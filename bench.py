@@ -1,0 +1,75 @@
+"""Benchmark: MNIST images/sec through the full data-parallel train step on
+real hardware. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Workload = the flagship DDP config (SURVEY.md §6): the 118,272-param MLP,
+per-chip batch 128, SGD lr=0.01, dropout active — i.e. the work one training
+step of ddp_tutorial_multi_gpu.py does per rank, on TPU via the SPMD step.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md). The
+driver-set north star is "match 2xA100 NCCL images/sec"; we pin that at a
+nominal 1,000,000 images/sec (an optimistic latency-bound estimate for this
+tiny MLP on 2 GPUs) and report value/1e6 so the ratio is stable across rounds.
+"""
+
+import json
+import time
+
+import numpy as np
+import jax
+
+NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
+
+
+def main() -> None:
+    from pytorch_ddp_mnist_tpu.parallel.ddp import (
+        make_dp_train_step, batch_sharding, replicated)
+    from pytorch_ddp_mnist_tpu.parallel.mesh import data_parallel_mesh
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist, normalize_images
+
+    mesh = data_parallel_mesh()
+    n_chips = mesh.devices.size
+    per_chip_batch = 128
+    batch = per_chip_batch * n_chips
+
+    split = synthetic_mnist(batch * 64, seed=0)
+    x_all = normalize_images(split.images)
+    y_all = split.labels.astype(np.int32)
+
+    step = make_dp_train_step(mesh, lr=0.01)
+    params = jax.device_put(init_mlp(jax.random.key(0)), replicated(mesh))
+    key = jax.device_put(jax.random.key(1), replicated(mesh))
+    bs = batch_sharding(mesh)
+
+    # Pre-stage batches on device: measures the compute/collective path the
+    # way the reference's images/sec would be measured with a saturated loader.
+    batches = [(jax.device_put(x_all[i * batch:(i + 1) * batch], bs),
+                jax.device_put(y_all[i * batch:(i + 1) * batch], bs))
+               for i in range(64)]
+
+    for x, y in batches[:3]:  # warmup + compile
+        params, key, loss = step(params, key, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 5.0:
+        for x, y in batches:
+            params, key, loss = step(params, key, x, y)
+        iters += len(batches)
+        jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = iters * batch / dt
+    per_chip = imgs_per_sec / n_chips
+    print(json.dumps({
+        "metric": "mnist_train_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(imgs_per_sec / NOMINAL_BASELINE_IMGS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

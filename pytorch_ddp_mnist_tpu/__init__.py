@@ -1,0 +1,19 @@
+"""pytorch_ddp_mnist_tpu — a TPU-native training framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the PyTorch DDP MNIST
+reference (Jonathanlyj/pytorch_ddp_mnist): serial baseline training, SPMD
+data-parallel training over a TPU device mesh (the reference's NCCL/Gloo/MPI
+gradient allreduce replaced by XLA collectives over ICI/DCN), a multi-method
+process wireup layer, a sharded parallel data pipeline with a native C++ reader
+core (the reference's PnetCDF/MPI-IO analog), an IDX->NetCDF converter, and
+launcher entry points for single-host and multi-host runs.
+
+Layer map (mirrors reference SURVEY.md §1):
+  L5 launchers   -> scripts/train_*.sh
+  L4 config/CLI  -> pytorch_ddp_mnist_tpu.train.config
+  L3 wireup/comm -> pytorch_ddp_mnist_tpu.parallel (mesh, wireup, collectives)
+  L2 data        -> pytorch_ddp_mnist_tpu.data (idx, netcdf, loader, native C++)
+  L1 model/loop  -> pytorch_ddp_mnist_tpu.models, .ops, .train
+"""
+
+__version__ = "0.1.0"

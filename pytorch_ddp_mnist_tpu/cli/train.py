@@ -1,0 +1,111 @@
+"""Unified trainer CLI — the capability of the reference's five entry scripts
+behind one config surface (SURVEY.md §0 capability matrix).
+
+  serial (default)      -> ddp_tutorial_cpu.py analog
+  --parallel            -> ddp_tutorial_multi_gpu.py / mnist_cpu_mp.py analog:
+                           SPMD data parallel over all devices of the mesh
+  --netcdf              -> mnist_pnetcdf_cpu[_mp].py analog: NetCDF data path
+  --wireup_method ...   -> multi-host rendezvous (reference `distributed` class)
+
+Run: python -m pytorch_ddp_mnist_tpu.cli.train [--parallel] [--n_epochs N] ...
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import numpy as np
+
+from ..data import BatchLoader, normalize_images
+from ..data.mnist import get_mnist
+from ..models import init_mlp, param_count
+from ..parallel import ShardedSampler
+from ..train import (TrainState, fit, save_checkpoint, load_checkpoint)
+from ..train.config import configure
+
+
+def main(argv=None) -> int:
+    from ..parallel.wireup import _honor_platform_env
+    _honor_platform_env()  # JAX_PLATFORMS from the launcher wins (e.g. cpu)
+    config = configure(argv)
+    tcfg, dcfg = config["trainer"], config["data"]
+
+    process_index, num_processes = 0, 1
+    train_step = None
+    put = None
+    mesh = None
+    if tcfg["parallel"]:
+        from ..parallel.wireup import initialize_runtime
+        from ..parallel.ddp import (make_dp_train_step, dp_mesh,
+                                    global_batch_from_local, replicate_state)
+        runtime = initialize_runtime(tcfg["wireup_method"])
+        process_index, num_processes = jax.process_index(), jax.process_count()
+        mesh = dp_mesh()  # global: all devices of all processes
+        train_step = make_dp_train_step(mesh, tcfg["lr"], dtype=tcfg["dtype"])
+        put = lambda b: global_batch_from_local(mesh, b)  # noqa: E731
+        num_shards = mesh.devices.size  # data sharding is per-device
+        local_shards = len(jax.local_devices())
+    else:
+        num_shards = local_shards = 1
+
+    if dcfg["netcdf"]:
+        raise SystemExit(
+            "--netcdf: the NetCDF data path ships with the native I/O layer "
+            "(pytorch_ddp_mnist_tpu.data.netcdf); not available yet")
+    train = get_mnist(dcfg["path"], train=True)
+    test = get_mnist(dcfg["path"], train=False)
+    if dcfg["limit"] and dcfg["limit"] > 0:
+        train.images = train.images[:dcfg["limit"]]
+        train.labels = train.labels[:dcfg["limit"]]
+    x_train = normalize_images(train.images)
+    x_test = normalize_images(test.images)
+
+    # Data plane: every process loads ONLY the rows for its own devices
+    # (PnetCDF independent-read analog); the sampler shards at process
+    # granularity and global_batch_from_local stitches the per-process
+    # shards into the global dp-sharded array. Single process degrades to
+    # the whole batch.
+    sampler = ShardedSampler(len(train), num_replicas=num_processes,
+                             rank=process_index, shuffle=True, seed=42)
+    global_batch = tcfg["batch_size"] * num_shards
+    local_batch = tcfg["batch_size"] * local_shards
+    loader = BatchLoader(x_train, train.labels, sampler, batch_size=local_batch)
+
+    state = TrainState(init_mlp(jax.random.key(tcfg["seed"])),
+                       jax.random.key(tcfg["seed"] + 1))
+    if tcfg["resume"]:
+        state = TrainState(load_checkpoint(tcfg["resume"], state.params),
+                           state.key)
+    if mesh is not None:
+        state = TrainState(replicate_state(mesh, state.params),
+                           replicate_state(mesh, state.key))
+
+    if process_index == 0:
+        print(f"pytorch_ddp_mnist_tpu: devices={jax.device_count()} "
+              f"processes={num_processes} params={param_count(state.params)} "
+              f"global_batch={global_batch} parallel={tcfg['parallel']}")
+
+    # Epoch-granular checkpointing (added capability — the reference saves
+    # only once, after training, ddp_tutorial_multi_gpu.py:143-144; rank-0
+    # gating matches it). Atomic overwrite, so preemption at epoch k resumes
+    # from k-1 via --resume.
+    hook = None
+    if process_index == 0 and tcfg["checkpoint"]:
+        hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
+
+    state = fit(state, loader, x_test, test.labels.astype(np.int32),
+                epochs=tcfg["n_epochs"],
+                batch_size=global_batch,
+                **({"lr": tcfg["lr"]} if train_step is None else {}),
+                log=print if process_index == 0 else (lambda s: None),
+                train_step=train_step, put=put, epoch_hook=hook)
+
+    if process_index == 0 and tcfg["checkpoint"]:
+        save_checkpoint(tcfg["checkpoint"], state.params)
+        print(f"saved checkpoint to {tcfg['checkpoint']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

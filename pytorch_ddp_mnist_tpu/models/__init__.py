@@ -1,0 +1,3 @@
+from .mlp import MLP_DIMS, init_mlp, mlp_apply, param_count
+
+__all__ = ["MLP_DIMS", "init_mlp", "mlp_apply", "param_count"]

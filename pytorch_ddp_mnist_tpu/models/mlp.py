@@ -1,0 +1,95 @@
+"""The MNIST MLP, as a functional JAX model.
+
+Capability parity with the reference model (see /root/reference
+ddp_tutorial_cpu.py:43-53, identical copies at ddp_tutorial_multi_gpu.py:52-62,
+mnist_cpu_mp.py:344-354, mnist_pnetcdf_cpu.py:66-76,
+mnist_pnetcdf_cpu_mp.py:412-422):
+
+    Linear(784, 128) -> ReLU -> Dropout(0.2) -> Linear(128, 128) -> ReLU
+        -> Linear(128, 10, bias=False)
+
+Parity points the implementation preserves:
+  * dropout ONLY after the first layer, rate 0.2, active only in train mode;
+  * NO bias on the final (output) layer;
+  * torch's default Linear initialization semantics: weight and bias both
+    drawn from U(-1/sqrt(fan_in), +1/sqrt(fan_in)) (kaiming_uniform with
+    a=sqrt(5) reduces to that bound for the weight).
+
+The model is a params pytree + pure apply function, the idiomatic JAX shape:
+everything jits, vmaps, and shards without a module system in the way. Params
+are stored in float32; `mlp_apply` computes in the dtype of `x` so a bfloat16
+compute path (MXU-friendly) is a cast at the call site, not a model change.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# (in_features, hidden, hidden, classes) — reference ddp_tutorial_cpu.py:45-51.
+MLP_DIMS = (784, 128, 128, 10)
+DROPOUT_RATE = 0.2
+
+Params = Dict[str, Dict[str, Any]]
+
+
+def _torch_linear_init(key: jax.Array, fan_in: int, fan_out: int, *, bias: bool,
+                       dtype=jnp.float32) -> Dict[str, jax.Array]:
+    """U(-1/sqrt(fan_in), +1/sqrt(fan_in)) for weight (and bias if present).
+
+    Matches torch.nn.Linear.reset_parameters semantics (kaiming_uniform with
+    a=sqrt(5) => bound sqrt(6/(6*fan_in)) = 1/sqrt(fan_in)).
+    Weight is stored as (fan_in, fan_out) so the forward pass is x @ w — the
+    natural MXU layout — rather than torch's (out, in) + transpose.
+    """
+    bound = 1.0 / math.sqrt(fan_in)
+    wkey, bkey = jax.random.split(key)
+    layer = {
+        "w": jax.random.uniform(wkey, (fan_in, fan_out), dtype, -bound, bound)
+    }
+    if bias:
+        layer["b"] = jax.random.uniform(bkey, (fan_out,), dtype, -bound, bound)
+    return layer
+
+
+def init_mlp(key: jax.Array, dtype=jnp.float32) -> Params:
+    """Initialize the 784-128-128-10 MLP params pytree."""
+    d0, d1, d2, d3 = MLP_DIMS
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "fc1": _torch_linear_init(k1, d0, d1, bias=True, dtype=dtype),
+        "fc2": _torch_linear_init(k2, d1, d2, bias=True, dtype=dtype),
+        # Output layer has bias=False in the reference (ddp_tutorial_cpu.py:51).
+        "fc3": _torch_linear_init(k3, d2, d3, bias=False, dtype=dtype),
+    }
+
+
+def mlp_apply(params: Params, x: jax.Array, *, train: bool = False,
+              dropout_key: jax.Array | None = None) -> jax.Array:
+    """Forward pass. `x` is (batch, 784) (callers flatten, matching the
+    reference's x.view(B, -1) at ddp_tutorial_multi_gpu.py:90).
+
+    In train mode a dropout mask is drawn from `dropout_key`; each data-parallel
+    replica must pass a distinct key (DDP ranks draw independent masks — see
+    SURVEY.md §7 parity item 4). Compute dtype follows x; params are cast to it.
+    """
+    dt = x.dtype
+    h = x @ params["fc1"]["w"].astype(dt) + params["fc1"]["b"].astype(dt)
+    h = jax.nn.relu(h)
+    if train:
+        if dropout_key is None:
+            raise ValueError("train=True requires dropout_key")
+        keep = 1.0 - DROPOUT_RATE
+        mask = jax.random.bernoulli(dropout_key, keep, h.shape)
+        # Inverted dropout, same as torch.nn.Dropout: scale kept units by 1/keep.
+        h = jnp.where(mask, h / jnp.asarray(keep, dt), jnp.zeros((), dt))
+    h = h @ params["fc2"]["w"].astype(dt) + params["fc2"]["b"].astype(dt)
+    h = jax.nn.relu(h)
+    return h @ params["fc3"]["w"].astype(dt)
+
+
+def param_count(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree_util.tree_leaves(params))

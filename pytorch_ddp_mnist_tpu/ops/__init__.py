@@ -1,0 +1,4 @@
+from .loss import cross_entropy, accuracy
+from .sgd import sgd_step
+
+__all__ = ["cross_entropy", "accuracy", "sgd_step"]

@@ -1,0 +1,27 @@
+"""Loss and metric ops.
+
+Parity target: torch.nn.CrossEntropyLoss() with default mean reduction, as the
+reference uses (ddp_tutorial_multi_gpu.py:76,93) — logits in, integer class
+targets in, softmax cross entropy averaged over the batch.
+
+The reference never computes accuracy anywhere (SURVEY.md §5.5); `accuracy` is
+the added capability BASELINE.md's acceptance targets require.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy. logits (B, C) float, labels (B,) int."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logz, labels[:, None].astype(jnp.int32), axis=-1)
+    return -jnp.mean(picked)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Fraction of argmax predictions matching labels."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.mean((pred == labels.astype(pred.dtype)).astype(jnp.float32))

@@ -1,0 +1,15 @@
+"""Plain SGD, matching the reference optimizer exactly: torch.optim.SGD with
+lr=0.01 and no momentum / weight decay / schedule
+(ddp_tutorial_multi_gpu.py:75). Stateless, so the "optimizer state" in our
+train step is just the params pytree itself — one less buffer to shard.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def sgd_step(params, grads, lr: float):
+    """params <- params - lr * grads, elementwise over the pytree."""
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
+                                  params, grads)

@@ -1,0 +1,4 @@
+from .sampler import ShardedSampler
+from .mesh import make_mesh, data_parallel_mesh
+
+__all__ = ["ShardedSampler", "make_mesh", "data_parallel_mesh"]

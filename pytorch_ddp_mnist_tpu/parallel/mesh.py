@@ -1,0 +1,43 @@
+"""Device-mesh construction for SPMD data parallelism (and beyond).
+
+The reference's parallel topology is flat ranks over NCCL/Gloo
+(ddp_tutorial_multi_gpu.py:133-134). The TPU-native analog is a
+jax.sharding.Mesh: collectives are emitted by the SPMD partitioner and ride
+ICI within a slice / DCN across slices, instead of a hand-driven process
+group. The mesh is the single topology object the rest of the framework
+consumes — samplers key off its size, train steps shard over its axes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+DATA_AXIS = "dp"
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
+              devices=None) -> Mesh:
+    """Build a Mesh of the given logical shape over `devices`.
+
+    Devices default to all addressable devices in process-major order
+    (jax.devices()), so on multi-host pods the leading axis naturally maps
+    hosts -> DCN and trailing axes -> ICI, the layout XLA's collectives want.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = int(np.prod(axis_sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(axis_sizes)} wants {n} devices, have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(tuple(axis_sizes))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """1-D mesh over every device, axis 'dp' — the DDP-analog topology."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return make_mesh([len(devices)], [DATA_AXIS], devices)

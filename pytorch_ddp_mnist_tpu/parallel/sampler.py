@@ -1,0 +1,77 @@
+"""Epoch-seeded sharded sampler — DistributedSampler-semantics parity.
+
+The reference shards its train set with torch's DistributedSampler
+(ddp_tutorial_multi_gpu.py:26-30, re-keyed per epoch via sampler.set_epoch(i)
+at :81; same pattern at mnist_cpu_mp.py:318-328,381 and
+mnist_pnetcdf_cpu_mp.py:390-401,449). The semantics that matter, and that this
+class reproduces (SURVEY.md §7 parity item 3):
+
+  1. a single GLOBAL permutation of [0, n) seeded by (seed + epoch), seed=42 —
+     every rank computes the same permutation;
+  2. PADDING BY REPETITION: the permuted index list is extended with its own
+     head so its length is divisible by world_size (total_size =
+     ceil(n / world) * world);
+  3. ROUND-ROBIN split: rank r takes indices[r::world_size];
+  4. reshuffle each epoch by calling set_epoch(e) before iterating.
+
+The permutation source is numpy's PCG64 (np.random.default_rng(seed + epoch))
+rather than torch's MT19937 randperm — deliberately: the framework carries no
+torch dependency. The *sharding math* (padding, interleave, epoch keying) is
+bitwise-faithful; tests/test_sampler.py cross-checks it against
+torch.utils.data.DistributedSampler when torch is importable.
+
+Non-shuffling mode mirrors DistributedSampler(shuffle=False): identity order,
+same padding and split.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+class ShardedSampler:
+    def __init__(self, num_samples: int, *, num_replicas: int = 1, rank: int = 0,
+                 shuffle: bool = True, seed: int = 42):
+        if not (0 <= rank < num_replicas):
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.num_samples = int(num_samples)
+        self.num_replicas = int(num_replicas)
+        self.rank = int(rank)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.epoch = 0
+        # Per-rank sample count after padding (DistributedSampler.num_samples).
+        self.samples_per_replica = math.ceil(self.num_samples / self.num_replicas)
+        self.total_size = self.samples_per_replica * self.num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Re-key the shuffle for a new epoch (DistributedSampler.set_epoch)."""
+        self.epoch = int(epoch)
+
+    def global_permutation(self) -> np.ndarray:
+        """The padded global order all ranks agree on this epoch."""
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(self.num_samples)
+        else:
+            idx = np.arange(self.num_samples)
+        pad = self.total_size - self.num_samples
+        if pad > 0:
+            # Pad by repeating from the head — torch repeats indices[:padding]
+            # (cycling if padding exceeds n, which only happens when
+            # world_size > n).
+            reps = np.resize(idx, pad) if pad > idx.size else idx[:pad]
+            idx = np.concatenate([idx, reps])
+        return idx
+
+    def indices(self) -> np.ndarray:
+        """This rank's shard for the current epoch: global_perm[rank::world]."""
+        return self.global_permutation()[self.rank::self.num_replicas]
+
+    def __len__(self) -> int:
+        return self.samples_per_replica
+
+    def __iter__(self):
+        return iter(self.indices())

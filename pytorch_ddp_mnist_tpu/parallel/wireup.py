@@ -1,0 +1,172 @@
+"""Multi-process wireup — the reference `distributed` class, TPU-native.
+
+The reference's wireup layer (mnist_cpu_mp.py:14-206, extended at
+mnist_pnetcdf_cpu_mp.py:51-272) derives MASTER_ADDR/PORT, RANK, WORLD_SIZE
+from SLURM / OpenMPI(PMIx) / MPICH(PMI) / fallback env vars, then calls
+torch.distributed.init_process_group(env://) and exposes rank/size queries
+plus MPI collectives (reduceMAX, barrier, finalize).
+
+TPU-native shape: the same env-derivation chains feed
+`jax.distributed.initialize(coordinator_address, num_processes, process_id)`
+— after which every JAX collective (the psum in parallel.ddp) spans all
+processes' devices over ICI/DCN; there is no separate "backend" choice
+because XLA owns the fabric (SURVEY.md §5.8 TPU-native equivalent).
+
+Method names map 1:1 to the reference's --wireup_method choices so launch
+scripts port directly; the reference's nccl-openmpi `os.environ(...)` crash
+bug (mnist_cpu_mp.py:97) is, naturally, not reproduced.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+
+
+def _first_host(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist, e.g. 'nid[0012-0015,0020]' -> nid0012.
+
+    The reference shells out to `scontrol show hostnames`; we parse the common
+    compact forms directly so no scheduler binary is required.
+    """
+    m = re.match(r"^([^\[,]+)\[([^\]]+)\]", nodelist)
+    if m:
+        prefix, ranges = m.groups()
+        first = ranges.split(",")[0].split("-")[0]
+        return prefix + first
+    return nodelist.split(",")[0]
+
+
+@dataclass
+class Runtime:
+    """Process-level topology handle (reference get_rank/get_size/
+    get_local_rank, mnist_cpu_mp.py:15-39)."""
+    method: str
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    coordinator: str | None = None
+    initialized: bool = False
+
+    def barrier(self) -> None:
+        """Cross-process sync (reference barrier, mnist_cpu_mp.py:201-203)."""
+        if self.size > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("pytorch_ddp_mnist_tpu.barrier")
+
+    def reduce_max(self, value: float) -> float:
+        """Global max of a host scalar (reference reduceMAX via
+        MPI.Reduce(op=MAX), mnist_cpu_mp.py:193-199) — delivered to ALL
+        processes (allreduce; the reference's root-only Reduce result is a
+        strict subset of this)."""
+        if self.size == 1:
+            return float(value)
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(jnp.float32(value))
+        return float(gathered.max())
+
+    def finalize(self) -> None:
+        """Tear down the distributed client (reference finalize ->
+        destroy_process_group, mnist_cpu_mp.py:205-206)."""
+        if self.initialized:
+            import jax
+            jax.distributed.shutdown()
+            self.initialized = False
+
+
+def _derive(method: str):
+    """(rank, size, local_rank, coordinator) from launcher env vars."""
+    env = os.environ
+    if method == "slurm":
+        # Reference SLURM branch: mnist_cpu_mp.py:47-89.
+        rank = int(env["SLURM_PROCID"])
+        size = int(env["SLURM_NTASKS"])
+        local = int(env.get("SLURM_LOCALID", 0))
+        host = _first_host(env.get("SLURM_STEP_NODELIST",
+                                   env.get("SLURM_NODELIST", "127.0.0.1")))
+        port = 12000 + int(env.get("SLURM_JOBID", "0")) % 20000
+        return rank, size, local, f"{host}:{port}"
+    if method == "openmpi":
+        # Reference PMIx branch: mnist_cpu_mp.py:94-113.
+        rank = int(env["OMPI_COMM_WORLD_RANK"])
+        size = int(env["OMPI_COMM_WORLD_SIZE"])
+        local = int(env.get("OMPI_COMM_WORLD_LOCAL_RANK", 0))
+        coord = f"{env.get('MASTER_ADDR', '127.0.0.1')}:{env.get('MASTER_PORT', '29500')}"
+        return rank, size, local, coord
+    if method == "mpich":
+        # Reference PMI branch: mnist_cpu_mp.py:118-142.
+        rank = int(env["PMI_RANK"])
+        size = int(env["PMI_SIZE"])
+        local = int(env.get("MPI_LOCALRANKID", 0))
+        coord = f"{env.get('MASTER_ADDR', '127.0.0.1')}:{env.get('MASTER_PORT', '29500')}"
+        return rank, size, local, coord
+    if method == "env":
+        # Reference fallback branch: mnist_cpu_mp.py:147-185.
+        rank = int(env.get("RANK", "0"))
+        size = int(env.get("WORLD_SIZE", "1"))
+        local = int(env.get("LOCAL_RANK", "0"))
+        coord = f"{env.get('MASTER_ADDR', '127.0.0.1')}:{env.get('MASTER_PORT', '29500')}"
+        return rank, size, local, coord
+    raise ValueError(f"unknown wireup method {method!r}")
+
+
+def detect_method() -> str:
+    """Probe launcher env — the reference picks via CLI; 'auto' adds detection."""
+    env = os.environ
+    if "SLURM_PROCID" in env and "SLURM_NTASKS" in env:
+        return "slurm"
+    if "OMPI_COMM_WORLD_RANK" in env:
+        return "openmpi"
+    if "PMI_RANK" in env:
+        return "mpich"
+    if "RANK" in env and "WORLD_SIZE" in env:
+        return "env"
+    return "single"
+
+
+def _honor_platform_env() -> None:
+    """Make JAX_PLATFORMS from the launcher win over any backend already
+    registered at interpreter start (e.g. a site-installed TPU plugin that
+    forces its own platform list). Must run before rendezvous so every
+    process brings up the same platform."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
+        try:
+            from jax.extend.backend import clear_backends
+            clear_backends()
+        except Exception:
+            pass
+
+
+def initialize_runtime(method: str = "auto") -> Runtime:
+    """Resolve topology and (if multi-process) rendezvous via
+    jax.distributed.initialize. Safe to call in single-process runs.
+
+    After a successful multi-process init, jax.device_count() spans ALL
+    processes' devices and every jit/psum is global — the moment the
+    reference reaches with dist.init_process_group (mnist_cpu_mp.py:92-188).
+    """
+    _honor_platform_env()
+    if method == "auto":
+        method = detect_method()
+    if method == "single":
+        return Runtime(method="single")
+    rank, size, local, coord = _derive(method)
+    rt = Runtime(method=method, rank=rank, size=size, local_rank=local,
+                 coordinator=coord)
+    if size > 1:
+        import jax
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=size, process_id=rank)
+        rt.initialized = True
+        if jax.process_count() != size:
+            raise RuntimeError(
+                f"wireup {rt.method}: expected {size} processes, runtime "
+                f"formed {jax.process_count()} — rendezvous failed")
+    return rt

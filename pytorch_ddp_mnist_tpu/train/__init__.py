@@ -1,0 +1,7 @@
+from .loop import TrainState, make_train_step, make_eval_step, fit, evaluate
+from .checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = [
+    "TrainState", "make_train_step", "make_eval_step", "fit", "evaluate",
+    "save_checkpoint", "load_checkpoint",
+]

@@ -1,0 +1,37 @@
+"""Checkpointing.
+
+Reference behavior (SURVEY.md §5.4): ONE final params-only save, rank 0 only,
+DDP-unwrapped — torch.save(model.state_dict(), 'model.pt')
+(ddp_tutorial_multi_gpu.py:118,143-144). The save-side parity is
+`save_checkpoint(path, params)` called process-0-only by the trainers; the
+"unwrap" has no analog because SPMD params are already a plain pytree.
+
+Added capability beyond the reference (which has no load path at all): a
+matching `load_checkpoint`, so checkpoints are actually usable, and an
+epoch-granular resume hook in the CLI. Format: flax msgpack serialization of
+the params pytree — single file, byte-stable, no torch dependency.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def save_checkpoint(path: str, params) -> None:
+    """Serialize a params pytree to `path` (msgpack). Fully fetches to host."""
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    data = serialization.to_bytes(host_params)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic: no torn checkpoint on crash
+
+
+def load_checkpoint(path: str, template):
+    """Restore a params pytree from `path` using `template` for structure."""
+    with open(path, "rb") as f:
+        return serialization.from_bytes(template, f.read())

@@ -1,0 +1,86 @@
+"""Config / CLI layer — the reference `configure()` analog.
+
+The reference builds a two-section nested dict from defaults + argparse
+(mnist_cpu_mp.py:208-243, mnist_pnetcdf_cpu_mp.py:274-309):
+trainer.{batch_size, wireup_method, parallel, device, n_epochs, num_workers}
+and data.{path, limit, label_map, hdf5}. Its tutorial scripts instead
+hard-code batch_size=128 / epochs in __main__
+(ddp_tutorial_multi_gpu.py:126-127); our CLIs take these as defaults.
+
+Kept keys that are dead in the reference (label_map, hdf5, data.limit —
+parsed and printed but never used by training, SURVEY.md §5.6) are accepted
+for CLI compatibility; `data.limit` is actually honored here (truncates the
+dataset) since that is its evident intent.
+
+wireup_method choices map the reference's {nccl-slurm, nccl-openmpi,
+nccl-mpich, gloo, mpich} onto the TPU runtime: every method resolves to
+jax.distributed.initialize with coordinator discovery appropriate to the
+launcher (see parallel.wireup); the names are kept so launch scripts port 1:1.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict
+
+WIREUP_CHOICES = (
+    "auto",          # probe: SLURM -> OpenMPI -> MPICH -> env -> single-process
+    "slurm",         # reference nccl-slurm analog (mnist_cpu_mp.py:47-89)
+    "openmpi",       # reference nccl-openmpi analog (PMIx env, :94-113)
+    "mpich",         # reference nccl-mpich / mpich analog (PMI env, :118-142)
+    "env",           # reference fallback env:// analog (:147-185)
+    "single",        # no distributed init (serial / one-process multi-chip)
+)
+
+
+def configure(argv=None) -> Dict[str, Dict[str, Any]]:
+    """Parse CLI args into the nested {trainer: {...}, data: {...}} config."""
+    p = argparse.ArgumentParser(
+        description="TPU-native MNIST trainer (capability parity with "
+                    "pytorch_ddp_mnist; see SURVEY.md)")
+    t = p.add_argument_group("trainer")
+    t.add_argument("--batch_size", type=int, default=128)
+    t.add_argument("--n_epochs", type=int, default=1)
+    t.add_argument("--lr", type=float, default=0.01)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--parallel", action="store_true",
+                   help="data-parallel over the device mesh (DDP analog)")
+    t.add_argument("--wireup_method", choices=WIREUP_CHOICES, default="auto")
+    t.add_argument("--num_workers", type=int, default=0,
+                   help="accepted for reference-CLI parity; the prefetch "
+                        "loader is async without worker processes")
+    t.add_argument("--device", type=int, default=0,
+                   help="reference-CLI parity (per-rank device ordinal); "
+                        "device placement is mesh-driven on TPU")
+    t.add_argument("--checkpoint", type=str, default="model.msgpack")
+    t.add_argument("--resume", type=str, default=None,
+                   help="checkpoint to load before training (added capability;"
+                        " the reference has no load path)")
+    t.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32",
+                   help="compute dtype for the train step")
+    d = p.add_argument_group("data")
+    d.add_argument("--path", type=str, default="data/",
+                   help="dataset root (IDX or NetCDF files)")
+    d.add_argument("--netcdf", action="store_true",
+                   help="read mnist_{train,test}_images.nc (PnetCDF-path analog)")
+    d.add_argument("--limit", type=int, default=-1,
+                   help="truncate dataset to N samples (reference parsed this "
+                        "but never used it; honored here)")
+    d.add_argument("--hdf5", action="store_true",
+                   help="dead flag kept for reference-CLI parity")
+    d.add_argument("--label_map", type=int, nargs="*", default=None,
+                   help="dead key kept for reference-CLI parity")
+    a = p.parse_args(argv)
+    return {
+        "trainer": {
+            "batch_size": a.batch_size, "n_epochs": a.n_epochs, "lr": a.lr,
+            "seed": a.seed, "parallel": a.parallel,
+            "wireup_method": a.wireup_method, "num_workers": a.num_workers,
+            "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
+            "dtype": a.dtype,
+        },
+        "data": {
+            "path": a.path, "netcdf": a.netcdf, "limit": a.limit,
+            "hdf5": a.hdf5, "label_map": a.label_map,
+        },
+    }

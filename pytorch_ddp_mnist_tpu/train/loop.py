@@ -1,0 +1,161 @@
+"""Train/eval loop — the reference's `main()` re-shaped for XLA.
+
+Canonical reference loop: ddp_tutorial_multi_gpu.py:65-118. Per epoch it
+(a) reshuffles via sampler.set_epoch(i), (b) runs the train pass — flatten,
+forward, CE loss, backward (allreduce inside), SGD step, per-step scalar
+logging — then (c) evaluates the FULL test set on every rank with dropout off,
+and prints `Epoch=i, train_loss=…, val_loss=…`.
+
+XLA-native restructurings (reported numbers keep the reference's meaning,
+SURVEY.md §7 item 7):
+  * the whole step (fwd+bwd+SGD) is one jitted function with donated params —
+    no optimizer object, no zero_grad; XLA fuses the pipeline;
+  * per-step `.item()` host syncs (ddp_tutorial_multi_gpu.py:96 — a
+    device→host round trip EVERY step) are replaced by accumulating the
+    per-batch mean losses on device and fetching ONCE per epoch;
+  * the reference's "epoch_loss" accumulator quirk — it sums
+    batch_mean_loss / batch_size, a nonstandard unit (SURVEY.md §5.5) — is
+    reproduced exactly in the printed line, with standard mean loss and test
+    accuracy (capability added per BASELINE.md) reported alongside;
+  * eval runs the full test set per process, dropout disabled, exactly like
+    the reference (ddp_tutorial_multi_gpu.py:101-114).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Iterable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.mlp import mlp_apply
+from ..ops.loss import cross_entropy, accuracy
+from ..ops.sgd import sgd_step
+from ..data.loader import BatchLoader, device_prefetch
+
+
+@dataclass
+class TrainState:
+    """Params + RNG key. SGD is stateless so this is the whole state."""
+    params: dict
+    key: jax.Array
+
+
+def _loss_fn(params, x, y, dropout_key):
+    logits = mlp_apply(params, x, train=True, dropout_key=dropout_key)
+    return cross_entropy(logits, y)
+
+
+def make_train_step(lr: float) -> Callable:
+    """One jitted SGD step: (params, key, x, y) -> (params', key', mean_loss).
+
+    The RNG key is split inside the step (traced, so it stays on device); the
+    dropout mask is drawn per call, matching torch Dropout's fresh mask per
+    forward. Params are donated — the update is in-place in HBM.
+    """
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, key, x, y):
+        key, sub = jax.random.split(key)
+        loss, grads = jax.value_and_grad(_loss_fn)(params, x, y, sub)
+        return sgd_step(params, grads, lr), key, loss
+
+    return step
+
+
+def make_eval_step() -> Callable:
+    """Jitted masked eval on a fixed-size batch.
+
+    (params, x, y, n_valid) -> (sum_loss, n_correct) over the first `n_valid`
+    rows only. The mask (not the shape) carries the partial-batch size, so
+    every eval batch compiles ONE program and padded rows can never bias the
+    metrics.
+    """
+    @jax.jit
+    def step(params, x, y, n_valid):
+        logits = mlp_apply(params, x, train=False)
+        logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        per_sample = -jnp.take_along_axis(
+            logz, y[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+        mask = (jnp.arange(x.shape[0]) < n_valid).astype(jnp.float32)
+        return jnp.sum(per_sample * mask), jnp.sum(correct * mask)
+
+    return step
+
+
+def evaluate(eval_step, params, x_test, y_test, batch_size: int):
+    """Full-test-set eval, batched like the reference's eval loop
+    (ddp_tutorial_multi_gpu.py:101-114).
+
+    Returns (val_loss_ref_unit, mean_loss, acc): val_loss_ref_unit replicates
+    the reference accumulator Σ(batch_mean/B) including its true last-batch
+    size B (the reference's DataLoader yields a short final batch; here the
+    batch is padded to static shape and masked out of the sums instead).
+    """
+    n = x_test.shape[0]
+    x_test = np.asarray(x_test)
+    y_test = np.asarray(y_test)
+    sums, corrects, counts = [], [], []
+    for start in range(0, n, batch_size):
+        b = min(batch_size, n - start)
+        idx = np.arange(start, start + batch_size) % n  # wrap-pad, masked out
+        sum_loss, n_correct = eval_step(
+            params, jnp.asarray(x_test[idx]), jnp.asarray(y_test[idx]),
+            jnp.int32(b))
+        sums.append(sum_loss)
+        corrects.append(n_correct)
+        counts.append(b)
+    sums = np.asarray(jnp.stack(sums))          # ONE device->host fetch
+    corrects = np.asarray(jnp.stack(corrects))
+    counts = np.asarray(counts, np.float64)
+    val_loss_ref_unit = float((sums / counts / counts).sum())  # Σ(mean/B)
+    return val_loss_ref_unit, float(sums.sum() / n), float(corrects.sum() / n)
+
+
+def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
+        epochs: int, batch_size: int, lr: float | None = None,
+        log: Callable[[str], None] = print,
+        train_step: Callable | None = None, sharding=None, put=None,
+        epoch_hook: Callable | None = None) -> TrainState:
+    """Run the reference training loop for `epochs` epochs.
+
+    Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
+    jitted step; a prebuilt `train_step` (e.g. the mesh-sharded DP step,
+    which bakes in its own lr) is used as-is, with `sharding`/`put` for batch
+    placement. The printed epoch line replicates the reference format and
+    units (ddp_tutorial_multi_gpu.py:116), extended with accuracy and timing.
+    `epoch_hook(epoch, state)` supports mid-training checkpointing.
+    """
+    if (train_step is None) == (lr is None):
+        raise ValueError("pass exactly one of lr= or train_step=")
+    step = train_step if train_step is not None else make_train_step(lr)
+    eval_step = make_eval_step()
+    params, key = state.params, state.key
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        train_loader.sampler.set_epoch(epoch)
+        losses = []
+        nbatches = 0
+        for x, y in device_prefetch(train_loader, sharding=sharding, put=put):
+            params, key, loss = step(params, key, x, y)
+            losses.append(loss)
+            nbatches += 1
+        losses = np.asarray(jnp.stack(losses))  # single host fetch per epoch
+        train_loss_ref_unit = float((losses / batch_size).sum())
+        train_mean = float(losses.mean())
+        val_ref_unit, val_mean, val_acc = evaluate(
+            eval_step, params, x_test, y_test, batch_size)
+        dt = time.perf_counter() - t0
+        imgs = nbatches * batch_size
+        log(f"Epoch={epoch}, train_loss={train_loss_ref_unit}, "
+            f"val_loss={val_ref_unit}"
+            f"  [mean_train={train_mean:.4f} mean_val={val_mean:.4f} "
+            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s]")
+        state = TrainState(params, key)
+        if epoch_hook is not None:
+            epoch_hook(epoch, state)
+    return state

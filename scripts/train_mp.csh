@@ -1,0 +1,17 @@
+#!/bin/csh
+# Multi-process CPU stand-in — the reference train_cpu_mp.csh analog
+# (/root/reference/train_cpu_mp.csh:1: mpiexec -n 4 ... --parallel
+# --wireup_method mpich). Without an MPI launcher in the image, the same
+# 4-process rendezvous is driven by env-var wireup (the reference's fallback
+# branch, mnist_cpu_mp.py:147-185): each process gets RANK/WORLD_SIZE and
+# meets at the coordinator.
+cd `dirname $0`/..
+setenv JAX_PLATFORMS cpu
+setenv WORLD_SIZE 4
+setenv MASTER_ADDR 127.0.0.1
+setenv MASTER_PORT 29531
+foreach r (0 1 2 3)
+    env RANK=$r python -m pytorch_ddp_mnist_tpu.cli.train \
+        --parallel --wireup_method env --n_epochs 1 $argv:q &
+end
+wait

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Multi-process CPU stand-in (bash twin of train_mp.csh, since csh may not be
+# installed). 4 localhost processes rendezvous via the env:// wireup branch —
+# the analog of the reference's `mpiexec -n 4 … --wireup_method mpich` run
+# (/root/reference/train_cpu_mp.csh:1) with gloo forced on no-GPU hosts
+# (mnist_cpu_mp.py:248-250).
+set -e
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export WORLD_SIZE=${WORLD_SIZE:-4}
+export MASTER_ADDR=127.0.0.1
+export MASTER_PORT=${MASTER_PORT:-29531}
+pids=()
+for r in $(seq 0 $((WORLD_SIZE - 1))); do
+    RANK=$r python -m pytorch_ddp_mnist_tpu.cli.train \
+        --parallel --wireup_method env --n_epochs 1 "$@" &
+    pids+=($!)
+done
+for p in "${pids[@]}"; do wait "$p"; done

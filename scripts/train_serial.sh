@@ -1,0 +1,6 @@
+#!/bin/bash
+# Serial baseline trainer — the reference train_cpu.sh analog
+# (/root/reference/train_cpu.sh:3 runs ddp_tutorial_cpu.py, 1 epoch).
+set -e
+cd "$(dirname "$0")/.."
+python -m pytorch_ddp_mnist_tpu.cli.train --n_epochs 1 "$@"

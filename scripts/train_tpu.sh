@@ -1,0 +1,10 @@
+#!/bin/bash
+# Flagship data-parallel trainer — the reference train_multi_gpu.sh analog
+# (/root/reference/train_multi_gpu.sh:3: torch.distributed.launch
+# --nproc_per_node=8, NCCL, 10 epochs). On TPU one process drives all local
+# chips via the SPMD mesh; no per-rank process spawn is needed on a single
+# host. For a multi-host pod, run this once per host under your scheduler —
+# wireup (SLURM/OpenMPI/MPICH/env) is picked up from the environment.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytorch_ddp_mnist_tpu.cli.train --parallel --n_epochs 10 "$@"

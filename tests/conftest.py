@@ -1,0 +1,30 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU-native analog of the reference's cluster stand-in — it tests
+multi-node DDP semantics with 4 local gloo processes (train_cpu_mp.csh:1,
+forced CPU at mnist_cpu_mp.py:248-250). Here, 8 virtual XLA host devices
+stand in for a v4-8 slice (SURVEY.md §4): the same SPMD code paths, shardings
+and collectives compile and run, just on CPU.
+
+The session may have a real TPU backend pre-registered at interpreter startup
+(sitecustomize), so setting env vars alone is not enough: we set XLA_FLAGS
+(read lazily at CPU client creation), force the platform list to cpu, and
+drop any already-initialized backend set.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+    clear_backends()
+except Exception:
+    pass

@@ -1,0 +1,51 @@
+"""CE loss parity vs torch.nn.CrossEntropyLoss (the reference's criterion,
+ddp_tutorial_multi_gpu.py:76) and SGD step parity vs torch.optim.SGD."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.ops import cross_entropy, accuracy, sgd_step
+
+torch = pytest.importorskip("torch")
+
+
+def test_cross_entropy_matches_torch():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 10)).astype(np.float32) * 5
+    labels = rng.integers(0, 10, size=64)
+    ours = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    theirs = float(torch.nn.CrossEntropyLoss()(
+        torch.tensor(logits), torch.tensor(labels)))
+    assert abs(ours - theirs) < 1e-5
+
+
+def test_cross_entropy_grad_matches_torch():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(8, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=8)
+    g_ours = np.asarray(jax.grad(
+        lambda l: cross_entropy(l, jnp.asarray(labels)))(jnp.asarray(logits)))
+    t = torch.tensor(logits, requires_grad=True)
+    torch.nn.CrossEntropyLoss()(t, torch.tensor(labels)).backward()
+    np.testing.assert_allclose(g_ours, t.grad.numpy(), atol=1e-6)
+
+
+def test_accuracy():
+    logits = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [3.0, 2.0], [0.1, 0.2]])
+    labels = jnp.asarray([0, 1, 1, 1])
+    assert float(accuracy(logits, labels)) == 0.75
+
+
+def test_sgd_matches_torch():
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(16, 4)).astype(np.float32)
+    g = rng.normal(size=(16, 4)).astype(np.float32)
+    ours = np.asarray(sgd_step({"w": jnp.asarray(w)}, {"w": jnp.asarray(g)},
+                               lr=0.01)["w"])
+    tw = torch.tensor(w, requires_grad=True)
+    opt = torch.optim.SGD([tw], lr=0.01)
+    tw.grad = torch.tensor(g)
+    opt.step()
+    np.testing.assert_allclose(ours, tw.detach().numpy(), atol=1e-7)

@@ -1,0 +1,65 @@
+"""Model parity tests vs reference §2.6 (MLP 784-128-128-10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.models import init_mlp, mlp_apply, param_count
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_mlp(jax.random.key(0))
+
+
+def test_param_count_matches_reference(params):
+    # 784*128 + 128 + 128*128 + 128 + 128*10 = 118,272 (BASELINE.md)
+    assert param_count(params) == 118_272
+
+
+def test_output_layer_has_no_bias(params):
+    assert "b" not in params["fc3"]
+    assert params["fc3"]["w"].shape == (128, 10)
+
+
+def test_init_bounds_match_torch_linear(params):
+    # torch Linear: weight, bias ~ U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+    for name, fan_in in (("fc1", 784), ("fc2", 128), ("fc3", 128)):
+        bound = 1.0 / np.sqrt(fan_in)
+        w = np.asarray(params[name]["w"])
+        assert np.abs(w).max() <= bound
+        # Distribution sanity: spread should fill a good part of the range.
+        assert np.abs(w).max() > 0.8 * bound
+
+
+def test_forward_shape_and_determinism(params):
+    x = jnp.ones((4, 784))
+    out1 = mlp_apply(params, x)
+    out2 = mlp_apply(params, x)
+    assert out1.shape == (4, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_dropout_only_in_train_mode(params):
+    x = jnp.ones((8, 784))
+    eval_out = mlp_apply(params, x, train=False)
+    k1, k2 = jax.random.key(1), jax.random.key(2)
+    t1 = mlp_apply(params, x, train=True, dropout_key=k1)
+    t2 = mlp_apply(params, x, train=True, dropout_key=k2)
+    # train-mode outputs vary with the dropout key; eval does not use one
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+    assert np.all(np.isfinite(np.asarray(eval_out)))
+    with pytest.raises(ValueError):
+        mlp_apply(params, x, train=True)
+
+
+def test_train_eval_expectation_consistent(params):
+    # Inverted dropout: E[train output] ~= eval output. Average many keys.
+    x = jax.random.normal(jax.random.key(3), (16, 784))
+    eval_out = np.asarray(mlp_apply(params, x, train=False))
+    outs = [np.asarray(mlp_apply(params, x, train=True,
+                                 dropout_key=jax.random.key(100 + i)))
+            for i in range(200)]
+    mean_out = np.mean(outs, axis=0)
+    np.testing.assert_allclose(mean_out, eval_out, atol=0.25)

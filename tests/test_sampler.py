@@ -1,0 +1,78 @@
+"""ShardedSampler parity vs torch DistributedSampler semantics (SURVEY.md §7
+item 3): seeded global permutation, padding by repetition, round-robin split,
+per-epoch reshuffle."""
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+
+torch = pytest.importorskip("torch")
+from torch.utils.data import DistributedSampler  # noqa: E402
+
+
+class _FakeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+@pytest.mark.parametrize("n,world", [(100, 4), (60_000, 8), (10, 3), (7, 8)])
+def test_no_shuffle_bitwise_matches_torch(n, world):
+    for rank in range(world):
+        ours = ShardedSampler(n, num_replicas=world, rank=rank, shuffle=False)
+        theirs = DistributedSampler(_FakeDataset(n), num_replicas=world,
+                                    rank=rank, shuffle=False)
+        np.testing.assert_array_equal(ours.indices(), np.fromiter(iter(theirs), int))
+        assert len(ours) == len(theirs)
+
+
+@pytest.mark.parametrize("n,world", [(100, 4), (1000, 8), (13, 4)])
+def test_shards_partition_padded_permutation(n, world):
+    samplers = [ShardedSampler(n, num_replicas=world, rank=r, seed=42)
+                for r in range(world)]
+    for s in samplers:
+        s.set_epoch(3)
+    shards = [s.indices() for s in samplers]
+    total = samplers[0].total_size
+    assert sum(len(s) for s in shards) == total
+    # Concatenated shards re-interleave into the global padded permutation.
+    merged = np.empty(total, dtype=int)
+    for r, sh in enumerate(shards):
+        merged[r::world] = sh
+    np.testing.assert_array_equal(merged, samplers[0].global_permutation())
+    # Every original sample appears at least once.
+    assert set(np.concatenate(shards)) == set(range(n))
+
+
+def test_epoch_reshuffle_and_determinism():
+    s = ShardedSampler(1000, num_replicas=4, rank=1, seed=42)
+    s.set_epoch(0)
+    e0 = s.indices()
+    s.set_epoch(1)
+    e1 = s.indices()
+    assert not np.array_equal(e0, e1)
+    s.set_epoch(0)
+    np.testing.assert_array_equal(e0, s.indices())
+    # Same (seed, epoch) on another instance agrees — all ranks can shuffle
+    # without communicating, like torch's set_epoch contract.
+    s2 = ShardedSampler(1000, num_replicas=4, rank=1, seed=42)
+    s2.set_epoch(1)
+    np.testing.assert_array_equal(e1, s2.indices())
+
+
+def test_padding_by_repetition_from_head():
+    s = ShardedSampler(10, num_replicas=4, rank=0, shuffle=False)
+    perm = s.global_permutation()
+    # 10 -> total 12, pad with head of the (identity) order: [0, 1]
+    np.testing.assert_array_equal(perm, np.r_[np.arange(10), [0, 1]])
+
+
+def test_pad_exceeding_dataset_cycles():
+    # world > n: torch cycles the index list to fill the pad.
+    s = ShardedSampler(3, num_replicas=8, rank=0, shuffle=False)
+    perm = s.global_permutation()
+    assert perm.size == 8
+    np.testing.assert_array_equal(perm, [0, 1, 2, 0, 1, 2, 0, 1])

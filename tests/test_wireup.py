@@ -1,0 +1,74 @@
+"""Wireup env-derivation parity vs the reference `distributed` class branches
+(mnist_cpu_mp.py:41-191). Single-process here; the true multi-process
+rendezvous is exercised by tests/test_multiprocess.py."""
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.parallel.wireup import (
+    Runtime, _derive, _first_host, detect_method, initialize_runtime)
+
+
+def test_first_host_parsing():
+    assert _first_host("nid[0012-0015,0020]") == "nid0012"
+    assert _first_host("node1,node2") == "node1"
+    assert _first_host("host07") == "host07"
+    assert _first_host("gpu[3,5-9]") == "gpu3"
+
+
+def test_slurm_derivation(monkeypatch):
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.setenv("SLURM_LOCALID", "1")
+    monkeypatch.setenv("SLURM_NODELIST", "nid[0040-0043]")
+    monkeypatch.setenv("SLURM_JOBID", "12345")
+    rank, size, local, coord = _derive("slurm")
+    assert (rank, size, local) == (3, 8, 1)
+    host, port = coord.rsplit(":", 1)
+    assert host == "nid0040"
+    assert 12000 <= int(port) < 32000
+    assert detect_method() == "slurm"
+
+
+def test_openmpi_derivation(monkeypatch):
+    for k in ("SLURM_PROCID", "SLURM_NTASKS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "2")
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    monkeypatch.setenv("MASTER_PORT", "23456")
+    rank, size, local, coord = _derive("openmpi")
+    assert (rank, size, local, coord) == (2, 4, 2, "10.0.0.1:23456")
+    assert detect_method() == "openmpi"
+
+
+def test_mpich_derivation(monkeypatch):
+    monkeypatch.setenv("PMI_RANK", "1")
+    monkeypatch.setenv("PMI_SIZE", "4")
+    rank, size, local, coord = _derive("mpich")
+    assert (rank, size) == (1, 4)
+
+
+def test_env_fallback_and_single(monkeypatch):
+    for k in ("SLURM_PROCID", "SLURM_NTASKS", "OMPI_COMM_WORLD_RANK",
+              "PMI_RANK", "RANK", "WORLD_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    assert detect_method() == "single"
+    rt = initialize_runtime("auto")
+    assert rt.size == 1 and rt.rank == 0 and not rt.initialized
+    # single-process collectives degrade gracefully
+    assert rt.reduce_max(3.5) == 3.5
+    rt.barrier()
+    rt.finalize()
+
+    monkeypatch.setenv("RANK", "0")
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    assert detect_method() == "env"
+    rank, size, local, coord = _derive("env")
+    assert (rank, size) == (0, 2)
+
+
+def test_unknown_method_raises():
+    with pytest.raises(ValueError):
+        _derive("nccl")
