@@ -49,28 +49,52 @@ def main(argv=None) -> int:
     else:
         num_shards = local_shards = 1
 
-    if dcfg["netcdf"]:
-        raise SystemExit(
-            "--netcdf: the NetCDF data path ships with the native I/O layer "
-            "(pytorch_ddp_mnist_tpu.data.netcdf); not available yet")
-    train = get_mnist(dcfg["path"], train=True)
-    test = get_mnist(dcfg["path"], train=False)
-    if dcfg["limit"] and dcfg["limit"] > 0:
-        train.images = train.images[:dcfg["limit"]]
-        train.labels = train.labels[:dcfg["limit"]]
-    x_train = normalize_images(train.images)
-    x_test = normalize_images(test.images)
+    global_batch = tcfg["batch_size"] * num_shards
+    local_batch = tcfg["batch_size"] * local_shards
 
     # Data plane: every process loads ONLY the rows for its own devices
     # (PnetCDF independent-read analog); the sampler shards at process
     # granularity and global_batch_from_local stitches the per-process
     # shards into the global dp-sharded array. Single process degrades to
     # the whole batch.
-    sampler = ShardedSampler(len(train), num_replicas=num_processes,
-                             rank=process_index, shuffle=True, seed=42)
-    global_batch = tcfg["batch_size"] * num_shards
-    local_batch = tcfg["batch_size"] * local_shards
-    loader = BatchLoader(x_train, train.labels, sampler, batch_size=local_batch)
+    if dcfg["netcdf"]:
+        # NetCDF path (mnist_pnetcdf_cpu[_mp].py analog): train batches are
+        # sharded row-gathers straight from the .nc file; the test split is
+        # read whole per process, like the serial variant's collective read
+        # (mnist_pnetcdf_cpu.py:47).
+        import os
+        from ..data.loader import NetCDFShardLoader
+        from ..data.netcdf import read_mnist_netcdf
+        train_nc = os.path.join(dcfg["path"], "mnist_train_images.nc")
+        test_nc = os.path.join(dcfg["path"], "mnist_test_images.nc")
+        for p in (train_nc, test_nc):
+            if not os.path.exists(p):
+                raise SystemExit(
+                    f"--netcdf: {p} not found; produce it with "
+                    "`python -m pytorch_ddp_mnist_tpu.data.convert`")
+        test_images, test_labels = read_mnist_netcdf(test_nc)
+        x_test = normalize_images(test_images)
+        test_labels = test_labels.astype(np.int32)
+        from ..data.netcdf import NetCDFReader
+        n_train = NetCDFReader(train_nc).variables["images"].shape[0]
+        if dcfg["limit"] and dcfg["limit"] > 0:
+            n_train = min(n_train, dcfg["limit"])
+        sampler = ShardedSampler(n_train, num_replicas=num_processes,
+                                 rank=process_index, shuffle=True, seed=42)
+        loader = NetCDFShardLoader(train_nc, sampler, batch_size=local_batch)
+    else:
+        train = get_mnist(dcfg["path"], train=True)
+        test = get_mnist(dcfg["path"], train=False)
+        if dcfg["limit"] and dcfg["limit"] > 0:
+            train.images = train.images[:dcfg["limit"]]
+            train.labels = train.labels[:dcfg["limit"]]
+        x_train = normalize_images(train.images)
+        x_test = normalize_images(test.images)
+        test_labels = test.labels.astype(np.int32)
+        sampler = ShardedSampler(len(train), num_replicas=num_processes,
+                                 rank=process_index, shuffle=True, seed=42)
+        loader = BatchLoader(x_train, train.labels, sampler,
+                             batch_size=local_batch)
 
     state = TrainState(init_mlp(jax.random.key(tcfg["seed"])),
                        jax.random.key(tcfg["seed"] + 1))
@@ -94,7 +118,7 @@ def main(argv=None) -> int:
     if process_index == 0 and tcfg["checkpoint"]:
         hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
 
-    state = fit(state, loader, x_test, test.labels.astype(np.int32),
+    state = fit(state, loader, x_test, test_labels,
                 epochs=tcfg["n_epochs"],
                 batch_size=global_batch,
                 **({"lr": tcfg["lr"]} if train_step is None else {}),

@@ -1,0 +1,99 @@
+"""Native C++ reader core vs the pure-Python parsers (which are the format
+source of truth). Covers IDX and CDF-5, whole reads, sharded row gathers,
+coalesced runs, and error paths."""
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.data import synthetic_mnist, write_idx
+from pytorch_ddp_mnist_tpu.data.netcdf import (write_mnist_netcdf,
+                                               write_netcdf, NetCDFReader)
+from pytorch_ddp_mnist_tpu.data.native import (NativeReader, native_available,
+                                               native_build_error)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason=f"no native reader: {native_build_error()}")
+
+
+@pytest.fixture(scope="module")
+def split():
+    return synthetic_mnist(64, seed=5)
+
+
+def test_netcdf_whole_read_matches_python(tmp_path, split):
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    with NativeReader(path) as r:
+        assert r.variables["images"][0] == (64, 28, 28)
+        np.testing.assert_array_equal(r.read("images"), split.images)
+        np.testing.assert_array_equal(r.read("labels"), split.labels)
+
+
+def test_netcdf_row_gather_matches_python(tmp_path, split):
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    py = NetCDFReader(path)
+    # mixed order, duplicates, and a contiguous run to exercise coalescing
+    idx = [5, 6, 7, 8, 3, 3, 63, 0, 10, 11, 12]
+    with NativeReader(path) as r:
+        np.testing.assert_array_equal(r.read("images", idx),
+                                      py.read("images", idx))
+        np.testing.assert_array_equal(r.read("labels", idx),
+                                      py.read("labels", idx))
+
+
+def test_idx_files(tmp_path, split):
+    ipath = str(tmp_path / "imgs-idx3-ubyte")
+    lpath = str(tmp_path / "lbls-idx1-ubyte")
+    write_idx(ipath, split.images)
+    write_idx(lpath, split.labels)
+    with NativeReader(ipath) as r:
+        assert list(r.variables) == ["images"]
+        np.testing.assert_array_equal(r.read("images"), split.images)
+        np.testing.assert_array_equal(r.read("images", [2, 2, 50]),
+                                      split.images[[2, 2, 50]])
+    with NativeReader(lpath) as r:
+        np.testing.assert_array_equal(r.read("labels"), split.labels)
+
+
+def test_multibyte_dtype_byteswap(tmp_path):
+    rng = np.random.default_rng(0)
+    f32 = rng.normal(size=(10, 4)).astype(np.float32)
+    i64 = rng.integers(-1 << 40, 1 << 40, size=(4,)).astype(np.int64)
+    path = str(tmp_path / "t.nc")
+    write_netcdf(path, {"a": 10, "b": 4},
+                 {"f": (("a", "b"), f32), "q": (("b",), i64)})
+    with NativeReader(path) as r:
+        np.testing.assert_array_equal(r.read("f"), f32)
+        np.testing.assert_array_equal(r.read("f", [9, 0]), f32[[9, 0]])
+        np.testing.assert_array_equal(r.read("q"), i64)
+
+
+def test_large_sharded_gather_threads(tmp_path):
+    # >4 MiB across many runs triggers the thread pool path.
+    n, row = 4096, 2048
+    data = np.arange(n * row, dtype=np.uint8).reshape(n, row) % 251
+    path = str(tmp_path / "big.nc")
+    write_netcdf(path, {"n": n, "r": row}, {"d": (("n", "r"), data)})
+    idx = np.random.default_rng(1).permutation(n)[: n // 2 * 2]
+    with NativeReader(path) as r:
+        np.testing.assert_array_equal(r.read("d", idx), data[idx])
+
+
+def test_errors(tmp_path, split):
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    with NativeReader(path) as r:
+        with pytest.raises(KeyError):
+            r.read("nope")
+        with pytest.raises(IndexError):
+            r.read("images", [64])
+        with pytest.raises(IndexError):
+            r.read("images", [-1])
+    bad = str(tmp_path / "bad.bin")
+    with open(bad, "wb") as f:
+        f.write(b"\x12\x34\x56\x78" * 4)
+    with pytest.raises(ValueError, match="magic"):
+        NativeReader(bad)
+    with pytest.raises(ValueError, match="open"):
+        NativeReader(str(tmp_path / "missing.nc"))

@@ -1,0 +1,131 @@
+"""NetCDF CDF-5 subset: round trips, format bytes, converter CLI, and the
+reference schema (mnist_to_netcdf.ipynb: dims Y/X/idx, NC_UBYTE vars)."""
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.data.netcdf import (
+    NetCDFReader, write_netcdf, write_mnist_netcdf, read_mnist_netcdf,
+    NC_UBYTE)
+from pytorch_ddp_mnist_tpu.data import synthetic_mnist, write_idx
+from pytorch_ddp_mnist_tpu.data.convert import convert
+
+
+@pytest.fixture
+def mnist_nc(tmp_path):
+    split = synthetic_mnist(50, seed=0)
+    path = str(tmp_path / "mnist_train_images.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    return path, split
+
+
+def test_cdf5_magic_and_schema(mnist_nc):
+    path, split = mnist_nc
+    with open(path, "rb") as f:
+        assert f.read(4) == b"CDF\x05"  # 64BIT_DATA, as PnetCDF writes
+    r = NetCDFReader(path)
+    assert r.dimensions == {"Y": 28, "X": 28, "idx": 50}
+    assert r.variables["images"].shape == (50, 28, 28)
+    assert r.variables["images"].nc_type == NC_UBYTE
+    assert r.variables["labels"].shape == (50,)
+
+
+def test_round_trip_whole(mnist_nc):
+    path, split = mnist_nc
+    images, labels = read_mnist_netcdf(path)
+    np.testing.assert_array_equal(images, split.images)
+    np.testing.assert_array_equal(labels, split.labels)
+
+
+def test_row_gather_matches_independent_reads(mnist_nc):
+    """The per-sample access pattern of mnist_pnetcdf_cpu_mp.py:46 (each rank
+    reads only its sampler's indices)."""
+    path, split = mnist_nc
+    idx = [3, 47, 0, 11, 11]
+    images, labels = read_mnist_netcdf(path, idx)
+    np.testing.assert_array_equal(images, split.images[idx])
+    np.testing.assert_array_equal(labels, split.labels[idx])
+    with pytest.raises(IndexError):
+        read_mnist_netcdf(path, [50])
+
+
+@pytest.mark.parametrize("version", [1, 2, 5])
+def test_versions_and_dtypes(tmp_path, version):
+    path = str(tmp_path / f"v{version}.nc")
+    rng = np.random.default_rng(0)
+    f32 = rng.normal(size=(4, 6)).astype(np.float32)
+    i32 = rng.integers(-5, 5, size=(6,)).astype(np.int32)
+    write_netcdf(path, {"a": 4, "b": 6},
+                 {"f": (("a", "b"), f32), "i": (("b",), i32)},
+                 version=version)
+    with open(path, "rb") as fh:
+        assert fh.read(4) == b"CDF" + bytes([version])
+    r = NetCDFReader(path)
+    np.testing.assert_array_equal(r.read("f"), f32)
+    np.testing.assert_array_equal(r.read("i"), i32)
+
+
+def test_vsize_padding_odd_rows(tmp_path):
+    # labels of odd length exercise the 4-byte vsize pad between variables
+    path = str(tmp_path / "odd.nc")
+    lab = np.arange(7, dtype=np.uint8)
+    img = np.arange(7 * 3 * 3, dtype=np.uint8).reshape(7, 3, 3)
+    write_netcdf(path, {"Y": 3, "X": 3, "idx": 7},
+                 {"labels": (("idx",), lab),
+                  "images": (("idx", "Y", "X"), img)})
+    r = NetCDFReader(path)
+    np.testing.assert_array_equal(r.read("labels"), lab)
+    np.testing.assert_array_equal(r.read("images"), img)
+
+
+def test_converter_cli_from_idx(tmp_path):
+    split = synthetic_mnist(20, seed=2)
+    test_split = synthetic_mnist(8, seed=3)
+    write_idx(str(tmp_path / "train-images-idx3-ubyte"), split.images)
+    write_idx(str(tmp_path / "train-labels-idx1-ubyte"), split.labels)
+    write_idx(str(tmp_path / "t10k-images-idx3-ubyte"), test_split.images)
+    write_idx(str(tmp_path / "t10k-labels-idx1-ubyte"), test_split.labels)
+    out = convert(str(tmp_path), str(tmp_path / "nc"))
+    images, labels = read_mnist_netcdf(out[0])
+    np.testing.assert_array_equal(images, split.images)
+    np.testing.assert_array_equal(labels, split.labels)
+    images, labels = read_mnist_netcdf(out[1])
+    np.testing.assert_array_equal(images, test_split.images)
+
+
+def test_converter_cli_synthetic(tmp_path):
+    out = convert("unused", str(tmp_path), synthetic="30:10")
+    r = NetCDFReader(out[0])
+    assert r.dimensions["idx"] == 30
+    r = NetCDFReader(out[1])
+    assert r.dimensions["idx"] == 10
+
+
+def test_converter_missing_idx_errors(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no IDX files"):
+        convert(str(tmp_path), str(tmp_path))
+
+
+def test_netcdf_shard_loader_matches_in_memory(tmp_path):
+    """Disk-sharded batches must equal the in-memory BatchLoader's batches
+    for the same sampler state (same shard, same order, same transform)."""
+    from pytorch_ddp_mnist_tpu.data import BatchLoader, normalize_images
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+
+    split = synthetic_mnist(100, seed=7)
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+
+    s1 = ShardedSampler(100, num_replicas=4, rank=1, seed=42)
+    s2 = ShardedSampler(100, num_replicas=4, rank=1, seed=42)
+    s1.set_epoch(2)
+    s2.set_epoch(2)
+    mem = BatchLoader(normalize_images(split.images), split.labels, s1,
+                      batch_size=8)
+    disk = NetCDFShardLoader(path, s2, batch_size=8)
+    assert len(mem) == len(disk)
+    for (mx, my), (dx, dy) in zip(mem, disk):
+        np.testing.assert_allclose(mx, dx, rtol=1e-6)
+        np.testing.assert_array_equal(my, dy)
+        assert dy.dtype == np.int32
