@@ -75,13 +75,13 @@ def main(argv=None) -> int:
         test_images, test_labels = read_mnist_netcdf(test_nc)
         x_test = normalize_images(test_images)
         test_labels = test_labels.astype(np.int32)
-        from ..data.netcdf import NetCDFReader
-        n_train = NetCDFReader(train_nc).variables["images"].shape[0]
+        loader = NetCDFShardLoader(train_nc, batch_size=local_batch)
+        n_train = loader.num_samples  # one header parse; sampler bound below
         if dcfg["limit"] and dcfg["limit"] > 0:
             n_train = min(n_train, dcfg["limit"])
-        sampler = ShardedSampler(n_train, num_replicas=num_processes,
-                                 rank=process_index, shuffle=True, seed=42)
-        loader = NetCDFShardLoader(train_nc, sampler, batch_size=local_batch)
+        loader.sampler = ShardedSampler(n_train, num_replicas=num_processes,
+                                        rank=process_index, shuffle=True,
+                                        seed=42)
     else:
         train = get_mnist(dcfg["path"], train=True)
         test = get_mnist(dcfg["path"], train=False)

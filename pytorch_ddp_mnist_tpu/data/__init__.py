@@ -1,0 +1,17 @@
+"""L2 data pipeline (SURVEY.md §1): IDX + NetCDF parsing, MNIST loading with
+synthetic fallback, sharded batch loaders, device prefetch, and the native
+C++ reader core — the capabilities of the reference's torchvision path
+(ddp_tutorial_cpu.py:12-49) and PnetCDF/MPI-IO path
+(mnist_pnetcdf_cpu[_mp].py), re-designed for TPU hosts."""
+
+from .idx import read_idx, write_idx
+from .mnist import (MNIST_MEAN, MNIST_STD, Split, get_mnist, load_mnist,
+                    normalize_images, synthetic_mnist)
+from .loader import BatchLoader, NetCDFShardLoader, device_prefetch
+
+__all__ = [
+    "read_idx", "write_idx",
+    "MNIST_MEAN", "MNIST_STD", "Split", "get_mnist", "load_mnist",
+    "normalize_images", "synthetic_mnist",
+    "BatchLoader", "NetCDFShardLoader", "device_prefetch",
+]

@@ -1,0 +1,136 @@
+"""Batch loaders: in-memory, disk-sharded NetCDF, and device prefetch.
+
+Reference data plane: `DataLoader(dataset, batch_size, sampler)` feeding the
+train loop with `(x, y)` batches, `.to(device, non_blocking=True)` per batch
+(ddp_tutorial_multi_gpu.py:33-36,87-88); the PnetCDF variant reads each
+sample independently from the shared .nc file inside `__getitem__`
+(mnist_pnetcdf_cpu_mp.py:39-49).
+
+XLA-native reshaping:
+  * STATIC batch shapes — torch tolerates a short final batch; XLA would
+    recompile for it. The final partial batch is padded by wrapping to the
+    shard's head (the same repetition trick DistributedSampler itself uses to
+    pad the epoch, SURVEY.md §7 item 3), keeping one compiled program.
+  * labels are cast uint8 -> int32 at batch assembly (SURVEY.md §7 item 9:
+    the PnetCDF path yields uint8 0-d labels; CE targets need integers).
+  * `device_prefetch` overlaps the NEXT batch's host->device transfer with
+    the current step — the MpDeviceLoader / non_blocking=True analog: XLA
+    device_put is async, so putting batch k+1 before blocking on step k
+    double-buffers HBM transfers.
+  * `NetCDFShardLoader` gathers each batch's rows straight from the .nc file
+    (independent-I/O analog) through the native C++ core when available.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .mnist import normalize_images
+
+
+def _batched_indices(sampler, batch_size: int) -> Iterator[np.ndarray]:
+    """Split this rank's shard into fixed-size index batches, wrap-padding
+    the final one so every batch has the same (compiled-once) shape."""
+    shard = np.asarray(sampler.indices())
+    for start in range(0, shard.size, batch_size):
+        b = shard[start:start + batch_size]
+        if b.size < batch_size:
+            b = np.concatenate([b, np.resize(shard, batch_size - b.size)])
+        yield b
+
+
+class BatchLoader:
+    """In-memory loader: yields (x, y) batches for `sampler`'s shard.
+
+    `images` is the pre-normalized (n, 784) float32 array; `labels` any
+    integer array, cast to int32 per batch.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, sampler,
+                 batch_size: int):
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.asarray(labels)
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+
+    def __len__(self) -> int:
+        return math.ceil(len(self.sampler) / self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for b in _batched_indices(self.sampler, self.batch_size):
+            yield self.images[b], self.labels[b].astype(np.int32)
+
+
+class NetCDFShardLoader:
+    """Disk-sharded loader: each batch is a row gather from the shared .nc
+    file for THIS rank's sampler indices only — the PnetCDF independent-I/O
+    analog (mnist_pnetcdf_cpu_mp.py:32,46), minus MPI: plain sharded preads
+    via the native C++ core (pure-Python fallback when no toolchain).
+
+    Batches are bit-identical to BatchLoader over the same sampler state:
+    gather -> normalize is elementwise, so normalize(all)[idx] ==
+    normalize(gather(idx)).
+
+    `sampler` may be None at construction (so `num_samples` can be read to
+    size the sampler first) but must be assigned before iterating.
+    """
+
+    def __init__(self, path: str, sampler=None, *, batch_size: int):
+        self.path = path
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        from .native import NativeReader, native_available
+        if native_available():
+            self._reader = NativeReader(path)
+            self._read = self._reader.read
+        else:
+            from .netcdf import NetCDFReader
+            self._reader = NetCDFReader(path)
+            self._read = self._reader.read
+        shape = (self._reader.variables["images"][0]
+                 if isinstance(self._reader.variables["images"], tuple)
+                 else self._reader.variables["images"].shape)
+        self.num_samples = int(shape[0])
+
+    def __len__(self) -> int:
+        return math.ceil(len(self.sampler) / self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        for b in _batched_indices(self.sampler, self.batch_size):
+            images = self._read("images", b)
+            labels = self._read("labels", b)
+            yield normalize_images(images), labels.astype(np.int32)
+
+
+def device_prefetch(loader, sharding=None,
+                    put: Optional[Callable] = None):
+    """Iterate a loader with one batch of transfer lookahead.
+
+    `put` places a host batch on device(s) (e.g. the DP global-batch
+    assembler); `sharding` is a shorthand for jax.device_put with that
+    sharding; default is plain device_put. Dispatching batch k+1's transfer
+    before batch k's step is consumed lets XLA overlap PCIe/HBM copies with
+    compute — the reference gets the same overlap from
+    `non_blocking=True` + CUDA streams (ddp_tutorial_multi_gpu.py:87-88).
+    """
+    import jax
+
+    if put is None:
+        if sharding is not None:
+            def put(b):
+                return jax.device_put(b, sharding)
+        else:
+            def put(b):
+                return jax.tree_util.tree_map(jax.device_put, b)
+    it = iter(loader)
+    try:
+        pending = put(next(it))
+    except StopIteration:
+        return
+    for batch in it:
+        ready, pending = pending, put(batch)
+        yield ready
+    yield pending

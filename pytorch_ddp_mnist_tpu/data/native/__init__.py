@@ -1,0 +1,169 @@
+"""ctypes binding for the native C++ reader core (reader.cc).
+
+Builds `_reader.so` on demand with the system C++ toolchain (g++ by default,
+$CXX to override; `make` in this directory does the same build) and falls
+back cleanly when no toolchain is present: `native_available()` gates every
+use, `native_build_error()` reports why it is off, and the pure-Python
+parsers in data/netcdf.py + data/idx.py remain the behavioral source of
+truth (tests/test_native.py asserts byte equality between the two).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "reader.cc")
+_SO = os.path.join(_HERE, "_reader.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: Optional[str] = None
+_tried = False
+
+# nc_type -> native numpy dtype (the C core already swapped to host order)
+_NP_OF_NC = {1: "i1", 2: "S1", 3: "i2", 4: "i4", 5: "f4", 6: "f8",
+             7: "u1", 8: "u2", 9: "u4", 10: "i8", 11: "u8"}
+
+
+def _compile() -> None:
+    cxx = os.environ.get("CXX", "g++")
+    tmp = _SO + f".tmp.{os.getpid()}"
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{' '.join(cmd)} failed:\n{proc.stderr.strip()}")
+    os.replace(tmp, _SO)  # atomic under concurrent builders
+
+
+def _load():
+    global _lib, _build_error, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _compile()
+            lib = ctypes.CDLL(_SO)
+            lib.nr_open.restype = ctypes.c_void_p
+            lib.nr_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_int]
+            lib.nr_close.argtypes = [ctypes.c_void_p]
+            lib.nr_nvars.restype = ctypes.c_int
+            lib.nr_nvars.argtypes = [ctypes.c_void_p]
+            lib.nr_var_info.restype = ctypes.c_int
+            lib.nr_var_info.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+            lib.nr_read_rows.restype = ctypes.c_int
+            lib.nr_read_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+            _lib = lib
+        except Exception as e:  # toolchain missing, compile error, bad .so
+            _build_error = str(e)
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class NativeReader:
+    """One open file (IDX or NetCDF). Context manager; thread-safe reads
+    (the core uses pread on a shared fd).
+
+    `variables` maps name -> (shape tuple, nc_type). `read(name, idx)`
+    gathers leading-dim rows host-endian; `read(name)` reads the whole
+    variable (a single coalesced pread).
+    """
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native reader unavailable: {_build_error}")
+        self._lib = lib
+        err = ctypes.create_string_buffer(1024)
+        self._h = lib.nr_open(os.fsencode(path), err, len(err))
+        if not self._h:
+            raise ValueError(err.value.decode(errors="replace"))
+        self.path = path
+        self.variables: Dict[str, Tuple[Tuple[int, ...], int]] = {}
+        self._index: Dict[str, int] = {}
+        shape = (ctypes.c_longlong * 16)()
+        ndims = ctypes.c_int()
+        nc_type = ctypes.c_int()
+        name = ctypes.create_string_buffer(256)
+        for i in range(lib.nr_nvars(self._h)):
+            if lib.nr_var_info(self._h, i, name, len(name), shape, 16,
+                               ctypes.byref(ndims), ctypes.byref(nc_type)):
+                raise RuntimeError(f"{path}: nr_var_info({i}) failed")
+            nm = name.value.decode()
+            self.variables[nm] = (
+                tuple(int(shape[d]) for d in range(ndims.value)),
+                int(nc_type.value))
+            self._index[nm] = i
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nr_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def read(self, name: str,
+             indices: Optional[Sequence[int]] = None) -> np.ndarray:
+        if self._h is None:
+            raise ValueError(f"{self.path}: reader is closed")
+        shape, nc_type = self.variables[name]  # KeyError on unknown name
+        if indices is None:
+            idx = np.arange(shape[0] if shape else 1, dtype=np.int64)
+            out_shape = shape
+        else:
+            idx = np.ascontiguousarray(indices, np.int64)
+            if not shape:
+                raise IndexError(f"variable {name!r} is a scalar")
+            if idx.size and (idx.min() < 0 or idx.max() >= shape[0]):
+                raise IndexError(
+                    f"indices out of range [0, {shape[0]}) for {name!r}")
+            out_shape = (idx.size,) + shape[1:]
+        out = np.empty(out_shape, dtype=_NP_OF_NC[nc_type])
+        if out.size == 0:
+            return out
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.nr_read_rows(
+            self._h, self._index[name],
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            len(idx), out.ctypes.data_as(ctypes.c_void_p), err, len(err))
+        if rc != 0:
+            raise IOError(
+                f"{self.path}: {err.value.decode(errors='replace')}")
+        return out

@@ -1,0 +1,382 @@
+// Native reader core: IDX + NetCDF classic/CDF-5 header parse and sharded
+// row gathers. This is the framework's analog of the reference's native
+// PnetCDF C library (SURVEY.md §2.12): where each reference rank issues
+// independent MPI-IO reads for its sampler's indices
+// (mnist_pnetcdf_cpu_mp.py:32,46), here each host process gathers its rows
+// with plain pread(2) — contiguous index runs are coalesced into single
+// reads, large gathers fan out over a thread pool, and multi-byte types are
+// byte-swapped from the format's big-endian to host order.
+//
+// The grammar matches data/netcdf.py (the format source of truth, tested
+// against it): CDF-5 widens every NON_NEG size field to INT64; offsets are
+// 32-bit only in CDF-1. Record (unlimited) dimensions are not supported.
+//
+// C ABI only (consumed via ctypes): nr_open / nr_close / nr_nvars /
+// nr_var_info / nr_read_rows.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kMaxHeader = 1 << 20;  // converter headers are ~100s of bytes
+
+struct Var {
+  std::string name;
+  std::vector<long long> shape;
+  int nc_type = 0;      // netcdf type ids; IDX dtypes are mapped onto them
+  int itemsize = 0;
+  long long begin = 0;
+  long long row_bytes = 0;  // itemsize * prod(shape[1:])
+};
+
+struct File {
+  int fd = -1;
+  std::vector<Var> vars;
+  ~File() {
+    if (fd >= 0) close(fd);
+  }
+};
+
+void set_err(char* err, int cap, const std::string& msg) {
+  if (err && cap > 0) {
+    std::snprintf(err, static_cast<size_t>(cap), "%s", msg.c_str());
+  }
+}
+
+int nc_itemsize(int nc_type) {
+  switch (nc_type) {
+    case 1: case 2: case 7: return 1;   // byte, char, ubyte
+    case 3: case 8: return 2;           // short, ushort
+    case 4: case 5: case 9: return 4;   // int, float, uint
+    case 6: case 10: case 11: return 8; // double, int64, uint64
+    default: return 0;
+  }
+}
+
+// Big-endian cursor over the in-memory header buffer.
+struct Cur {
+  const unsigned char* p;
+  size_t len, pos = 0;
+  bool ok = true;
+
+  uint64_t be(int n) {
+    if (!ok || pos + n > len) {
+      ok = false;
+      return 0;
+    }
+    uint64_t v = 0;
+    for (int i = 0; i < n; i++) v = (v << 8) | p[pos + i];
+    pos += n;
+    return v;
+  }
+
+  std::string name(int W) {
+    uint64_t n = be(W);
+    if (!ok || pos + n > len) {
+      ok = false;
+      return "";
+    }
+    std::string s(reinterpret_cast<const char*>(p) + pos, n);
+    pos += (n + 3) & ~3ULL;  // namestring is padded to a 4-byte boundary
+    if (pos > len) ok = false;
+    return s;
+  }
+
+  void skip_atts(int W) {
+    uint64_t tag = be(4), n = be(W);
+    if (tag == 0 && n == 0) return;
+    if (tag != 0x0C) {
+      ok = false;
+      return;
+    }
+    for (uint64_t i = 0; i < n && ok; i++) {
+      name(W);
+      uint64_t t = be(4), ne = be(W);
+      uint64_t bytes = ne * static_cast<uint64_t>(nc_itemsize(t));
+      pos += (bytes + 3) & ~3ULL;
+      if (pos > len) ok = false;
+    }
+  }
+};
+
+long long prod_tail(const std::vector<long long>& shape) {
+  long long p = 1;
+  for (size_t i = 1; i < shape.size(); i++) p *= shape[i];
+  return p;
+}
+
+bool parse_netcdf(File* f, const unsigned char* h, size_t hlen, int ver,
+                  std::string* err) {
+  const int W = ver == 5 ? 8 : 4;
+  const int OFF = ver == 1 ? 4 : 8;
+  Cur c{h, hlen, 4};
+  c.be(W);  // numrecs (no record vars in the converter schema)
+
+  std::vector<long long> dimlen;
+  uint64_t tag = c.be(4), n = c.be(W);
+  if (tag == 0x0A) {
+    for (uint64_t i = 0; i < n && c.ok; i++) {
+      c.name(W);
+      dimlen.push_back(static_cast<long long>(c.be(W)));
+    }
+  } else if (tag != 0 || n != 0) {
+    *err = "header: bad dim_list tag";
+    return false;
+  }
+  c.skip_atts(W);
+
+  tag = c.be(4);
+  n = c.be(W);
+  if (tag == 0x0B) {
+    for (uint64_t i = 0; i < n && c.ok; i++) {
+      Var v;
+      v.name = c.name(W);
+      uint64_t nd = c.be(W);
+      for (uint64_t d = 0; d < nd && c.ok; d++) {
+        uint64_t id = c.be(W);
+        if (id >= dimlen.size()) {
+          *err = "header: dimid out of range";
+          return false;
+        }
+        v.shape.push_back(dimlen[id]);
+      }
+      c.skip_atts(W);
+      v.nc_type = static_cast<int>(c.be(4));
+      v.itemsize = nc_itemsize(v.nc_type);
+      c.be(W);  // vsize (recomputed from the shape)
+      v.begin = static_cast<long long>(c.be(OFF));
+      if (v.itemsize == 0) {
+        *err = "header: unsupported nc_type";
+        return false;
+      }
+      v.row_bytes = v.itemsize * (v.shape.empty() ? 1 : prod_tail(v.shape));
+      f->vars.push_back(std::move(v));
+    }
+  } else if (tag != 0 || n != 0) {
+    *err = "header: bad var_list tag";
+    return false;
+  }
+  if (!c.ok) {
+    *err = "header: truncated or malformed";
+    return false;
+  }
+  return true;
+}
+
+bool parse_idx(File* f, const unsigned char* h, size_t hlen,
+               std::string* err) {
+  // IDX dtype code -> (nc_type, itemsize)
+  int nc_type;
+  switch (h[2]) {
+    case 0x08: nc_type = 7; break;   // ubyte
+    case 0x09: nc_type = 1; break;   // byte
+    case 0x0B: nc_type = 3; break;   // short
+    case 0x0C: nc_type = 4; break;   // int
+    case 0x0D: nc_type = 5; break;   // float
+    case 0x0E: nc_type = 6; break;   // double
+    default:
+      *err = "magic: bad IDX dtype code";
+      return false;
+  }
+  int nd = h[3];
+  if (nd == 0 || hlen < 4 + 4 * static_cast<size_t>(nd)) {
+    *err = "magic: bad IDX dimension count";
+    return false;
+  }
+  Var v;
+  v.name = nd >= 2 ? "images" : "labels";
+  v.nc_type = nc_type;
+  v.itemsize = nc_itemsize(nc_type);
+  for (int d = 0; d < nd; d++) {
+    uint64_t s = 0;
+    for (int b = 0; b < 4; b++) s = (s << 8) | h[4 + 4 * d + b];
+    v.shape.push_back(static_cast<long long>(s));
+  }
+  v.begin = 4 + 4 * nd;
+  v.row_bytes = v.itemsize * prod_tail(v.shape);
+  f->vars.push_back(std::move(v));
+  return true;
+}
+
+bool pread_full(int fd, char* dst, long long bytes, long long off) {
+  while (bytes > 0) {
+    ssize_t r = pread(fd, dst, static_cast<size_t>(bytes), off);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;  // unexpected EOF
+    dst += r;
+    off += r;
+    bytes -= r;
+  }
+  return true;
+}
+
+void bswap_buf(unsigned char* p, long long bytes, int itemsize) {
+  long long n = bytes / itemsize;
+  switch (itemsize) {
+    case 2: {
+      uint16_t* q = reinterpret_cast<uint16_t*>(p);
+      for (long long i = 0; i < n; i++) q[i] = __builtin_bswap16(q[i]);
+      break;
+    }
+    case 4: {
+      uint32_t* q = reinterpret_cast<uint32_t*>(p);
+      for (long long i = 0; i < n; i++) q[i] = __builtin_bswap32(q[i]);
+      break;
+    }
+    case 8: {
+      uint64_t* q = reinterpret_cast<uint64_t*>(p);
+      for (long long i = 0; i < n; i++) q[i] = __builtin_bswap64(q[i]);
+      break;
+    }
+    default: break;
+  }
+}
+
+struct Run {
+  long long file_off, out_off, bytes;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* nr_open(const char* path, char* err, int err_cap) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) {
+    set_err(err, err_cap,
+            std::string("open: ") + path + ": " + std::strerror(errno));
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 4) {
+    close(fd);
+    set_err(err, err_cap, std::string("magic: ") + path + ": too short");
+    return nullptr;
+  }
+  size_t hlen = static_cast<size_t>(
+      st.st_size < kMaxHeader ? st.st_size : kMaxHeader);
+  std::vector<unsigned char> head(hlen);
+  if (!pread_full(fd, reinterpret_cast<char*>(head.data()),
+                  static_cast<long long>(hlen), 0)) {
+    close(fd);
+    set_err(err, err_cap, std::string("open: ") + path + ": header read failed");
+    return nullptr;
+  }
+
+  auto* f = new File;
+  f->fd = fd;
+  std::string msg;
+  bool ok;
+  if (hlen >= 4 && head[0] == 'C' && head[1] == 'D' && head[2] == 'F' &&
+      (head[3] == 1 || head[3] == 2 || head[3] == 5)) {
+    ok = parse_netcdf(f, head.data(), hlen, head[3], &msg);
+  } else if (head[0] == 0 && head[1] == 0) {
+    ok = parse_idx(f, head.data(), hlen, &msg);
+  } else {
+    ok = false;
+    msg = "magic: not a NetCDF classic or IDX file";
+  }
+  if (!ok) {
+    set_err(err, err_cap, std::string(path) + ": " + msg);
+    delete f;
+    return nullptr;
+  }
+  return f;
+}
+
+void nr_close(void* h) { delete static_cast<File*>(h); }
+
+int nr_nvars(void* h) {
+  return static_cast<int>(static_cast<File*>(h)->vars.size());
+}
+
+// Fills name (NUL-terminated, truncated to name_cap), shape (up to
+// shape_cap dims), *ndims, *nc_type for variable i. Returns 0 on success.
+int nr_var_info(void* h, int i, char* name, int name_cap, long long* shape,
+                int shape_cap, int* ndims, int* nc_type) {
+  File* f = static_cast<File*>(h);
+  if (i < 0 || i >= static_cast<int>(f->vars.size())) return -1;
+  const Var& v = f->vars[i];
+  std::snprintf(name, static_cast<size_t>(name_cap), "%s", v.name.c_str());
+  *ndims = static_cast<int>(v.shape.size());
+  *nc_type = v.nc_type;
+  for (int d = 0; d < *ndims && d < shape_cap; d++) shape[d] = v.shape[d];
+  return 0;
+}
+
+// Gather `n` leading-dim rows of variable `vi` (indices pre-validated by the
+// caller) into `out`, host-endian. Returns 0 on success, -1 with `err` set.
+int nr_read_rows(void* h, int vi, const long long* idx, long long n,
+                 void* out, char* err, int err_cap) {
+  File* f = static_cast<File*>(h);
+  if (vi < 0 || vi >= static_cast<int>(f->vars.size())) {
+    set_err(err, err_cap, "read: bad variable index");
+    return -1;
+  }
+  const Var& v = f->vars[vi];
+
+  // Coalesce consecutive indices into single contiguous preads (a shuffled
+  // epoch still yields many short runs; a whole-variable read becomes one).
+  std::vector<Run> runs;
+  long long k = 0;
+  while (k < n) {
+    long long j = k + 1;
+    while (j < n && idx[j] == idx[j - 1] + 1) j++;
+    runs.push_back({v.begin + idx[k] * v.row_bytes, k * v.row_bytes,
+                    (j - k) * v.row_bytes});
+    k = j;
+  }
+
+  char* dst = static_cast<char*>(out);
+  const long long total = n * v.row_bytes;
+  std::vector<char> failed(1, 0);
+
+  auto do_range = [&](size_t a, size_t b) {
+    for (size_t r = a; r < b; r++) {
+      if (!pread_full(f->fd, dst + runs[r].out_off, runs[r].bytes,
+                      runs[r].file_off)) {
+        failed[0] = 1;
+        return;
+      }
+    }
+  };
+
+  constexpr long long kThreadThreshold = 4LL << 20;  // 4 MiB
+  if (total > kThreadThreshold && runs.size() > 1) {
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t nt = std::min<size_t>(hw ? hw : 4, runs.size());
+    nt = std::min<size_t>(nt, 16);
+    std::vector<std::thread> pool;
+    size_t per = (runs.size() + nt - 1) / nt;
+    for (size_t t = 0; t < nt; t++) {
+      size_t a = t * per, b = std::min(runs.size(), a + per);
+      if (a >= b) break;
+      pool.emplace_back(do_range, a, b);
+    }
+    for (auto& th : pool) th.join();
+  } else {
+    do_range(0, runs.size());
+  }
+  if (failed[0]) {
+    set_err(err, err_cap, "read: pread failed or short");
+    return -1;
+  }
+  if (v.itemsize > 1) {
+    bswap_buf(static_cast<unsigned char*>(out), total, v.itemsize);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -91,6 +91,7 @@ def test_converter_cli_from_idx(tmp_path):
     np.testing.assert_array_equal(labels, split.labels)
     images, labels = read_mnist_netcdf(out[1])
     np.testing.assert_array_equal(images, test_split.images)
+    np.testing.assert_array_equal(labels, test_split.labels)
 
 
 def test_converter_cli_synthetic(tmp_path):
