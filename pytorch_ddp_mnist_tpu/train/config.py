@@ -58,6 +58,10 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         " the reference has no load path)")
     t.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32",
                    help="compute dtype for the train step")
+    t.add_argument("--cached", action="store_true",
+                   help="cache the dataset in HBM and run each epoch as one "
+                        "jitted lax.scan program (fastest path for datasets "
+                        "that fit on device; single-process runs only)")
     d = p.add_argument_group("data")
     d.add_argument("--path", type=str, default="data/",
                    help="dataset root (IDX or NetCDF files)")
@@ -77,7 +81,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "seed": a.seed, "parallel": a.parallel,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
-            "dtype": a.dtype,
+            "dtype": a.dtype, "cached": a.cached,
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,

@@ -1,0 +1,212 @@
+"""Epoch-jitted training: one `lax.scan` program per epoch.
+
+The reference's hot loop dispatches one optimizer step per Python iteration
+(ddp_tutorial_multi_gpu.py:86-98) — on GPU that cost hides behind CUDA
+streams; under XLA each dispatch is host work on the critical path, and for
+this 118k-param MLP the step is latency-bound, so dispatch dominates. The
+TPU-native restructuring: keep the (tiny) dataset resident in HBM, compute
+the epoch's batch INDICES on host (preserving ShardedSampler's exact
+DistributedSampler semantics — host numpy stays the permutation source of
+truth), and run the entire epoch as ONE jitted `lax.scan` whose body gathers
+the batch on device and applies the fused fwd/bwd/SGD step. Python touches
+the device once per epoch instead of once per step.
+
+Semantics are bit-compatible with the streaming loop (train/loop.py): the
+same per-step `jax.random.split` chain drives dropout, the same wrap-padded
+static batches come out of the same sampler indices, and per-step mean
+losses are accumulated identically — `fit_cached` therefore prints the same
+reference-format epoch line. The DP variant runs the scan inside
+`shard_map`: batch indices are sharded over 'dp' (each device gathers only
+its replica's rows from the replicated dataset — no collective), gradients
+are `pmean`ed per step exactly like the streaming DP step.
+
+Scale note: this mode replicates the dataset in HBM (MNIST: 188 MB fp32),
+the right call at the reference's scale; the streaming loaders remain the
+path for datasets that don't fit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.mlp import mlp_apply
+from ..ops.loss import cross_entropy
+from ..ops.sgd import sgd_step
+from ..parallel.ddp import _pvary
+from ..parallel.mesh import DATA_AXIS
+from .loop import TrainState, make_eval_step, evaluate
+
+
+def epoch_batch_indices(sampler, batch_size: int) -> np.ndarray:
+    """(nbatches, batch_size) int32 — this rank's epoch as static-shape
+    batches, wrap-padding the final one (same math as the loaders)."""
+    from ..data.loader import _batched_indices
+    return np.stack(list(_batched_indices(sampler, batch_size))).astype(np.int32)
+
+
+def make_epoch_fn(lr: float, *, dtype: str = "float32") -> Callable:
+    """Serial epoch program: (params, key, x_all, y_all, idx) ->
+    (params', key', losses) with idx (nbatches, B)."""
+    compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def body(carry, batch_idx, x_all, y_all):
+        params, key = carry
+        key, sub = jax.random.split(key)
+        x = jnp.take(x_all, batch_idx, axis=0).astype(compute_dt)
+        y = jnp.take(y_all, batch_idx, axis=0)
+
+        def loss_fn(p):
+            return cross_entropy(mlp_apply(p, x, train=True, dropout_key=sub), y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return (sgd_step(params, grads, lr), key), loss
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def epoch(params, key, x_all, y_all, idx):
+        (params, key), losses = jax.lax.scan(
+            partial(body, x_all=x_all, y_all=y_all), (params, key), idx)
+        return params, key, losses
+
+    return epoch
+
+
+def _dp_step_body(x_all, y_all, me, lr, compute_dt):
+    """The shared per-step scan body of the DP programs: gather this
+    replica's rows, fwd/bwd with a replica-distinct dropout key, pmean grads
+    (the DDP allreduce), SGD."""
+
+    def body(carry, batch_idx):
+        params, key = carry
+        key, sub = jax.random.split(key)
+        rkey = jax.random.fold_in(sub, me)
+        x = jnp.take(x_all, batch_idx, axis=0).astype(compute_dt)
+        y = jnp.take(y_all, batch_idx, axis=0)
+
+        def loss_fn(p):
+            return cross_entropy(
+                mlp_apply(p, x, train=True, dropout_key=rkey), y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        return (sgd_step(params, grads, lr), key), loss
+
+    return body
+
+
+def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable:
+    """SPMD epoch program over the 'dp' mesh.
+
+    x_all/y_all replicated (each device holds the dataset and gathers its own
+    rows — no data-movement collective); idx (nbatches, global_B) sharded on
+    dim 1 over 'dp'; per-step grads pmean'ed exactly like
+    parallel.ddp.make_dp_train_step. Dropout keys fold in the replica index
+    (independent masks per replica, SURVEY.md §7 item 4).
+
+    One epoch is the one-element case of the fused multi-epoch program
+    (tests prove the equivalence), so this just wraps make_dp_run_fn.
+    """
+    run = make_dp_run_fn(mesh, lr, dtype=dtype)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def epoch(params, key, x_all, y_all, idx):
+        params, key, losses = run(params, key, x_all, y_all, idx[None])
+        return params, key, losses[0]
+
+    return epoch
+
+
+def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable:
+    """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
+    (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
+    sharded on the batch dim.
+
+    A nested lax.scan (epochs over steps) turns an E-epoch training run into
+    ONE device program — zero host round-trips inside, which is what a
+    remote/tunneled TPU needs (a per-epoch sync costs a full RTT) and what
+    lets XLA keep the whole run in its pipeline. Epoch reshuffles stay exact:
+    the host precomputes each epoch's sampler indices into idxs.
+    """
+    compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def shard_fn(params, key, x_all, y_all, idxs):
+        params = _pvary(params, DATA_AXIS)
+        me = jax.lax.axis_index(DATA_AXIS)
+        body = _dp_step_body(x_all, y_all, me, lr, compute_dt)
+
+        def epoch(carry, idx_e):
+            return jax.lax.scan(body, carry, idx_e)
+
+        (params, key), losses = jax.lax.scan(epoch, (params, key), idxs)
+        params = jax.tree_util.tree_map(
+            lambda a: jax.lax.pmean(a, DATA_AXIS), params)
+        return params, key, losses
+
+    sharded = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(None, None, DATA_AXIS)),
+        out_specs=(P(), P(), P()))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run(params, key, x_all, y_all, idxs):
+        return sharded(params, key, x_all, y_all, idxs)
+
+    return run
+
+
+def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
+               epochs: int, batch_size: int, lr: float,
+               mesh: Optional[Mesh] = None, dtype: str = "float32",
+               log: Callable[[str], None] = print,
+               epoch_hook: Callable | None = None) -> TrainState:
+    """The `fit` loop with the dataset cached in HBM and epochs scanned.
+
+    `batch_size` is the GLOBAL batch (sampler shards rows per process; with a
+    mesh the index array is device-sharded on the batch dim). Prints the same
+    reference-format epoch line as `fit`.
+    """
+    import time
+
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        x_all = jax.device_put(np.asarray(x_train, np.float32), rep)
+        y_all = jax.device_put(np.asarray(y_train, np.int32), rep)
+        epoch_fn = make_dp_epoch_fn(mesh, lr, dtype=dtype)
+        idx_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+    else:
+        x_all = jax.device_put(np.asarray(x_train, np.float32))
+        y_all = jax.device_put(np.asarray(y_train, np.int32))
+        epoch_fn = make_epoch_fn(lr)
+        idx_sharding = None
+
+    eval_step = make_eval_step()
+    params, key = state.params, state.key
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        sampler.set_epoch(epoch)
+        idx = epoch_batch_indices(sampler, batch_size)
+        if idx_sharding is not None:
+            idx = jax.device_put(idx, idx_sharding)
+        params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
+        losses = np.asarray(losses)                 # one host fetch per epoch
+        train_loss_ref_unit = float((losses / batch_size).sum())
+        train_mean = float(losses.mean())
+        val_ref_unit, val_mean, val_acc = evaluate(
+            eval_step, params, x_test, y_test, batch_size)
+        dt = time.perf_counter() - t0
+        imgs = losses.size * batch_size
+        log(f"Epoch={epoch}, train_loss={train_loss_ref_unit}, "
+            f"val_loss={val_ref_unit}"
+            f"  [mean_train={train_mean:.4f} mean_val={val_mean:.4f} "
+            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s]")
+        state = TrainState(params, key)
+        if epoch_hook is not None:
+            epoch_hook(epoch, state)
+    return state
