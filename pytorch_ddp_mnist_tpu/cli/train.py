@@ -31,6 +31,23 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
+    use_pallas = tcfg["kernel"] == "pallas"
+    if use_pallas:
+        if tcfg["cached"]:
+            raise SystemExit("--kernel pallas drives the streaming loop; "
+                             "--cached is the XLA scan path — drop one")
+        if tcfg["dtype"] != "float32":
+            raise SystemExit("--kernel pallas computes in float32 "
+                             "(MXU accumulation); drop --dtype bfloat16")
+
+    def _pallas_interpret() -> bool:
+        # The kernel needs Mosaic (TPU — incl. the axon plugin, which
+        # aliases the tpu lowering rules); on CPU backends fall back to the
+        # Pallas interpreter so the same CLI runs everywhere. Must only be
+        # called AFTER wireup: the backend query initializes JAX, and
+        # jax.distributed.initialize must come first in multi-process runs.
+        return jax.default_backend() not in ("tpu", "axon")
+
     process_index, num_processes = 0, 1
     train_step = None
     put = None
@@ -42,11 +59,21 @@ def main(argv=None) -> int:
         runtime = initialize_runtime(tcfg["wireup_method"])
         process_index, num_processes = jax.process_index(), jax.process_count()
         mesh = dp_mesh()  # global: all devices of all processes
-        train_step = make_dp_train_step(mesh, tcfg["lr"], dtype=tcfg["dtype"])
+        if use_pallas:
+            from ..ops.pallas_step import make_pallas_dp_train_step
+            train_step = make_pallas_dp_train_step(
+                mesh, tcfg["lr"], interpret=_pallas_interpret())
+        else:
+            train_step = make_dp_train_step(mesh, tcfg["lr"],
+                                            dtype=tcfg["dtype"])
         put = lambda b: global_batch_from_local(mesh, b)  # noqa: E731
         num_shards = mesh.devices.size  # data sharding is per-device
         local_shards = len(jax.local_devices())
     else:
+        if use_pallas:
+            from ..ops.pallas_step import make_pallas_train_step
+            train_step = make_pallas_train_step(
+                tcfg["lr"], interpret=_pallas_interpret())
         num_shards = local_shards = 1
 
     global_batch = tcfg["batch_size"] * num_shards
@@ -118,7 +145,9 @@ def main(argv=None) -> int:
     if process_index == 0 and tcfg["checkpoint"]:
         hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
 
-    log = print if process_index == 0 else (lambda s: None)
+    from ..utils.logging import rank_zero_log
+    from ..utils.profiling import trace
+    log = rank_zero_log(print)
     if tcfg["cached"]:
         # Epoch-scanned fast path: dataset resident in HBM, one jitted
         # lax.scan program per epoch (train/scan.py).
@@ -138,16 +167,20 @@ def main(argv=None) -> int:
             y_train = labels.astype(np.int32)
         else:
             y_train = train.labels.astype(np.int32)
-        state = fit_cached(state, x_train, y_train, sampler, x_test,
-                           test_labels, epochs=tcfg["n_epochs"],
-                           batch_size=global_batch, lr=tcfg["lr"], mesh=mesh,
-                           dtype=tcfg["dtype"], log=log, epoch_hook=hook)
+        with trace(tcfg["profile"]):
+            state = fit_cached(state, x_train, y_train, sampler, x_test,
+                               test_labels, epochs=tcfg["n_epochs"],
+                               batch_size=global_batch, lr=tcfg["lr"],
+                               mesh=mesh, dtype=tcfg["dtype"], log=log,
+                               epoch_hook=hook)
     else:
-        state = fit(state, loader, x_test, test_labels,
-                    epochs=tcfg["n_epochs"],
-                    batch_size=global_batch,
-                    **({"lr": tcfg["lr"]} if train_step is None else {}),
-                    log=log, train_step=train_step, put=put, epoch_hook=hook)
+        with trace(tcfg["profile"]):
+            state = fit(state, loader, x_test, test_labels,
+                        epochs=tcfg["n_epochs"],
+                        batch_size=global_batch,
+                        **({"lr": tcfg["lr"]} if train_step is None else {}),
+                        log=log, train_step=train_step, put=put,
+                        epoch_hook=hook)
 
     if process_index == 0 and tcfg["checkpoint"]:
         save_checkpoint(tcfg["checkpoint"], state.params)

@@ -1,0 +1,213 @@
+"""Fused train-step Pallas kernel: the whole fwd+bwd in ONE TPU kernel.
+
+The hot op of this framework is the MLP training step
+(flatten -> fc1+ReLU+dropout -> fc2+ReLU -> fc3 -> CE loss -> backward ->
+grads; reference semantics at ddp_tutorial_multi_gpu.py:90-95). The model is
+118k params — every weight, the per-chip batch, and all activations fit in
+one core's VMEM with room to spare, so the XLA-kernel-per-op model (HBM round
+trips between fusions) is pure overhead. This kernel keeps the entire
+fwd+bwd dataflow resident in VMEM: six MXU matmuls (three forward, three
+gradient) plus all elementwise work in a single `pallas_call`.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+  * The class dimension (10) is zero-padded to one full 128 lane tile
+    (`PADDED_CLASSES`); padded logit columns are masked to -1e30 before the
+    softmax, so their probability — and therefore their gradient — is
+    exactly 0 and fc3's padded weight columns stay zero through SGD.
+  * The dropout mask arrives PRE-SCALED (0 or 1/keep) as a kernel input
+    rather than being drawn in-kernel from pltpu.prng_random_bits: the mask
+    then comes from the same jax.random.bernoulli stream as the reference
+    path (models/mlp.py), making the fused step bitwise-matched in RNG to
+    the unfused one (tested), and the kernel stays deterministic and
+    CPU-interpretable. An all-ones mask gives the eval/no-dropout step.
+  * Gradients are returned (not applied): the serial wrapper fuses the SGD
+    update in the surrounding jit; the DP wrapper `pmean`s them across the
+    mesh first — the same split as parallel/ddp.py, so the kernel slots
+    into both without an in-kernel collective.
+  * All matmuls accumulate in float32 on the MXU via preferred_element_type
+    (bfloat16 inputs welcome; master weights stay f32 in the wrapper).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.mlp import MLP_DIMS, DROPOUT_RATE
+
+IN_DIM, HIDDEN1, HIDDEN2, NUM_CLASSES = MLP_DIMS
+PADDED_CLASSES = 128  # one full lane tile
+_NEG_INF = -1e30
+
+
+def _fused_kernel(x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                  w3_ref, loss_ref, gw1_ref, gb1_ref, gw2_ref, gb2_ref,
+                  gw3_ref):
+    """One batch, whole fwd+bwd. Shapes (B = batch):
+    x (B,784) f32 · y (B,1) i32 · m (B,128) f32 pre-scaled dropout mask ·
+    w1 (784,128) · b1 (1,128) · w2 (128,128) · b2 (1,128) ·
+    w3 (128,PADDED_CLASSES) zero-padded past column NUM_CLASSES.
+    Outputs: loss (1,1) · grads matching each weight input's shape.
+    """
+    f32 = jnp.float32
+    x = x_ref[:]
+    batch = x.shape[0]
+    m = m_ref[:]
+
+    # ---- forward ----
+    z1 = jax.lax.dot_general(x, w1_ref[:], (((1,), (0,)), ((), ())),
+                             preferred_element_type=f32) + b1_ref[:]
+    h1 = jnp.maximum(z1, 0.0)
+    d1 = h1 * m                                    # inverted dropout
+    z2 = jax.lax.dot_general(d1, w2_ref[:], (((1,), (0,)), ((), ())),
+                             preferred_element_type=f32) + b2_ref[:]
+    h2 = jnp.maximum(z2, 0.0)
+    logits = jax.lax.dot_general(h2, w3_ref[:], (((1,), (0,)), ((), ())),
+                                 preferred_element_type=f32)
+
+    cols = jax.lax.broadcasted_iota(jnp.int32, (batch, PADDED_CLASSES), 1)
+    logits = jnp.where(cols < NUM_CLASSES, logits, _NEG_INF)
+
+    # ---- softmax CE (stable); padded cols contribute exp(-1e30 - mx) = 0 ----
+    mx = jnp.max(logits, axis=1, keepdims=True)
+    ex = jnp.exp(logits - mx)
+    se = jnp.sum(ex, axis=1, keepdims=True)
+    onehot = (cols == y_ref[:]).astype(f32)
+    logit_y = jnp.sum(jnp.where(onehot > 0, logits, 0.0), axis=1,
+                      keepdims=True)
+    losses = (mx + jnp.log(se)) - logit_y          # -log p[y], (B,1)
+    loss_ref[0, 0] = jnp.sum(losses) / batch
+
+    # ---- backward ----
+    dlogits = (ex / se - onehot) * (1.0 / batch)   # (B,128); 0 on padded cols
+    # gw3 = h2^T @ dlogits (contract batch)
+    gw3_ref[:] = jax.lax.dot_general(h2, dlogits, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=f32)
+    # dh2 = dlogits @ w3^T (contract class)
+    dh2 = jax.lax.dot_general(dlogits, w3_ref[:], (((1,), (1,)), ((), ())),
+                              preferred_element_type=f32)
+    dz2 = dh2 * (z2 > 0.0).astype(f32)
+    gw2_ref[:] = jax.lax.dot_general(d1, dz2, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=f32)
+    gb2_ref[:] = jnp.sum(dz2, axis=0, keepdims=True)
+    dd1 = jax.lax.dot_general(dz2, w2_ref[:], (((1,), (1,)), ((), ())),
+                              preferred_element_type=f32)
+    dz1 = (dd1 * m) * (z1 > 0.0).astype(f32)
+    gw1_ref[:] = jax.lax.dot_general(x, dz1, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=f32)
+    gb1_ref[:] = jnp.sum(dz1, axis=0, keepdims=True)
+
+
+def pad_fc3(w3: jax.Array) -> jax.Array:
+    """(128, 10) -> (128, PADDED_CLASSES), zero-filled."""
+    return jnp.pad(w3, ((0, 0), (0, PADDED_CLASSES - w3.shape[1])))
+
+
+def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
+    """Run the kernel: (params pytree, x (B,784), y (B,) int, scaled_mask
+    (B,128) in {0, 1/keep}) -> (mean_loss, grads pytree).
+
+    `interpret=True` runs the Pallas interpreter (CPU tests)."""
+    batch = x.shape[0]
+    f32 = jnp.float32
+    vmem = partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, 1), f32),                       # loss
+        jax.ShapeDtypeStruct((IN_DIM, HIDDEN1), f32),            # gw1
+        jax.ShapeDtypeStruct((1, HIDDEN1), f32),                 # gb1
+        jax.ShapeDtypeStruct((HIDDEN1, HIDDEN2), f32),           # gw2
+        jax.ShapeDtypeStruct((1, HIDDEN2), f32),                 # gb2
+        jax.ShapeDtypeStruct((HIDDEN2, PADDED_CLASSES), f32),    # gw3 (padded)
+    )
+    loss, gw1, gb1, gw2, gb2, gw3 = pl.pallas_call(
+        _fused_kernel,
+        out_shape=out_shapes,
+        in_specs=[vmem()] * 8,
+        out_specs=tuple(
+            [pl.BlockSpec(memory_space=pltpu.SMEM)] + [vmem()] * 5),
+        interpret=interpret,
+    )(
+        x.astype(f32),
+        y.astype(jnp.int32)[:, None],
+        scaled_mask.astype(f32),
+        params["fc1"]["w"].astype(f32),
+        params["fc1"]["b"].astype(f32)[None, :],
+        params["fc2"]["w"].astype(f32),
+        params["fc2"]["b"].astype(f32)[None, :],
+        pad_fc3(params["fc3"]["w"].astype(f32)),
+    )
+    grads = {
+        "fc1": {"w": gw1, "b": gb1[0]},
+        "fc2": {"w": gw2, "b": gb2[0]},
+        "fc3": {"w": gw3[:, :NUM_CLASSES]},
+    }
+    return loss[0, 0], grads
+
+
+def dropout_mask(key: jax.Array, batch: int, *, train: bool = True):
+    """The pre-scaled mask the kernel consumes, drawn EXACTLY like
+    models/mlp.py's dropout (same bernoulli stream for the same key), so the
+    fused step reproduces the unfused step bit-for-bit in RNG."""
+    keep = 1.0 - DROPOUT_RATE
+    if not train:
+        return jnp.ones((batch, HIDDEN1), jnp.float32)
+    mask = jax.random.bernoulli(key, keep, (batch, HIDDEN1))
+    return mask.astype(jnp.float32) / keep
+
+
+def make_pallas_train_step(lr: float, *, interpret: bool = False):
+    """Drop-in replacement for train.loop.make_train_step: one jitted
+    (params, key, x, y) -> (params', key', loss) whose fwd+bwd is the fused
+    kernel; the SGD update fuses into the surrounding jit. Same
+    jax.random.split chain as the unfused step -> same dropout masks."""
+    from .sgd import sgd_step
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, key, x, y):
+        key, sub = jax.random.split(key)
+        mask = dropout_mask(sub, x.shape[0])
+        loss, grads = fused_loss_and_grads(params, x, y, mask,
+                                           interpret=interpret)
+        return sgd_step(params, grads, lr), key, loss
+
+    return step
+
+
+def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False):
+    """SPMD data-parallel fused step over the 'dp' mesh — the
+    parallel.ddp.make_dp_train_step shape (per-replica kernel, pmean'd
+    grads, redundant SGD) with the Pallas kernel as the local compute."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from ..parallel.mesh import DATA_AXIS
+    from .sgd import sgd_step
+
+    def _shard_fn(params, sub, x, y):
+        rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
+        mask = dropout_mask(rkey, x.shape[0])
+        loss, grads = fused_loss_and_grads(params, x, y, mask,
+                                           interpret=interpret)
+        grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        return grads, loss
+
+    # check_vma=False: grads come out of the kernel, not an autodiff
+    # transpose, so shard_map's replication tracking (the reason ddp.py
+    # needs _pvary) has nothing to protect here — and pallas_call's
+    # out_shape structs carry no vma for it to check.
+    sharded = shard_map(
+        _shard_fn, mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P()), check_vma=False)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, key, x, y):
+        key, sub = jax.random.split(key)
+        grads, loss = sharded(params, sub, x, y)
+        return sgd_step(params, grads, lr), key, loss
+
+    return step

@@ -58,6 +58,15 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         " the reference has no load path)")
     t.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32",
                    help="compute dtype for the train step")
+    t.add_argument("--kernel", choices=("xla", "pallas"), default="xla",
+                   help="train-step implementation: 'xla' (jit + XLA fusion) "
+                        "or 'pallas' (the fused fwd+bwd VMEM-resident TPU "
+                        "kernel, ops/pallas_step.py; streaming loop only)")
+    t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
+                   help="capture a jax.profiler trace of the training run "
+                        "into LOGDIR (view in TensorBoard/XProf); restores "
+                        "the timing capability the reference's ancestral "
+                        "I/O-cost harness lost (SURVEY.md §5.1)")
     t.add_argument("--cached", action="store_true",
                    help="cache the dataset in HBM and run each epoch as one "
                         "jitted lax.scan program (fastest path for datasets "
@@ -81,7 +90,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "seed": a.seed, "parallel": a.parallel,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
-            "dtype": a.dtype, "cached": a.cached,
+            "dtype": a.dtype, "cached": a.cached, "profile": a.profile,
+            "kernel": a.kernel,
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,

@@ -36,6 +36,7 @@ from ..models.mlp import mlp_apply
 from ..ops.loss import cross_entropy, accuracy
 from ..ops.sgd import sgd_step
 from ..data.loader import BatchLoader, device_prefetch
+from ..utils.logging import progress
 
 
 @dataclass
@@ -140,7 +141,9 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         train_loader.sampler.set_epoch(epoch)
         losses = []
         nbatches = 0
-        for x, y in device_prefetch(train_loader, sharding=sharding, put=put):
+        for x, y in progress(
+                device_prefetch(train_loader, sharding=sharding, put=put),
+                desc=f"epoch {epoch}"):
             params, key, loss = step(params, key, x, y)
             losses.append(loss)
             nbatches += 1

@@ -1,0 +1,4 @@
+"""Cross-cutting utilities: timing/profiling and rank-aware logging."""
+
+from .profiling import Timer, CumulativeTimer, trace, device_sync  # noqa: F401
+from .logging import rank_zero_log, progress  # noqa: F401

@@ -1,0 +1,59 @@
+"""Rank-aware logging and progress display.
+
+The reference prints its epoch summary on EVERY rank (SURVEY.md §5.5) and
+drives tqdm bars per step (ddp_tutorial_multi_gpu.py:85,98); it also defines
+a DISABLE_TQDM flag it never honors (ddp_tutorial_cpu.py:9 — dead). Here:
+process-0-gated logging is the default surface (matching the mp scripts'
+rank-0 banner, mnist_cpu_mp.py:278-299), and the progress wrapper actually
+honors its disable switch. No per-step device sync is ever forced for
+display — the reference's `.item()`-per-step pattern is the antipattern this
+framework exists to avoid.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+DISABLE_TQDM = bool(int(os.environ.get("DISABLE_TQDM", "0")))
+
+
+def rank_zero_log(log: Callable[[str], None] = print) -> Callable[[str], None]:
+    """Return `log` on process 0, a no-op elsewhere. Safe before
+    jax.distributed init (treats that as single-process)."""
+    try:
+        import jax
+        is_zero = jax.process_index() == 0
+    except Exception:
+        is_zero = True
+    if is_zero:
+        return log
+    return lambda _msg: None
+
+
+def progress(iterable: Iterable[T], desc: str = "", *,
+             disable: bool | None = None) -> Iterator[T]:
+    """tqdm-style progress iteration (reference: tqdm wraps both hot loops,
+    ddp_tutorial_multi_gpu.py:85,101). Falls back to a plain iterator when
+    tqdm is unavailable, `disable` is set, DISABLE_TQDM=1, stderr is not a
+    TTY (so batch logs stay clean), or this is not process 0 (N ranks
+    interleaving carriage returns on one terminal garble each other — the
+    reference does exactly that; rank-0 gating is the fix)."""
+    if disable is None:
+        disable = DISABLE_TQDM or not sys.stderr.isatty()
+        if not disable:
+            try:
+                import jax
+                disable = jax.process_index() != 0
+            except Exception:
+                disable = False
+    if disable:
+        return iter(iterable)
+    try:
+        from tqdm import tqdm
+    except ImportError:
+        return iter(iterable)
+    return iter(tqdm(iterable, desc=desc))

@@ -1,0 +1,112 @@
+"""Timing and profiler capture.
+
+The reference repo descends from an I/O-cost-evaluation harness — its argparse
+still self-describes as "Evaluate cost of reading input files"
+(mnist_cpu_mp.py:210) — but no timing code survives in it (SURVEY.md §5.1).
+This module restores that capability the TPU way:
+
+  * `Timer` / `CumulativeTimer` — wall-clock timing that understands XLA's
+    async dispatch: on device work, a naive `time.time()` pair measures only
+    enqueue time, so timers take an optional pytree to `block_until_ready` on
+    exit.
+  * `trace(logdir)` — one-line capture of a real profiler trace
+    (jax.profiler: XPlane protos viewable in TensorBoard/XProf), covering
+    device compute, HBM transfers, and ICI collectives — the data the
+    reference's lost I/O-cost harness wanted, plus the device side it never
+    had.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+
+
+def device_sync(tree: Any = None) -> None:
+    """Drain async dispatch: block until `tree`'s arrays (or, with no
+    argument, all live arrays on all local devices) are computed."""
+    if tree is not None:
+        jax.block_until_ready(tree)
+        return
+    for a in jax.live_arrays():
+        jax.block_until_ready(a)
+
+
+class Timer:
+    """Context-manager wall timer, async-dispatch aware.
+
+        with Timer("epoch") as t:
+            out = step(...)
+            t.sync(out)          # timer exit blocks on `out` first
+        print(t.seconds)
+
+    Without `sync`, measures plain wall time of the block.
+    """
+
+    def __init__(self, name: str = "timer"):
+        self.name = name
+        self.seconds: Optional[float] = None
+        self._sync_tree: Any = None
+
+    def sync(self, tree: Any) -> Any:
+        """Register a pytree to block on at exit; returns it unchanged."""
+        self._sync_tree = tree
+        return tree
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._sync_tree is not None:
+            jax.block_until_ready(self._sync_tree)
+        self.seconds = time.perf_counter() - self._t0
+
+
+class CumulativeTimer:
+    """Accumulates wall time over repeated sections (e.g. data-loading vs
+    step time inside an epoch) — the per-phase cost split the reference's
+    ancestral I/O harness was built to report.
+
+        t = CumulativeTimer("io")
+        for ...:
+            with t:
+                batch = next(loader)
+        t.total, t.count, t.mean
+    """
+
+    def __init__(self, name: str = "section"):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def __enter__(self) -> "CumulativeTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.total += time.perf_counter() - self._t0
+        self.count += 1
+
+    def __repr__(self) -> str:
+        return (f"CumulativeTimer({self.name}: total={self.total:.4f}s "
+                f"count={self.count} mean={self.mean * 1e3:.3f}ms)")
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]):
+    """Capture a jax.profiler trace of the enclosed block into `logdir`
+    (no-op when logdir is falsy, so call sites need no branching). View with
+    TensorBoard's profile plugin or XProf."""
+    if not logdir:
+        yield
+        return
+    with jax.profiler.trace(str(logdir)):
+        yield
