@@ -18,7 +18,22 @@ from typing import Callable, Iterable, Iterator, TypeVar
 
 T = TypeVar("T")
 
-DISABLE_TQDM = bool(int(os.environ.get("DISABLE_TQDM", "0")))
+def _env_flag(name: str, default: bool = False) -> bool:
+    """Tolerant boolean env parsing: 1/true/yes/on (any case) enable, 0/
+    false/no/off/'' disable, anything else falls back to `default` rather
+    than raising at import time."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    val = raw.strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("", "0", "false", "no", "off"):
+        return False
+    return default
+
+
+DISABLE_TQDM = _env_flag("DISABLE_TQDM")
 
 
 def rank_zero_log(log: Callable[[str], None] = print) -> Callable[[str], None]:

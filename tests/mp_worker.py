@@ -1,0 +1,69 @@
+"""Worker process for the real multi-process integration test
+(tests/test_multiprocess.py) — NOT collected by pytest (no test_ prefix).
+
+Each worker is one jax.distributed process with ONE local CPU device. The
+parent launches WORLD_SIZE of these with env-var wireup (RANK/WORLD_SIZE/
+MASTER_ADDR/MASTER_PORT — the reference's fallback branch,
+mnist_cpu_mp.py:147-185), and they jointly run SPMD data-parallel training:
+rendezvous, per-process sampler shards, global-batch stitching, cross-process
+gradient allreduce, plus the Runtime collectives (barrier, reduce_max).
+
+Output: ONE JSON line on stdout with the loss curve, a params checksum, and
+collective results, which the parent cross-checks between ranks and against
+a single-process golden run of the same math.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    import numpy as np
+    import jax
+
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel.ddp import (
+        dp_mesh, global_batch_from_local, make_dp_train_step, replicate_state)
+    from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
+    from pytorch_ddp_mnist_tpu.parallel.wireup import initialize_runtime
+
+    n, local_batch, steps, lr = 512, 32, 5, 0.05
+
+    rt = initialize_runtime("env")
+    assert jax.process_count() == rt.size, "rendezvous failed"
+    mesh = dp_mesh()
+    assert mesh.devices.size == rt.size  # one device per process
+
+    split = synthetic_mnist(n, seed=0)
+    x_all = normalize_images(split.images)
+    y_all = split.labels.astype(np.int32)
+    sampler = ShardedSampler(n, num_replicas=rt.size, rank=rt.rank, seed=42)
+    sampler.set_epoch(0)
+    shard = sampler.indices()
+
+    step = make_dp_train_step(mesh, lr=lr)
+    params = replicate_state(mesh, init_mlp(jax.random.key(0)))
+    key = replicate_state(mesh, jax.random.key(1))
+
+    losses = []
+    for s in range(steps):
+        rows = shard[s * local_batch:(s + 1) * local_batch]
+        gx, gy = global_batch_from_local(mesh, (x_all[rows], y_all[rows]))
+        params, key, loss = step(params, key, gx, gy)
+        losses.append(float(loss))
+
+    # Params are fully replicated -> every process can materialize them.
+    checksum = float(sum(np.abs(np.asarray(leaf)).sum()
+                         for leaf in jax.tree_util.tree_leaves(params)))
+    rmax = rt.reduce_max(float(rt.rank))
+    rt.barrier()
+    print(json.dumps({"rank": rt.rank, "size": rt.size, "losses": losses,
+                      "checksum": checksum, "reduce_max": rmax}))
+    sys.stdout.flush()
+    rt.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
