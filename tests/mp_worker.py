@@ -16,6 +16,11 @@ a single-process golden run of the same math.
 import json
 import sys
 
+# Single source of truth for the run config — the golden replay in
+# test_multiprocess.py imports these, so worker and golden cannot drift.
+HPARAMS = dict(n=512, local_batch=32, steps=5, lr=0.05,
+               data_seed=0, sampler_seed=42, param_seed=0, key_seed=1)
+
 
 def main() -> int:
     import numpy as np
@@ -28,23 +33,25 @@ def main() -> int:
     from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
     from pytorch_ddp_mnist_tpu.parallel.wireup import initialize_runtime
 
-    n, local_batch, steps, lr = 512, 32, 5, 0.05
+    n, local_batch, steps, lr = (HPARAMS["n"], HPARAMS["local_batch"],
+                                 HPARAMS["steps"], HPARAMS["lr"])
 
     rt = initialize_runtime("env")
     assert jax.process_count() == rt.size, "rendezvous failed"
     mesh = dp_mesh()
     assert mesh.devices.size == rt.size  # one device per process
 
-    split = synthetic_mnist(n, seed=0)
+    split = synthetic_mnist(n, seed=HPARAMS["data_seed"])
     x_all = normalize_images(split.images)
     y_all = split.labels.astype(np.int32)
-    sampler = ShardedSampler(n, num_replicas=rt.size, rank=rt.rank, seed=42)
+    sampler = ShardedSampler(n, num_replicas=rt.size, rank=rt.rank,
+                             seed=HPARAMS["sampler_seed"])
     sampler.set_epoch(0)
     shard = sampler.indices()
 
     step = make_dp_train_step(mesh, lr=lr)
-    params = replicate_state(mesh, init_mlp(jax.random.key(0)))
-    key = replicate_state(mesh, jax.random.key(1))
+    params = replicate_state(mesh, init_mlp(jax.random.key(HPARAMS["param_seed"])))
+    key = replicate_state(mesh, jax.random.key(HPARAMS["key_seed"]))
 
     losses = []
     for s in range(steps):

@@ -1,0 +1,86 @@
+"""CLI surface tests — the unified trainer entry point
+(pytorch_ddp_mnist_tpu/cli/train.py) run in-process on the virtual CPU mesh.
+
+The reference's five entry scripts have no tests (SURVEY.md §4); this locks
+our single config surface: serial end-to-end, checkpoint/resume, the NetCDF
+data path behind the converter, and the CLI's guard rails (flag conflicts,
+missing-file errors). The multi-process CLI path is covered by real spawned
+processes in tests/test_multiprocess.py.
+"""
+
+import re
+
+import pytest
+
+from pytorch_ddp_mnist_tpu.cli.train import main
+from pytorch_ddp_mnist_tpu.data.convert import main as convert_main
+
+
+def _epoch_lines(capsys):
+    out = capsys.readouterr().out
+    return out, [ln for ln in out.splitlines() if ln.startswith("Epoch=")]
+
+
+def _mean_train(line: str) -> float:
+    return float(re.search(r"mean_train=([0-9.]+)", line).group(1))
+
+
+def test_serial_end_to_end_and_resume(tmp_path, capsys):
+    ckpt = tmp_path / "m.msgpack"
+    args = ["--limit", "768", "--batch_size", "64", "--lr", "0.1",
+            "--path", str(tmp_path / "nodata"), "--checkpoint", str(ckpt)]
+    assert main(args + ["--n_epochs", "3"]) == 0
+    out, lines = _epoch_lines(capsys)
+    assert len(lines) == 3, out
+    assert ckpt.exists()
+    from_scratch = _mean_train(lines[0])
+
+    # Resume: training must pick up near where it left off, not from scratch.
+    assert main(args + ["--n_epochs", "1", "--resume", str(ckpt)]) == 0
+    _, lines = _epoch_lines(capsys)
+    resumed = _mean_train(lines[0])
+    assert resumed < from_scratch * 0.5, (from_scratch, resumed)
+
+
+def test_empty_checkpoint_skips_save(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    assert main(["--limit", "256", "--batch_size", "64",
+                 "--path", str(tmp_path / "nodata"), "--checkpoint", ""]) == 0
+    capsys.readouterr()
+    assert not list(tmp_path.glob("*.msgpack"))
+
+
+def test_netcdf_roundtrip_through_converter(tmp_path, capsys):
+    assert convert_main(["--synthetic", "512:128",
+                         "--out_dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+    assert main(["--netcdf", "--path", str(tmp_path), "--batch_size", "64",
+                 "--checkpoint", ""]) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1
+
+
+def test_netcdf_cached_path(tmp_path, capsys):
+    assert convert_main(["--synthetic", "512:128",
+                         "--out_dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+    assert main(["--netcdf", "--cached", "--path", str(tmp_path),
+                 "--batch_size", "64", "--limit", "256",
+                 "--checkpoint", ""]) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1
+
+
+def test_netcdf_missing_files_error(tmp_path):
+    with pytest.raises(SystemExit, match="not found"):
+        main(["--netcdf", "--path", str(tmp_path), "--checkpoint", ""])
+
+
+def test_pallas_cached_conflict():
+    with pytest.raises(SystemExit, match="drop one"):
+        main(["--kernel", "pallas", "--cached"])
+
+
+def test_pallas_bfloat16_conflict():
+    with pytest.raises(SystemExit, match="bfloat16"):
+        main(["--kernel", "pallas", "--dtype", "bfloat16"])

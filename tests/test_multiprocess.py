@@ -52,21 +52,52 @@ def _spawn(rank: int, port: int, argv, extra_env=None):
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
 
 
-def _run_world(argv, extra_env=None, timeout=240):
+def _run_world_once(argv, extra_env, timeout):
     port = _free_port()
     procs = [_spawn(r, port, argv, extra_env) for r in range(WORLD)]
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=timeout)
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                # Harvest what the hung/killed workers DID say — that is the
+                # actual diagnostic, not the timeout itself.
+                out, err = p.communicate()
+                outs.append((None, out, err))
+                continue
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for rank, (rc, out, err) in enumerate(outs):
-        assert rc == 0, f"rank {rank} failed (rc={rc}):\n{out}\n{err}"
+            p.wait()
     return outs
+
+
+def _run_world(argv, extra_env=None, timeout=240, attempts=3):
+    """Run WORLD copies to completion, retrying on rendezvous-port races.
+
+    _free_port() closes its probe socket before the coordinator binds the
+    port, so another process can steal it in between (TOCTOU); a failed
+    attempt with a fresh port is retried rather than flaking."""
+    last = None
+    for _ in range(attempts):
+        outs = _run_world_once(argv, extra_env, timeout)
+        if all(rc == 0 for rc, _, _ in outs):
+            return outs
+        last = outs
+        blob = "\n".join(f"{o}\n{e}" for _, o, e in outs)
+        if not ("Address already in use" in blob or "Failed to bind" in blob
+                or "errno: 98" in blob):
+            break  # a real failure, not a port race — don't mask it
+    for rank, (rc, out, err) in enumerate(last):
+        assert rc == 0, (f"rank {rank} failed "
+                         f"(rc={'timeout' if rc is None else rc}):\n{out}\n{err}")
+    return last
 
 
 def _golden_worker_run():
@@ -77,6 +108,7 @@ def _golden_worker_run():
     out in process order), and dropout keys fold in the same axis_index — so
     the runs must agree to float tolerance.
     """
+    from mp_worker import HPARAMS
     from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
     from pytorch_ddp_mnist_tpu.models import init_mlp
     from pytorch_ddp_mnist_tpu.parallel.ddp import (
@@ -84,20 +116,23 @@ def _golden_worker_run():
     from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
     from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
 
-    n, local_batch, steps, lr = 512, 32, 5, 0.05
+    n, local_batch, steps, lr = (HPARAMS["n"], HPARAMS["local_batch"],
+                                 HPARAMS["steps"], HPARAMS["lr"])
     mesh = make_mesh([WORLD], ["dp"], jax.devices()[:WORLD])
-    split = synthetic_mnist(n, seed=0)
+    split = synthetic_mnist(n, seed=HPARAMS["data_seed"])
     x_all = normalize_images(split.images)
     y_all = split.labels.astype(np.int32)
     shards = []
     for r in range(WORLD):
-        s = ShardedSampler(n, num_replicas=WORLD, rank=r, seed=42)
+        s = ShardedSampler(n, num_replicas=WORLD, rank=r,
+                           seed=HPARAMS["sampler_seed"])
         s.set_epoch(0)
         shards.append(s.indices())
 
     step = make_dp_train_step(mesh, lr=lr)
-    params = jax.device_put(init_mlp(jax.random.key(0)), replicated(mesh))
-    key = jax.device_put(jax.random.key(1), replicated(mesh))
+    params = jax.device_put(init_mlp(jax.random.key(HPARAMS["param_seed"])),
+                            replicated(mesh))
+    key = jax.device_put(jax.random.key(HPARAMS["key_seed"]), replicated(mesh))
     losses = []
     for s in range(steps):
         rows = np.concatenate(
