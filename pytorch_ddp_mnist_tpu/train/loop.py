@@ -68,53 +68,51 @@ def make_train_step(lr: float) -> Callable:
 
 
 def make_eval_step() -> Callable:
-    """Jitted masked eval on a fixed-size batch.
+    """Jitted whole-test-set eval: (params, x, y) -> (per_sample_loss,
+    correct), both (n,) float32.
 
-    (params, x, y, n_valid) -> (sum_loss, n_correct) over the first `n_valid`
-    rows only. The mask (not the shape) carries the partial-batch size, so
-    every eval batch compiles ONE program and padded rows can never bias the
-    metrics.
+    ONE program and ONE device round-trip for the full eval pass — the
+    reference's eval loop dispatches per batch and syncs per step
+    (ddp_tutorial_multi_gpu.py:101-114); on a (possibly remote) TPU each
+    dispatch+transfer is host latency on the critical path, and the whole
+    10k-row forward is a single small matmul chain for the MXU anyway.
+    Per-sample values come back so the caller can aggregate in any batch
+    segmentation it wants.
     """
     @jax.jit
-    def step(params, x, y, n_valid):
+    def step(params, x, y):
         logits = mlp_apply(params, x, train=False)
         logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         per_sample = -jnp.take_along_axis(
             logz, y[:, None].astype(jnp.int32), axis=-1)[:, 0]
         correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
-        mask = (jnp.arange(x.shape[0]) < n_valid).astype(jnp.float32)
-        return jnp.sum(per_sample * mask), jnp.sum(correct * mask)
+        return per_sample, correct
 
     return step
 
 
 def evaluate(eval_step, params, x_test, y_test, batch_size: int):
-    """Full-test-set eval, batched like the reference's eval loop
-    (ddp_tutorial_multi_gpu.py:101-114).
+    """Full-test-set eval (reference eval loop, ddp_tutorial_multi_gpu.py:
+    101-114) in one device call.
 
     Returns (val_loss_ref_unit, mean_loss, acc): val_loss_ref_unit replicates
     the reference accumulator Σ(batch_mean/B) including its true last-batch
     size B (the reference's DataLoader yields a short final batch; here the
-    batch is padded to static shape and masked out of the sums instead).
+    per-sample losses are segmented into the same batch layout on host).
+    The reference shuffles its test loader, so the ref-unit's exact value is
+    RNG-dependent there; deterministic sequential order is used here.
     """
     n = x_test.shape[0]
-    x_test = np.asarray(x_test)
-    y_test = np.asarray(y_test)
-    sums, corrects, counts = [], [], []
+    per_sample, correct = eval_step(
+        params, jnp.asarray(x_test), jnp.asarray(y_test))
+    per_sample = np.asarray(per_sample, np.float64)   # one host fetch
+    correct = np.asarray(correct)
+    val_loss_ref_unit = 0.0
     for start in range(0, n, batch_size):
         b = min(batch_size, n - start)
-        idx = np.arange(start, start + batch_size) % n  # wrap-pad, masked out
-        sum_loss, n_correct = eval_step(
-            params, jnp.asarray(x_test[idx]), jnp.asarray(y_test[idx]),
-            jnp.int32(b))
-        sums.append(sum_loss)
-        corrects.append(n_correct)
-        counts.append(b)
-    sums = np.asarray(jnp.stack(sums))          # ONE device->host fetch
-    corrects = np.asarray(jnp.stack(corrects))
-    counts = np.asarray(counts, np.float64)
-    val_loss_ref_unit = float((sums / counts / counts).sum())  # Σ(mean/B)
-    return val_loss_ref_unit, float(sums.sum() / n), float(corrects.sum() / n)
+        val_loss_ref_unit += per_sample[start:start + b].mean() / b
+    return (float(val_loss_ref_unit), float(per_sample.mean()),
+            float(correct.mean()))
 
 
 def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
