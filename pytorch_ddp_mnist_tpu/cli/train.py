@@ -32,13 +32,9 @@ def main(argv=None) -> int:
     tcfg, dcfg = config["trainer"], config["data"]
 
     use_pallas = tcfg["kernel"] == "pallas"
-    if use_pallas:
-        if tcfg["cached"]:
-            raise SystemExit("--kernel pallas drives the streaming loop; "
-                             "--cached is the XLA scan path — drop one")
-        if tcfg["dtype"] != "float32":
-            raise SystemExit("--kernel pallas computes in float32 "
-                             "(MXU accumulation); drop --dtype bfloat16")
+    if use_pallas and tcfg["dtype"] != "float32":
+        raise SystemExit("--kernel pallas computes in float32 "
+                         "(MXU accumulation); drop --dtype bfloat16")
 
     def _pallas_interpret() -> bool:
         # The kernel needs Mosaic (TPU — incl. the axon plugin, which
@@ -59,18 +55,19 @@ def main(argv=None) -> int:
         runtime = initialize_runtime(tcfg["wireup_method"])
         process_index, num_processes = jax.process_index(), jax.process_count()
         mesh = dp_mesh()  # global: all devices of all processes
-        if use_pallas:
-            from ..ops.pallas_step import make_pallas_dp_train_step
-            train_step = make_pallas_dp_train_step(
-                mesh, tcfg["lr"], interpret=_pallas_interpret())
-        else:
-            train_step = make_dp_train_step(mesh, tcfg["lr"],
-                                            dtype=tcfg["dtype"])
+        if not tcfg["cached"]:  # the cached path builds its own step fns
+            if use_pallas:
+                from ..ops.pallas_step import make_pallas_dp_train_step
+                train_step = make_pallas_dp_train_step(
+                    mesh, tcfg["lr"], interpret=_pallas_interpret())
+            else:
+                train_step = make_dp_train_step(mesh, tcfg["lr"],
+                                                dtype=tcfg["dtype"])
         put = lambda b: global_batch_from_local(mesh, b)  # noqa: E731
         num_shards = mesh.devices.size  # data sharding is per-device
         local_shards = len(jax.local_devices())
     else:
-        if use_pallas:
+        if use_pallas and not tcfg["cached"]:
             from ..ops.pallas_step import make_pallas_train_step
             train_step = make_pallas_train_step(
                 tcfg["lr"], interpret=_pallas_interpret())
@@ -171,8 +168,10 @@ def main(argv=None) -> int:
             state = fit_cached(state, x_train, y_train, sampler, x_test,
                                test_labels, epochs=tcfg["n_epochs"],
                                batch_size=global_batch, lr=tcfg["lr"],
-                               mesh=mesh, dtype=tcfg["dtype"], log=log,
-                               epoch_hook=hook)
+                               mesh=mesh, dtype=tcfg["dtype"],
+                               kernel=tcfg["kernel"],
+                               interpret=use_pallas and _pallas_interpret(),
+                               log=log, epoch_hook=hook)
     else:
         with trace(tcfg["profile"]):
             state = fit(state, loader, x_test, test_labels,

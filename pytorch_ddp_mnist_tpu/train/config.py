@@ -61,7 +61,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--kernel", choices=("xla", "pallas"), default="xla",
                    help="train-step implementation: 'xla' (jit + XLA fusion) "
                         "or 'pallas' (the fused fwd+bwd VMEM-resident TPU "
-                        "kernel, ops/pallas_step.py; streaming loop only)")
+                        "kernel, ops/pallas_step.py; composes with --cached "
+                        "to run inside the epoch scan)")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "

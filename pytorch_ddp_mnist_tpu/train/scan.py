@@ -51,9 +51,35 @@ def epoch_batch_indices(sampler, batch_size: int) -> np.ndarray:
     return np.stack(list(_batched_indices(sampler, batch_size))).astype(np.int32)
 
 
-def make_epoch_fn(lr: float, *, dtype: str = "float32") -> Callable:
+def _check_kernel(kernel: str, dtype: str) -> None:
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"unknown kernel {kernel!r}")
+    if kernel == "pallas" and dtype != "float32":
+        raise ValueError("the pallas kernel computes in float32 "
+                         "(MXU f32 accumulation); drop dtype=bfloat16")
+
+
+def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):
+    """Per-step fwd+bwd, either XLA autodiff or the fused Pallas kernel.
+    Both draw the dropout mask from the same bernoulli stream for the same
+    key, so the choice changes the schedule, not the numbers."""
+    if kernel == "pallas":
+        from ..ops.pallas_step import dropout_mask, fused_loss_and_grads
+        mask = dropout_mask(dropout_key, x.shape[0])
+        return fused_loss_and_grads(params, x, y, mask, interpret=interpret)
+
+    def loss_fn(p):
+        return cross_entropy(
+            mlp_apply(p, x, train=True, dropout_key=dropout_key), y)
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
+                  interpret: bool = False) -> Callable:
     """Serial epoch program: (params, key, x_all, y_all, idx) ->
     (params', key', losses) with idx (nbatches, B)."""
+    _check_kernel(kernel, dtype)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
     def body(carry, batch_idx, x_all, y_all):
@@ -61,11 +87,7 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32") -> Callable:
         key, sub = jax.random.split(key)
         x = jnp.take(x_all, batch_idx, axis=0).astype(compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
-
-        def loss_fn(p):
-            return cross_entropy(mlp_apply(p, x, train=True, dropout_key=sub), y)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = _loss_and_grads(params, x, y, sub, kernel, interpret)
         return (sgd_step(params, grads, lr), key), loss
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -77,7 +99,8 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32") -> Callable:
     return epoch
 
 
-def _dp_step_body(x_all, y_all, me, lr, compute_dt):
+def _dp_step_body(x_all, y_all, me, lr, compute_dt, kernel="xla",
+                  interpret=False):
     """The shared per-step scan body of the DP programs: gather this
     replica's rows, fwd/bwd with a replica-distinct dropout key, pmean grads
     (the DDP allreduce), SGD."""
@@ -88,12 +111,7 @@ def _dp_step_body(x_all, y_all, me, lr, compute_dt):
         rkey = jax.random.fold_in(sub, me)
         x = jnp.take(x_all, batch_idx, axis=0).astype(compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
-
-        def loss_fn(p):
-            return cross_entropy(
-                mlp_apply(p, x, train=True, dropout_key=rkey), y)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss, grads = _loss_and_grads(params, x, y, rkey, kernel, interpret)
         grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
         loss = jax.lax.pmean(loss, DATA_AXIS)
         return (sgd_step(params, grads, lr), key), loss
@@ -101,7 +119,8 @@ def _dp_step_body(x_all, y_all, me, lr, compute_dt):
     return body
 
 
-def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable:
+def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
+                     kernel: str = "xla", interpret: bool = False) -> Callable:
     """SPMD epoch program over the 'dp' mesh.
 
     x_all/y_all replicated (each device holds the dataset and gathers its own
@@ -113,7 +132,8 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callab
     One epoch is the one-element case of the fused multi-epoch program
     (tests prove the equivalence), so this just wraps make_dp_run_fn.
     """
-    run = make_dp_run_fn(mesh, lr, dtype=dtype)
+    run = make_dp_run_fn(mesh, lr, dtype=dtype, kernel=kernel,
+                         interpret=interpret)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def epoch(params, key, x_all, y_all, idx):
@@ -123,7 +143,8 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callab
     return epoch
 
 
-def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable:
+def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
+                   kernel: str = "xla", interpret: bool = False) -> Callable:
     """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
     (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
     sharded on the batch dim.
@@ -134,12 +155,20 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable
     lets XLA keep the whole run in its pipeline. Epoch reshuffles stay exact:
     the host precomputes each epoch's sampler indices into idxs.
     """
+    _check_kernel(kernel, dtype)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    use_pallas = kernel == "pallas"
 
     def shard_fn(params, key, x_all, y_all, idxs):
-        params = _pvary(params, DATA_AXIS)
+        if not use_pallas:
+            # Differentiate per-replica copies so the allreduce in the body
+            # is the only grad reduction (see parallel/ddp.py). The pallas
+            # body's grads come from the kernel, not an autodiff transpose,
+            # so there is nothing to protect (and check_vma is off below).
+            params = _pvary(params, DATA_AXIS)
         me = jax.lax.axis_index(DATA_AXIS)
-        body = _dp_step_body(x_all, y_all, me, lr, compute_dt)
+        body = _dp_step_body(x_all, y_all, me, lr, compute_dt,
+                             kernel=kernel, interpret=interpret)
 
         def epoch(carry, idx_e):
             return jax.lax.scan(body, carry, idx_e)
@@ -152,7 +181,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable
     sharded = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, None, DATA_AXIS)),
-        out_specs=(P(), P(), P()))
+        out_specs=(P(), P(), P()), check_vma=not use_pallas)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def run(params, key, x_all, y_all, idxs):
@@ -164,6 +193,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32") -> Callable
 def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                epochs: int, batch_size: int, lr: float,
                mesh: Optional[Mesh] = None, dtype: str = "float32",
+               kernel: str = "xla", interpret: bool = False,
                log: Callable[[str], None] = print,
                epoch_hook: Callable | None = None) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
@@ -178,12 +208,14 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         rep = NamedSharding(mesh, P())
         x_all = jax.device_put(np.asarray(x_train, np.float32), rep)
         y_all = jax.device_put(np.asarray(y_train, np.int32), rep)
-        epoch_fn = make_dp_epoch_fn(mesh, lr, dtype=dtype)
+        epoch_fn = make_dp_epoch_fn(mesh, lr, dtype=dtype, kernel=kernel,
+                                    interpret=interpret)
         idx_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     else:
         x_all = jax.device_put(np.asarray(x_train, np.float32))
         y_all = jax.device_put(np.asarray(y_train, np.int32))
-        epoch_fn = make_epoch_fn(lr)
+        epoch_fn = make_epoch_fn(lr, dtype=dtype, kernel=kernel,
+                                 interpret=interpret)
         idx_sharding = None
 
     eval_step = make_eval_step()

@@ -76,9 +76,14 @@ def test_netcdf_missing_files_error(tmp_path):
         main(["--netcdf", "--path", str(tmp_path), "--checkpoint", ""])
 
 
-def test_pallas_cached_conflict():
-    with pytest.raises(SystemExit, match="drop one"):
-        main(["--kernel", "pallas", "--cached"])
+def test_pallas_cached_runs(tmp_path, capsys):
+    """--kernel pallas composes with --cached: the fused kernel inside the
+    epoch scan (interpreted on the CPU backend)."""
+    assert main(["--kernel", "pallas", "--cached", "--limit", "256",
+                 "--batch_size", "64", "--path", str(tmp_path / "nodata"),
+                 "--checkpoint", ""]) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1
 
 
 def test_pallas_bfloat16_conflict():
