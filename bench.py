@@ -17,6 +17,7 @@ about the hardware. Timing = full fetch of the loss curve (a guaranteed
 sync), best of 3 windows.
 """
 
+import argparse
 import json
 import time
 
@@ -27,8 +28,28 @@ NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
 FUSED_EPOCHS = 50
 
 
-def main() -> None:
-    import jax.numpy as jnp
+def main(argv=None) -> None:
+    # Variant flags (benchmark experiments; the driver's default run is the
+    # flagship float32/XLA/threefry config and prints the same single line).
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kernel", choices=("xla", "pallas"), default="xla")
+    p.add_argument("--dtype", choices=("float32", "bfloat16"),
+                   default="float32")
+    p.add_argument("--impl", choices=("threefry2x32", "rbg"),
+                   default="threefry2x32",
+                   help="PRNG engine carried by the train key (dropout "
+                        "stream); rbg uses the TPU hardware generator")
+    p.add_argument("--epochs", type=int, default=FUSED_EPOCHS)
+    a = p.parse_args(argv)
+    if a.epochs < 1:
+        p.error("--epochs must be >= 1")
+
+    # An explicit JAX_PLATFORMS in the env wins over any backend the site
+    # startup pre-registered (e.g. run the bench on CPU while the TPU tunnel
+    # is down): same policy as the trainer CLI.
+    from pytorch_ddp_mnist_tpu.parallel.wireup import _honor_platform_env
+    _honor_platform_env()
+
     from pytorch_ddp_mnist_tpu.data import synthetic_mnist, normalize_images
     from pytorch_ddp_mnist_tpu.models import init_mlp
     from pytorch_ddp_mnist_tpu.parallel import ShardedSampler, data_parallel_mesh
@@ -49,20 +70,27 @@ def main() -> None:
 
     sampler = ShardedSampler(60000, num_replicas=1, rank=0, seed=42)
     idxs = []
-    for e in range(FUSED_EPOCHS):
+    for e in range(a.epochs):
         sampler.set_epoch(e)
         idxs.append(epoch_batch_indices(sampler, batch))
     idxs = jax.device_put(np.stack(idxs),
                           NamedSharding(mesh, P(None, None, DATA_AXIS)))
 
-    run_fn = make_dp_run_fn(mesh, lr=0.01)
+    # Pallas needs Mosaic (TPU); interpret on CPU so every variant runs
+    # everywhere (same fallback as the trainer CLI).
+    interpret = (a.kernel == "pallas"
+                 and jax.default_backend() not in ("tpu", "axon"))
+    run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype, kernel=a.kernel,
+                            interpret=interpret)
     params_host = jax.tree_util.tree_map(np.asarray, init_mlp(jax.random.key(0)))
-    key_host = np.asarray(jax.random.key_data(jax.random.key(1)))
+    key_host = np.asarray(jax.random.key_data(
+        jax.random.key(1, impl=a.impl)))
     rep = replicated(mesh)
 
     def fresh():
         return (jax.device_put(params_host, rep),
-                jax.random.wrap_key_data(jax.device_put(key_host, rep)))
+                jax.random.wrap_key_data(
+                    jax.device_put(key_host, rep), impl=a.impl))
 
     p, k = fresh()
     losses = np.asarray(run_fn(p, k, x_all, y_all, idxs)[2])  # compile + sync
