@@ -147,23 +147,26 @@ def main(argv=None) -> int:
     log = rank_zero_log(print)
     if tcfg["cached"]:
         # Epoch-scanned fast path: dataset resident in HBM, one jitted
-        # lax.scan program per epoch (train/scan.py).
-        if num_processes > 1:
-            raise SystemExit("--cached runs single-process (one process "
-                             "drives the whole mesh); drop it for "
-                             "multi-process streaming")
+        # lax.scan program per epoch (train/scan.py). Works multi-process
+        # too: every process holds the dataset host-side (the PnetCDF
+        # COLLECTIVE-read analog, mnist_pnetcdf_cpu.py:47) and the same
+        # global sampler state; the scan shards each global batch's index
+        # rows over the mesh devices.
         from ..train.scan import fit_cached
         if dcfg["netcdf"]:
-            sampler = loader.sampler
             # Gather only the sampled rows (honors --limit; whole-file fast
             # path when unlimited).
-            rows = (None if sampler.num_samples == loader.num_samples
-                    else np.arange(sampler.num_samples))
+            n_train = loader.sampler.num_samples
+            rows = (None if n_train == loader.num_samples
+                    else np.arange(n_train))
             images, labels = read_mnist_netcdf(train_nc, rows)
             x_train = normalize_images(images)
             y_train = labels.astype(np.int32)
         else:
+            n_train = len(train)
             y_train = train.labels.astype(np.int32)
+        sampler = ShardedSampler(n_train, num_replicas=1, rank=0,
+                                 shuffle=True, seed=42)
         with trace(tcfg["profile"]):
             state = fit_cached(state, x_train, y_train, sampler, x_test,
                                test_labels, epochs=tcfg["n_epochs"],

@@ -71,7 +71,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--cached", action="store_true",
                    help="cache the dataset in HBM and run each epoch as one "
                         "jitted lax.scan program (fastest path for datasets "
-                        "that fit on device; single-process runs only)")
+                        "that fit on device; multi-process capable)")
     d = p.add_argument_group("data")
     d.add_argument("--path", type=str, default="data/",
                    help="dataset root (IDX or NetCDF files)")

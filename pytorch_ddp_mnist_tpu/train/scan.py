@@ -205,9 +205,13 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     import time
 
     if mesh is not None:
-        rep = NamedSharding(mesh, P())
-        x_all = jax.device_put(np.asarray(x_train, np.float32), rep)
-        y_all = jax.device_put(np.asarray(y_train, np.int32), rep)
+        # replicate_state / make_array_from_callback build GLOBAL arrays, so
+        # this path works when `mesh` spans multiple processes too: every
+        # process holds the (tiny) dataset and the same host-side sampler
+        # state, and contributes its devices' shards.
+        from ..parallel.ddp import replicate_state
+        x_all = replicate_state(mesh, np.asarray(x_train, np.float32))
+        y_all = replicate_state(mesh, np.asarray(y_train, np.int32))
         epoch_fn = make_dp_epoch_fn(mesh, lr, dtype=dtype, kernel=kernel,
                                     interpret=interpret)
         idx_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
@@ -225,7 +229,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         sampler.set_epoch(epoch)
         idx = epoch_batch_indices(sampler, batch_size)
         if idx_sharding is not None:
-            idx = jax.device_put(idx, idx_sharding)
+            idx = jax.make_array_from_callback(
+                idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
         params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
         losses = np.asarray(losses)                 # one host fetch per epoch
         train_loss_ref_unit = float((losses / batch_size).sum())
