@@ -154,9 +154,8 @@ def main(argv=None) -> int:
         # rows over the mesh devices.
         from ..train.scan import fit_cached
         if dcfg["netcdf"]:
-            # Gather only the sampled rows (honors --limit; whole-file fast
-            # path when unlimited).
-            n_train = loader.sampler.num_samples
+            # Gather only the sampled rows (honors --limit via the n_train
+            # computed above; whole-file fast path when unlimited).
             rows = (None if n_train == loader.num_samples
                     else np.arange(n_train))
             images, labels = read_mnist_netcdf(train_nc, rows)

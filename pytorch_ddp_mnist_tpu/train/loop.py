@@ -115,6 +115,26 @@ def evaluate(eval_step, params, x_test, y_test, batch_size: int):
             float(correct.mean()))
 
 
+def epoch_summary(epoch: int, losses: np.ndarray, batch_size: int,
+                  val: tuple, dt: float) -> str:
+    """The reference epoch line (ddp_tutorial_multi_gpu.py:116) + extensions.
+
+    `losses` are the epoch's per-batch mean losses; `val` is evaluate()'s
+    (ref_unit, mean, acc) triple. train_loss keeps the reference accumulator
+    unit Σ(batch_mean/B) (SURVEY.md §5.5 quirk); mean/acc/throughput are the
+    added diagnostics. Shared by the streaming and epoch-scanned trainers so
+    the two paths can never drift in format or units.
+    """
+    val_ref_unit, val_mean, val_acc = val
+    train_loss_ref_unit = float((losses / batch_size).sum())
+    imgs = losses.size * batch_size
+    return (f"Epoch={epoch}, train_loss={train_loss_ref_unit}, "
+            f"val_loss={val_ref_unit}"
+            f"  [mean_train={float(losses.mean()):.4f} "
+            f"mean_val={val_mean:.4f} "
+            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s]")
+
+
 def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         epochs: int, batch_size: int, lr: float | None = None,
         log: Callable[[str], None] = print,
@@ -138,24 +158,15 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         t0 = time.perf_counter()
         train_loader.sampler.set_epoch(epoch)
         losses = []
-        nbatches = 0
         for x, y in progress(
                 device_prefetch(train_loader, sharding=sharding, put=put),
                 desc=f"epoch {epoch}"):
             params, key, loss = step(params, key, x, y)
             losses.append(loss)
-            nbatches += 1
         losses = np.asarray(jnp.stack(losses))  # single host fetch per epoch
-        train_loss_ref_unit = float((losses / batch_size).sum())
-        train_mean = float(losses.mean())
-        val_ref_unit, val_mean, val_acc = evaluate(
-            eval_step, params, x_test, y_test, batch_size)
-        dt = time.perf_counter() - t0
-        imgs = nbatches * batch_size
-        log(f"Epoch={epoch}, train_loss={train_loss_ref_unit}, "
-            f"val_loss={val_ref_unit}"
-            f"  [mean_train={train_mean:.4f} mean_val={val_mean:.4f} "
-            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s]")
+        val = evaluate(eval_step, params, x_test, y_test, batch_size)
+        log(epoch_summary(epoch, losses, batch_size, val,
+                          time.perf_counter() - t0))
         state = TrainState(params, key)
         if epoch_hook is not None:
             epoch_hook(epoch, state)

@@ -41,7 +41,7 @@ from ..ops.loss import cross_entropy
 from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
-from .loop import TrainState, make_eval_step, evaluate
+from .loop import TrainState, epoch_summary, evaluate, make_eval_step
 
 
 def epoch_batch_indices(sampler, batch_size: int) -> np.ndarray:
@@ -233,16 +233,9 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
         params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
         losses = np.asarray(losses)                 # one host fetch per epoch
-        train_loss_ref_unit = float((losses / batch_size).sum())
-        train_mean = float(losses.mean())
-        val_ref_unit, val_mean, val_acc = evaluate(
-            eval_step, params, x_test, y_test, batch_size)
-        dt = time.perf_counter() - t0
-        imgs = losses.size * batch_size
-        log(f"Epoch={epoch}, train_loss={train_loss_ref_unit}, "
-            f"val_loss={val_ref_unit}"
-            f"  [mean_train={train_mean:.4f} mean_val={val_mean:.4f} "
-            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s]")
+        val = evaluate(eval_step, params, x_test, y_test, batch_size)
+        log(epoch_summary(epoch, losses, batch_size, val,
+                          time.perf_counter() - t0))
         state = TrainState(params, key)
         if epoch_hook is not None:
             epoch_hook(epoch, state)
