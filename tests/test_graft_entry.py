@@ -1,0 +1,26 @@
+"""The driver entry contract (__graft_entry__.py): entry() must hand back a
+jittable forward on the flagship model, and dryrun_multichip(n) must compile
+and run the SPMD training programs on an n-device mesh. Locked here so the
+contract can't rot between driver runs (conftest provides the 8-device CPU
+pool the dry run needs)."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_forward_jits():
+    fn, (params, x) = graft.entry()
+    logits = jax.jit(fn)(params, x)
+    assert logits.shape == (x.shape[0], 10)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)  # asserts internally; must not raise
