@@ -1,0 +1,21 @@
+# Developer entry points. The framework has no build step; `native` compiles
+# the optional C++ reader core (ctypes loads it on demand otherwise).
+PY ?= python
+
+.PHONY: test bench native clean convert
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+native:
+	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native
+
+convert:
+	$(PY) -m pytorch_ddp_mnist_tpu.data.convert --synthetic 60000:10000 --out_dir data/
+
+clean:
+	rm -f pytorch_ddp_mnist_tpu/data/native/_reader.so
+	find . -name __pycache__ -type d -exec rm -rf {} +
