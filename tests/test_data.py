@@ -93,3 +93,21 @@ def test_batch_loader_static_shapes_and_coverage():
     for bx, by in batches:
         assert bx.shape == (32, 784) and by.shape == (32,)
         assert by.dtype == np.int32  # uint8 -> int32 cast (SURVEY §7 item 9)
+
+
+def test_device_prefetch_order_and_edges():
+    """device_prefetch must yield every batch, in order, with one batch of
+    lookahead — including the 1-batch and 0-batch edge cases."""
+    import jax
+    from pytorch_ddp_mnist_tpu.data import device_prefetch
+
+    batches = [(np.full((4, 784), i, np.float32), np.full((4,), i, np.int32))
+               for i in range(5)]
+    out = list(device_prefetch(batches))
+    assert len(out) == 5
+    for i, (x, y) in enumerate(out):
+        assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+        assert float(x[0, 0]) == i and int(y[0]) == i
+
+    assert len(list(device_prefetch(batches[:1]))) == 1
+    assert list(device_prefetch([])) == []
