@@ -6,6 +6,16 @@ jax.sharding.Mesh: collectives are emitted by the SPMD partitioner and ride
 ICI within a slice / DCN across slices, instead of a hand-driven process
 group. The mesh is the single topology object the rest of the framework
 consumes — samplers key off its size, train steps shard over its axes.
+
+Topology-aware layout: on real hardware the physical order of devices
+matters — XLA's ring allreduce wants neighbors in the mesh to be neighbors
+on the ICI torus, and on multi-slice/multi-host jobs the slower-varying mesh
+dimension must map to DCN (cross-host network) while faster-varying
+dimensions stay on ICI. `jax.experimental.mesh_utils` owns that mapping
+(`create_device_mesh` consults the TPU coordinates; `create_hybrid_device_mesh`
+factors the mesh into a DCN outer product of per-slice ICI meshes), so we
+delegate to it and keep the plain process-major reshape as the fallback for
+backends mesh_utils cannot introspect.
 """
 
 from __future__ import annotations
@@ -20,20 +30,73 @@ from jax.sharding import Mesh
 DATA_AXIS = "dp"
 
 
+def _topology_device_array(axis_sizes, devices):
+    """Physical-topology-aware device array via mesh_utils, or None.
+
+    Single-granule jobs use `create_device_mesh` (ICI-coordinate ordering on
+    TPU; identity order elsewhere). Jobs spanning multiple processes/slices
+    factor each mesh axis as (DCN granules) x (devices per granule) so that
+    the inter-granule hops land on the slowest-varying stride — SURVEY.md §7
+    step 5's DCN-aware layout.
+    """
+    try:
+        from jax.experimental import mesh_utils
+    except ImportError:
+        return None  # fall back to process-major reshape
+    # The DCN granule must be the SAME unit create_hybrid_device_mesh groups
+    # by: TPU runtimes set slice_index (all chips in one slice share an ICI
+    # torus even across hosts, so a single-slice multi-host pod is NOT a
+    # hybrid topology); backends without slice_index (CPU pods in the
+    # multi-process tests) fall back to process granules.
+    if hasattr(devices[0], "slice_index"):
+        process_is_granule = False
+        n_granules = len({d.slice_index for d in devices})
+    else:
+        process_is_granule = True
+        n_granules = len({getattr(d, "process_index", 0) for d in devices})
+    shape = tuple(axis_sizes)
+    try:
+        if n_granules > 1:
+            # Factor the FIRST axis across granules: dp jobs shard data over
+            # granules first (DCN), then within each granule's chips (ICI).
+            if shape[0] % n_granules != 0:
+                return None
+            dcn_shape = (n_granules,) + (1,) * (len(shape) - 1)
+            ici_shape = (shape[0] // n_granules,) + shape[1:]
+            return mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                process_is_granule=process_is_granule,
+                allow_split_physical_axes=True)
+        return mesh_utils.create_device_mesh(shape, devices=devices,
+                                             allow_split_physical_axes=True)
+    except Exception as e:
+        # A broken topology path must surface, not silently degrade to a
+        # process-major mesh with DCN-crossing ring hops.
+        import warnings
+        warnings.warn(
+            f"mesh_utils topology layout failed ({type(e).__name__}: {e}); "
+            f"falling back to process-major device order", RuntimeWarning)
+        return None
+
+
 def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
               devices=None) -> Mesh:
     """Build a Mesh of the given logical shape over `devices`.
 
-    Devices default to all addressable devices in process-major order
-    (jax.devices()), so on multi-host pods the leading axis naturally maps
-    hosts -> DCN and trailing axes -> ICI, the layout XLA's collectives want.
+    Devices default to all addressable devices; the array layout is chosen by
+    mesh_utils when the backend exposes a physical topology (TPU ICI
+    coordinates, multi-host process granules), falling back to process-major
+    order (jax.devices()) — where the leading axis still maps hosts -> DCN
+    and trailing axes -> ICI, the layout XLA's collectives want.
     """
     devices = list(jax.devices()) if devices is None else list(devices)
     n = int(np.prod(axis_sizes))
     if n != len(devices):
         raise ValueError(
             f"mesh shape {tuple(axis_sizes)} wants {n} devices, have {len(devices)}")
-    dev_array = np.asarray(devices).reshape(tuple(axis_sizes))
+    dev_array = _topology_device_array(axis_sizes, devices)
+    if dev_array is None:
+        dev_array = np.asarray(devices).reshape(tuple(axis_sizes))
     return Mesh(dev_array, tuple(axis_names))
 
 

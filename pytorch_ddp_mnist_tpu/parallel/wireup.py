@@ -38,6 +38,25 @@ def _first_host(nodelist: str) -> str:
     return nodelist.split(",")[0]
 
 
+# The reference's literal --wireup_method spellings (mnist_cpu_mp.py:47-188,
+# mnist_pnetcdf_cpu_mp.py:184-211) accepted verbatim so a reference launch
+# line runs unmodified. `gloo` is the reference's localhost/env fallback
+# branch (mnist_cpu_mp.py:186-188: backend="gloo", init_method='env://');
+# NCCL-vs-gloo is meaningless on TPU (XLA owns the fabric), so each alias
+# resolves to the env-derivation chain its reference branch used.
+METHOD_ALIASES = {
+    "nccl-slurm": "slurm",
+    "nccl-openmpi": "openmpi",
+    "nccl-mpich": "mpich",
+    "gloo": "env",
+}
+
+
+def resolve_method(name: str) -> str:
+    """Canonicalize a wireup method name, accepting reference spellings."""
+    return METHOD_ALIASES.get(name, name)
+
+
 @dataclass
 class Runtime:
     """Process-level topology handle (reference get_rank/get_size/
@@ -76,13 +95,28 @@ class Runtime:
             self.initialized = False
 
 
+def _require(var: str, method: str, launcher: str) -> str:
+    """Fetch a required launcher env var, failing with a named, actionable
+    error like the reference's per-variable raises (mnist_cpu_mp.py:57-89)
+    instead of a bare KeyError."""
+    val = os.environ.get(var)
+    if val is None:
+        raise RuntimeError(
+            f"wireup method {method!r}: required environment variable {var} "
+            f"is not set — it is normally exported by the {launcher} "
+            f"launcher. Launch under {launcher}, or use --wireup_method env "
+            f"with RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT set manually.")
+    return val
+
+
 def _derive(method: str):
     """(rank, size, local_rank, coordinator) from launcher env vars."""
+    method = resolve_method(method)
     env = os.environ
     if method == "slurm":
         # Reference SLURM branch: mnist_cpu_mp.py:47-89.
-        rank = int(env["SLURM_PROCID"])
-        size = int(env["SLURM_NTASKS"])
+        rank = int(_require("SLURM_PROCID", method, "SLURM (srun)"))
+        size = int(_require("SLURM_NTASKS", method, "SLURM (srun)"))
         local = int(env.get("SLURM_LOCALID", 0))
         host = _first_host(env.get("SLURM_STEP_NODELIST",
                                    env.get("SLURM_NODELIST", "127.0.0.1")))
@@ -90,15 +124,15 @@ def _derive(method: str):
         return rank, size, local, f"{host}:{port}"
     if method == "openmpi":
         # Reference PMIx branch: mnist_cpu_mp.py:94-113.
-        rank = int(env["OMPI_COMM_WORLD_RANK"])
-        size = int(env["OMPI_COMM_WORLD_SIZE"])
+        rank = int(_require("OMPI_COMM_WORLD_RANK", method, "Open MPI (mpiexec)"))
+        size = int(_require("OMPI_COMM_WORLD_SIZE", method, "Open MPI (mpiexec)"))
         local = int(env.get("OMPI_COMM_WORLD_LOCAL_RANK", 0))
         coord = f"{env.get('MASTER_ADDR', '127.0.0.1')}:{env.get('MASTER_PORT', '29500')}"
         return rank, size, local, coord
     if method == "mpich":
         # Reference PMI branch: mnist_cpu_mp.py:118-142.
-        rank = int(env["PMI_RANK"])
-        size = int(env["PMI_SIZE"])
+        rank = int(_require("PMI_RANK", method, "MPICH (mpiexec)"))
+        size = int(_require("PMI_SIZE", method, "MPICH (mpiexec)"))
         local = int(env.get("MPI_LOCALRANKID", 0))
         coord = f"{env.get('MASTER_ADDR', '127.0.0.1')}:{env.get('MASTER_PORT', '29500')}"
         return rank, size, local, coord
@@ -153,6 +187,7 @@ def initialize_runtime(method: str = "auto") -> Runtime:
     reference reaches with dist.init_process_group (mnist_cpu_mp.py:92-188).
     """
     _honor_platform_env()
+    method = resolve_method(method)
     if method == "auto":
         method = detect_method()
     if method == "single":

@@ -30,6 +30,10 @@ WIREUP_CHOICES = (
     "mpich",         # reference nccl-mpich / mpich analog (PMI env, :118-142)
     "env",           # reference fallback env:// analog (:147-185)
     "single",        # no distributed init (serial / one-process multi-chip)
+    # The reference's literal spellings, accepted verbatim so its launch
+    # lines run unmodified (mnist_cpu_mp.py:47-188, train_cpu_mp.csh:1);
+    # canonicalized by parallel.wireup.resolve_method at parse time.
+    "nccl-slurm", "nccl-openmpi", "nccl-mpich", "gloo",
 )
 
 
@@ -73,18 +77,22 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "jitted lax.scan program (fastest path for datasets "
                         "that fit on device; multi-process capable)")
     d = p.add_argument_group("data")
-    d.add_argument("--path", type=str, default="data/",
-                   help="dataset root (IDX or NetCDF files)")
+    d.add_argument("--path", "--data_path", type=str, default="data/",
+                   help="dataset root (IDX or NetCDF files); --data_path is "
+                        "the reference spelling (mnist_cpu_mp.py:215)")
     d.add_argument("--netcdf", action="store_true",
                    help="read mnist_{train,test}_images.nc (PnetCDF-path analog)")
-    d.add_argument("--limit", type=int, default=-1,
+    d.add_argument("--limit", "--data_limit", type=int, default=-1,
                    help="truncate dataset to N samples (reference parsed this "
-                        "but never used it; honored here)")
+                        "but never used it; honored here); --data_limit is "
+                        "the reference spelling (mnist_cpu_mp.py:216)")
     d.add_argument("--hdf5", action="store_true",
                    help="dead flag kept for reference-CLI parity")
     d.add_argument("--label_map", type=int, nargs="*", default=None,
                    help="dead key kept for reference-CLI parity")
     a = p.parse_args(argv)
+    from pytorch_ddp_mnist_tpu.parallel.wireup import resolve_method
+    a.wireup_method = resolve_method(a.wireup_method)
     return {
         "trainer": {
             "batch_size": a.batch_size, "n_epochs": a.n_epochs, "lr": a.lr,

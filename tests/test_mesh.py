@@ -1,0 +1,117 @@
+"""Mesh construction: topology-aware layout (SURVEY.md §7 step 5).
+
+The reference has no mesh concept — flat ranks over NCCL (SURVEY.md §5.8).
+Here the Mesh is the topology object; these tests pin down (a) the virtual
+8-device CPU mesh used everywhere else, (b) the DCN-aware hybrid layout:
+when devices span processes/slices, the dp axis must vary slowest across
+granules so the inter-host hops ride DCN while per-host neighbors stay
+contiguous for ICI rings.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_ddp_mnist_tpu.parallel.mesh import (
+    DATA_AXIS, _topology_device_array, data_parallel_mesh, make_mesh)
+
+
+def test_dp_mesh_covers_all_devices():
+    m = data_parallel_mesh()
+    assert m.axis_names == (DATA_AXIS,)
+    assert m.shape[DATA_AXIS] == len(jax.devices())
+    assert sorted(d.id for d in m.devices.flat) == sorted(
+        d.id for d in jax.devices())
+
+
+def test_make_mesh_2d_and_shape_errors():
+    devs = jax.devices()
+    m = make_mesh([2, len(devs) // 2], ["dp", "mp"], devs)
+    assert m.shape == {"dp": 2, "mp": len(devs) // 2}
+    with pytest.raises(ValueError, match="wants"):
+        make_mesh([3], ["dp"], devs[:2])
+
+
+class FakeDev:
+    """Minimal device stand-in carrying the topology attributes mesh_utils
+    reads (process_index / slice_index for granule grouping, id for identity).
+    slice_index is only set when given, mirroring backends without slices."""
+
+    def __init__(self, id, process_index, slice_index=None):
+        self.id = id
+        self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+        self.platform = "cpu"
+        self.device_kind = "cpu"
+        self.client = None
+
+    def __repr__(self):
+        return (f"FakeDev(id={self.id}, proc={self.process_index}, "
+                f"slice={getattr(self, 'slice_index', None)})")
+
+
+def test_hybrid_layout_groups_process_granules():
+    """4 fake processes x 2 devices: the dp axis orders all of process 0's
+    devices before process 1's (contiguous granules), so a dp-sharded batch
+    keeps each host's shard local and cross-host traffic is the slow stride."""
+    devs = [FakeDev(id=p * 2 + k, process_index=p)
+            for p in range(4) for k in range(2)]
+    # shuffle so the test proves layout comes from topology, not input order
+    rng = np.random.RandomState(0)
+    shuffled = [devs[i] for i in rng.permutation(8)]
+    arr = _topology_device_array([8], shuffled)
+    assert arr is not None and arr.shape == (8,)
+    procs = [d.process_index for d in arr.flat]
+    assert procs == [0, 0, 1, 1, 2, 2, 3, 3], procs
+
+
+def test_single_slice_multihost_uses_ici_layout():
+    """v4-32 north-star shape: 4 processes, ONE slice (all 16 chips on one
+    ICI torus). The granule unit must be the slice, not the process — this
+    must NOT take the hybrid path (which would fail its granule-count check
+    and silently fall back before the fix)."""
+    devs = [FakeDev(id=p * 4 + k, process_index=p, slice_index=0)
+            for p in range(4) for k in range(4)]
+    arr = _topology_device_array([16], devs)
+    assert arr is not None and arr.shape == (16,)
+    assert sorted(d.id for d in arr.flat) == list(range(16))
+
+
+def test_multi_slice_groups_by_slice():
+    """2 slices x 2 processes x 2 devices: granules are slices; the dp axis
+    orders slice 0's devices before slice 1's."""
+    devs = [FakeDev(id=s * 4 + p * 2 + k, process_index=s * 2 + p,
+                    slice_index=s)
+            for s in range(2) for p in range(2) for k in range(2)]
+    arr = _topology_device_array([8], devs)
+    assert arr is not None and arr.shape == (8,)
+    slices = [d.slice_index for d in arr.flat]
+    assert slices == [0, 0, 0, 0, 1, 1, 1, 1], slices
+
+
+def test_topology_failure_warns_not_silent():
+    """An unexpected mesh_utils failure surfaces as a RuntimeWarning, not a
+    silent fallback (review finding: bare except hid a granule-count bug)."""
+    # 3 slices cannot tile a dp axis of 8 -> intentional None, no warning
+    devs = [FakeDev(id=i, process_index=i % 3, slice_index=i % 3)
+            for i in range(8)]
+    assert _topology_device_array([8], devs) is None
+    # A failure inside mesh_utils itself warns: 2 slices of UNEQUAL size
+    # (3+5) pass the divisibility pre-check (8 % 2 == 0) but cannot form
+    # 4-device per-granule meshes.
+    bad = [FakeDev(id=i, process_index=0, slice_index=0 if i < 3 else 1)
+           for i in range(8)]
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        assert _topology_device_array([8], bad) is None
+
+
+def test_hybrid_layout_indivisible_falls_back():
+    """dp axis not divisible by granule count -> fall back (None) rather
+    than a bogus hybrid factorization."""
+    devs = [FakeDev(id=i, process_index=i % 3) for i in range(8)]
+    assert _topology_device_array([8], devs) is None
+    # the public API still yields a valid full mesh
+    m = make_mesh([8], ["dp"], devs)
+    assert sorted(d.id for d in m.devices.flat) == list(range(8))

@@ -72,3 +72,46 @@ def test_env_fallback_and_single(monkeypatch):
 def test_unknown_method_raises():
     with pytest.raises(ValueError):
         _derive("nccl")
+
+
+def test_reference_alias_spellings(monkeypatch):
+    """The reference's literal --wireup_method values resolve to our branches
+    (mnist_cpu_mp.py:47-188, mnist_pnetcdf_cpu_mp.py:184-211)."""
+    from pytorch_ddp_mnist_tpu.parallel.wireup import resolve_method
+    assert resolve_method("nccl-slurm") == "slurm"
+    assert resolve_method("nccl-openmpi") == "openmpi"
+    assert resolve_method("nccl-mpich") == "mpich"
+    assert resolve_method("gloo") == "env"
+    assert resolve_method("mpich") == "mpich"
+    assert resolve_method("auto") == "auto"
+
+    # _derive accepts the aliases directly
+    monkeypatch.setenv("SLURM_PROCID", "1")
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    monkeypatch.setenv("SLURM_NODELIST", "n[01-04]")
+    rank, size, _, _ = _derive("nccl-slurm")
+    assert (rank, size) == (1, 4)
+
+    # and the config CLI accepts a reference launch line verbatim
+    from pytorch_ddp_mnist_tpu.train.config import configure
+    cfg = configure(["--parallel", "--wireup_method", "nccl-mpich"])
+    assert cfg["trainer"]["wireup_method"] == "mpich"
+    cfg = configure(["--parallel", "--wireup_method", "gloo"])
+    assert cfg["trainer"]["wireup_method"] == "env"
+
+
+def test_missing_env_named_errors(monkeypatch):
+    """A missing launcher variable raises a named, actionable error (reference
+    raises per-variable, mnist_cpu_mp.py:57-89) — not a bare KeyError."""
+    for k in ("SLURM_PROCID", "SLURM_NTASKS", "OMPI_COMM_WORLD_RANK",
+              "OMPI_COMM_WORLD_SIZE", "PMI_RANK", "PMI_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    with pytest.raises(RuntimeError, match="SLURM_PROCID"):
+        _derive("slurm")
+    with pytest.raises(RuntimeError, match="OMPI_COMM_WORLD_RANK"):
+        _derive("openmpi")
+    with pytest.raises(RuntimeError, match="PMI_RANK"):
+        _derive("mpich")
+    monkeypatch.setenv("PMI_RANK", "0")
+    with pytest.raises(RuntimeError, match="PMI_SIZE"):
+        _derive("mpich")
