@@ -19,7 +19,6 @@ sync), best of 3 windows.
 
 import argparse
 import json
-import time
 
 import numpy as np
 import jax
@@ -96,13 +95,14 @@ def main(argv=None) -> None:
     losses = np.asarray(run_fn(p, k, x_all, y_all, idxs)[2])  # compile + sync
     assert np.isfinite(losses).all()
 
+    from pytorch_ddp_mnist_tpu.utils import Timer
     best = float("inf")
     for _ in range(3):
         p, k = fresh()
-        t0 = time.perf_counter()
-        out = run_fn(p, k, x_all, y_all, idxs)
-        np.asarray(out[2])                       # full fetch = guaranteed sync
-        best = min(best, time.perf_counter() - t0)
+        with Timer("window") as t:
+            out = run_fn(p, k, x_all, y_all, idxs)
+            t.sync(out[2])        # timer exit blocks on the loss curve
+        best = min(best, t.seconds)
 
     imgs = idxs.size  # FUSED_EPOCHS * nbatches * batch
     imgs_per_sec = imgs / best

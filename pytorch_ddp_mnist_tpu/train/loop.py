@@ -37,6 +37,7 @@ from ..ops.loss import cross_entropy, accuracy
 from ..ops.sgd import sgd_step
 from ..data.loader import BatchLoader, device_prefetch
 from ..utils.logging import progress
+from ..utils.profiling import CumulativeTimer
 
 
 @dataclass
@@ -103,6 +104,8 @@ def evaluate(eval_step, params, x_test, y_test, batch_size: int):
     RNG-dependent there; deterministic sequential order is used here.
     """
     n = x_test.shape[0]
+    # jnp.asarray is a no-op for device-resident arrays; fit() hoists the
+    # test set to device ONCE so repeated evaluate() calls do no H2D.
     per_sample, correct = eval_step(
         params, jnp.asarray(x_test), jnp.asarray(y_test))
     per_sample = np.asarray(per_sample, np.float64)   # one host fetch
@@ -116,23 +119,29 @@ def evaluate(eval_step, params, x_test, y_test, batch_size: int):
 
 
 def epoch_summary(epoch: int, losses: np.ndarray, batch_size: int,
-                  val: tuple, dt: float) -> str:
+                  val: tuple, dt: float,
+                  io_seconds: float | None = None) -> str:
     """The reference epoch line (ddp_tutorial_multi_gpu.py:116) + extensions.
 
     `losses` are the epoch's per-batch mean losses; `val` is evaluate()'s
     (ref_unit, mean, acc) triple. train_loss keeps the reference accumulator
     unit Σ(batch_mean/B) (SURVEY.md §5.5 quirk); mean/acc/throughput are the
     added diagnostics. Shared by the streaming and epoch-scanned trainers so
-    the two paths can never drift in format or units.
+    the two paths can never drift in format or units. `io_seconds` (streaming
+    path only) reports the host time spent waiting on the data loader — the
+    I/O-vs-compute split the reference's ancestral harness was built to
+    measure (SURVEY.md §5.1).
     """
     val_ref_unit, val_mean, val_acc = val
     train_loss_ref_unit = float((losses / batch_size).sum())
     imgs = losses.size * batch_size
+    io = (f" io={io_seconds:.2f}s/{100 * io_seconds / dt:.0f}%"
+          if io_seconds is not None else "")
     return (f"Epoch={epoch}, train_loss={train_loss_ref_unit}, "
             f"val_loss={val_ref_unit}"
             f"  [mean_train={float(losses.mean()):.4f} "
             f"mean_val={val_mean:.4f} "
-            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s]")
+            f"acc={val_acc:.4f} {imgs / dt:.0f} img/s{io}]")
 
 
 def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
@@ -153,20 +162,34 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         raise ValueError("pass exactly one of lr= or train_step=")
     step = train_step if train_step is not None else make_train_step(lr)
     eval_step = make_eval_step()
+    # Hoist the test set to device ONCE — the reference re-materializes its
+    # test tensors per batch per epoch (ddp_tutorial_multi_gpu.py:105-106);
+    # repeating jnp.asarray inside the epoch loop would re-upload ~31 MB of
+    # MNIST per epoch for no reason.
+    x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
     for epoch in range(epochs):
         t0 = time.perf_counter()
+        io_timer = CumulativeTimer("loader-wait")
         train_loader.sampler.set_epoch(epoch)
         losses = []
-        for x, y in progress(
-                device_prefetch(train_loader, sharding=sharding, put=put),
-                desc=f"epoch {epoch}"):
+        batches = progress(
+            device_prefetch(train_loader, sharding=sharding, put=put),
+            desc=f"epoch {epoch}")
+        it = iter(batches)
+        while True:
+            with io_timer:   # host time blocked on the data pipeline
+                batch = next(it, None)
+            if batch is None:
+                break
+            x, y = batch
             params, key, loss = step(params, key, x, y)
             losses.append(loss)
         losses = np.asarray(jnp.stack(losses))  # single host fetch per epoch
-        val = evaluate(eval_step, params, x_test, y_test, batch_size)
+        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size)
         log(epoch_summary(epoch, losses, batch_size, val,
-                          time.perf_counter() - t0))
+                          time.perf_counter() - t0,
+                          io_seconds=io_timer.total))
         state = TrainState(params, key)
         if epoch_hook is not None:
             epoch_hook(epoch, state)

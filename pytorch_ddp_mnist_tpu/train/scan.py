@@ -223,6 +223,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         idx_sharding = None
 
     eval_step = make_eval_step()
+    # Test set to device once, not per epoch (mirrors loop.fit's hoist).
+    x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
     for epoch in range(epochs):
         t0 = time.perf_counter()
@@ -233,7 +235,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
         params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
         losses = np.asarray(losses)                 # one host fetch per epoch
-        val = evaluate(eval_step, params, x_test, y_test, batch_size)
+        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size)
         log(epoch_summary(epoch, losses, batch_size, val,
                           time.perf_counter() - t0))
         state = TrainState(params, key)

@@ -38,6 +38,30 @@ def test_fit_reduces_loss_and_prints_reference_format():
     assert len(lines) == 3
     # Reference epoch line prefix: "Epoch=i, train_loss=…, val_loss=…"
     assert re.match(r"Epoch=0, train_loss=[\d.]+, val_loss=[\d.]+", lines[0])
+    # streaming path reports the loader-wait split (SURVEY.md §5.1 capability)
+    assert re.search(r"io=[\d.]+s/\d+%", lines[0])
+
+
+def test_fit_hoists_test_set_to_device_once(monkeypatch):
+    """evaluate() must receive device-resident test arrays so no per-epoch
+    H2D happens (VERDICT r1 weak #7)."""
+    import jax.numpy as jnp
+    import pytorch_ddp_mnist_tpu.train.loop as loop_mod
+    loader, x_test, y_test = _setup(128, 64)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(0))
+    seen = []
+    real_evaluate = loop_mod.evaluate
+
+    def spy(eval_step, params, x, y, bs):
+        seen.append((type(x), type(y)))
+        return real_evaluate(eval_step, params, x, y, bs)
+
+    monkeypatch.setattr(loop_mod, "evaluate", spy)
+    fit(state, loader, x_test, y_test, epochs=2, lr=0.01, batch_size=64,
+        log=lambda s: None)
+    assert len(seen) == 2
+    for tx, ty in seen:
+        assert issubclass(tx, jax.Array) and issubclass(ty, jax.Array)
 
 
 def test_checkpoint_round_trip(tmp_path):
