@@ -48,6 +48,7 @@ def main(argv=None) -> int:
     train_step = None
     put = None
     mesh = None
+    runtime = None
     if tcfg["parallel"]:
         from ..parallel.wireup import initialize_runtime
         from ..parallel.ddp import (make_dp_train_step, dp_mesh,
@@ -107,8 +108,28 @@ def main(argv=None) -> int:
                                         rank=process_index, shuffle=True,
                                         seed=42)
     else:
-        train = get_mnist(dcfg["path"], train=True)
-        test = get_mnist(dcfg["path"], train=False)
+        # Multi-process: rank 0 downloads (when asked) BEFORE anyone probes
+        # the path, then a barrier releases the other processes to read the
+        # same files — otherwise non-zero ranks would race the fetch and
+        # silently land on the synthetic fallback while rank 0 trains on
+        # real MNIST. Single-process: get_mnist handles the probe order.
+        if dcfg["download"] and num_processes > 1:
+            if process_index == 0:
+                from ..data.download import download_mnist
+                try:
+                    download_mnist(dcfg["path"])
+                except Exception as e:  # noqa: BLE001 — rank 0 MUST reach
+                    # the barrier below or every other rank hangs in it;
+                    # any failure (mirrors, checksums, unwritable --path)
+                    # degrades to the synthetic fallback on all ranks.
+                    print(f"[data] MNIST download failed ({e}); synthetic "
+                          f"fallback will be used")
+            runtime.barrier()
+        # Every rank passes the real flag: a successful rank-0 fetch
+        # short-circuits on checksum (no refetch); a failed one yields an
+        # accurate per-rank message instead of a contradictory hint.
+        train = get_mnist(dcfg["path"], train=True, download=dcfg["download"])
+        test = get_mnist(dcfg["path"], train=False, download=dcfg["download"])
         if dcfg["limit"] and dcfg["limit"] > 0:
             train.images = train.images[:dcfg["limit"]]
             train.labels = train.labels[:dcfg["limit"]]

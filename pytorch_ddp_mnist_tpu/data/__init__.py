@@ -8,10 +8,12 @@ from .idx import read_idx, write_idx
 from .mnist import (MNIST_MEAN, MNIST_STD, Split, get_mnist, load_mnist,
                     normalize_images, synthetic_mnist)
 from .loader import BatchLoader, NetCDFShardLoader, device_prefetch
+from .download import DownloadError, download_file, download_mnist
 
 __all__ = [
     "read_idx", "write_idx",
     "MNIST_MEAN", "MNIST_STD", "Split", "get_mnist", "load_mnist",
     "normalize_images", "synthetic_mnist",
     "BatchLoader", "NetCDFShardLoader", "device_prefetch",
+    "DownloadError", "download_file", "download_mnist",
 ]

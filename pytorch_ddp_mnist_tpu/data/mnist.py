@@ -88,19 +88,34 @@ def synthetic_mnist(n: int, seed: int = 0) -> Split:
 
 
 def get_mnist(root: str, train: bool = True, *, synthetic_n: int | None = None,
-              quiet: bool = False) -> Split:
-    """Load a split from disk, falling back to synthetic data.
+              quiet: bool = False, download: bool = False) -> Split:
+    """Load a split from disk, optionally downloading, falling back to
+    synthetic data.
 
-    The reference downloads MNIST on first use (datasets.MNIST(download=True),
-    ddp_tutorial_cpu.py:22); this environment has no egress, so the fallback
-    is a generated dataset of the canonical split size (60k/10k) unless
-    `synthetic_n` overrides it.
+    Probe order mirrors the reference's acquisition chain
+    (datasets.MNIST(download=True), ddp_tutorial_cpu.py:22): files on disk
+    win; `download=True` then fetches the real IDX artifacts from the public
+    mirrors (data/download.py, checksum-verified); zero-egress environments
+    land on the generated stand-in of the canonical split size (60k/10k,
+    `synthetic_n` overrides) so every config still runs end-to-end.
     """
     split = load_mnist(root, train)
     if split is not None:
         return split
+    if download:
+        from .download import DownloadError, download_mnist
+        try:
+            download_mnist(root, quiet=quiet)
+            split = load_mnist(root, train)
+            if split is not None:
+                return split
+        except DownloadError as e:
+            if not quiet:
+                print(f"[data] MNIST download failed ({e}); "
+                      f"falling back to synthetic data")
     n = synthetic_n if synthetic_n is not None else (60000 if train else 10000)
     if not quiet:
+        hint = "" if download else " (pass --download to fetch real MNIST)"
         print(f"[data] no MNIST IDX files under {root!r}; using synthetic "
-              f"{'train' if train else 'test'} split of {n} samples")
+              f"{'train' if train else 'test'} split of {n} samples{hint}")
     return synthetic_mnist(n, seed=0 if train else 1)

@@ -82,6 +82,11 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "the reference spelling (mnist_cpu_mp.py:215)")
     d.add_argument("--netcdf", action="store_true",
                    help="read mnist_{train,test}_images.nc (PnetCDF-path analog)")
+    d.add_argument("--download", action="store_true",
+                   help="fetch real MNIST IDX files (checksum-verified "
+                        "mirrors) when absent from --path — the "
+                        "datasets.MNIST(download=True) analog "
+                        "(ddp_tutorial_cpu.py:22)")
     d.add_argument("--limit", "--data_limit", type=int, default=-1,
                    help="truncate dataset to N samples (reference parsed this "
                         "but never used it; honored here); --data_limit is "
@@ -104,6 +109,6 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,
-            "hdf5": a.hdf5, "label_map": a.label_map,
+            "download": a.download, "hdf5": a.hdf5, "label_map": a.label_map,
         },
     }
