@@ -18,7 +18,9 @@ import sys
 
 # Single source of truth for the run config — the golden replay in
 # test_multiprocess.py imports these, so worker and golden cannot drift.
-HPARAMS = dict(n=512, local_batch=32, steps=5, lr=0.05,
+# n must satisfy n/WORLD >= steps*local_batch so no step sees an empty
+# shard slice (the worker asserts it).
+HPARAMS = dict(n=1024, local_batch=32, steps=5, lr=0.05,
                data_seed=0, sampler_seed=42, param_seed=0, key_seed=1)
 
 
@@ -56,6 +58,8 @@ def main() -> int:
     losses = []
     for s in range(steps):
         rows = shard[s * local_batch:(s + 1) * local_batch]
+        assert len(rows) == local_batch, \
+            f"shard exhausted at step {s}: raise HPARAMS['n']"
         gx, gy = global_batch_from_local(mesh, (x_all[rows], y_all[rows]))
         params, key, loss = step(params, key, gx, gy)
         losses.append(float(loss))
