@@ -27,6 +27,39 @@ NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
 FUSED_EPOCHS = 50
 
 
+def _stream_bench(a) -> None:
+    """NetCDF streaming-loader throughput: gather + normalize of a full
+    shuffled 60k-row epoch from disk (the mnist_pnetcdf_cpu_mp.py data
+    plane), no device work — isolates the I/O path bench'd in docs/PERF.md."""
+    import os
+    import tempfile
+
+    from pytorch_ddp_mnist_tpu.data.convert import main as convert_main
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.utils import Timer
+
+    with tempfile.TemporaryDirectory() as td:
+        convert_main(["--synthetic", "60000:16", "--out_dir", td])
+        ldr = NetCDFShardLoader(os.path.join(td, "mnist_train_images.nc"),
+                                batch_size=128, num_workers=a.num_workers)
+        ldr.sampler = ShardedSampler(60000, num_replicas=1, rank=0,
+                                     shuffle=True, seed=42)
+        best, n = float("inf"), 0
+        for trial in range(4):  # trial 0 warms the page cache
+            ldr.sampler.set_epoch(trial)
+            with Timer("epoch") as t:
+                n = sum(len(x) for x, _ in ldr)
+            if trial:
+                best = min(best, t.seconds)
+        print(json.dumps({
+            "metric": "mnist_netcdf_stream_images_per_sec",
+            "value": round(n / best, 1),
+            "unit": "images/sec",
+            "vs_baseline": round((n / best) / NOMINAL_BASELINE_IMGS_PER_SEC, 4),
+        }))
+
+
 def main(argv=None) -> None:
     # Variant flags (benchmark experiments; the driver's default run is the
     # flagship float32/XLA/threefry config and prints the same single line).
@@ -39,9 +72,18 @@ def main(argv=None) -> None:
                    help="PRNG engine carried by the train key (dropout "
                         "stream); rbg uses the TPU hardware generator")
     p.add_argument("--epochs", type=int, default=FUSED_EPOCHS)
+    p.add_argument("--mode", choices=("train", "stream"), default="train",
+                   help="train: the flagship device-train metric (driver "
+                        "default); stream: NetCDF disk-streaming loader "
+                        "throughput (the PnetCDF-path data plane)")
+    p.add_argument("--num_workers", type=int, default=0,
+                   help="stream mode: readahead threads")
     a = p.parse_args(argv)
     if a.epochs < 1:
         p.error("--epochs must be >= 1")
+
+    if a.mode == "stream":
+        return _stream_bench(a)
 
     # An explicit JAX_PLATFORMS in the env wins over any backend the site
     # startup pre-registered (e.g. run the bench on CPU while the TPU tunnel

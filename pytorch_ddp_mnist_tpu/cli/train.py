@@ -100,8 +100,9 @@ def main(argv=None) -> int:
         test_images, test_labels = read_mnist_netcdf(test_nc)
         x_test = normalize_images(test_images)
         test_labels = test_labels.astype(np.int32)
-        loader = NetCDFShardLoader(train_nc, batch_size=local_batch)
-        n_train = loader.num_samples  # one header parse; sampler bound below
+        loader = NetCDFShardLoader(train_nc, batch_size=local_batch,
+                                   num_workers=tcfg["num_workers"])
+        n_train = loader.num_samples  # header parse + label cache; sampler below
         if dcfg["limit"] and dcfg["limit"] > 0:
             n_train = min(n_train, dcfg["limit"])
         loader.sampler = ShardedSampler(n_train, num_replicas=num_processes,

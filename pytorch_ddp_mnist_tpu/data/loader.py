@@ -76,12 +76,28 @@ class NetCDFShardLoader:
 
     `sampler` may be None at construction (so `num_samples` can be read to
     size the sampler first) but must be assigned before iterating.
+
+    `num_workers > 0` enables readahead: that many threads gather+normalize
+    upcoming batches into bounded queues while the consumer trains — the
+    capability of the reference's persistent DataLoader workers
+    (mnist_pnetcdf_cpu.py:58-60), as threads instead of forked processes
+    (the reference itself must force num_workers=0 in its DDP variant
+    because MPI handles can't fork, mnist_pnetcdf_cpu_mp.py:396-401; threads
+    sidestep that entirely). Batch order is identical to the synchronous
+    path: worker w produces batches w, w+N, ... and the consumer round-
+    robins the queues.
+
+    Labels are cached whole at construction (one coalesced pread of n bytes
+    — the serial reference's collective label read, mnist_pnetcdf_cpu.py:47);
+    per-batch disk work is the image gather only.
     """
 
-    def __init__(self, path: str, sampler=None, *, batch_size: int):
+    def __init__(self, path: str, sampler=None, *, batch_size: int,
+                 num_workers: int = 0):
         self.path = path
         self.sampler = sampler
         self.batch_size = int(batch_size)
+        self.num_workers = int(num_workers)
         from .native import NativeReader, native_available
         if native_available():
             self._reader = NativeReader(path)
@@ -94,15 +110,67 @@ class NetCDFShardLoader:
                  if isinstance(self._reader.variables["images"], tuple)
                  else self._reader.variables["images"].shape)
         self.num_samples = int(shape[0])
+        self._labels = self._read(
+            "labels", np.arange(self.num_samples, dtype=np.int64))
 
     def __len__(self) -> int:
         return math.ceil(len(self.sampler) / self.batch_size)
 
+    def _load(self, b: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        images = self._read("images", b)
+        return normalize_images(images), self._labels[b].astype(np.int32)
+
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        for b in _batched_indices(self.sampler, self.batch_size):
-            images = self._read("images", b)
-            labels = self._read("labels", b)
-            yield normalize_images(images), labels.astype(np.int32)
+        batches = list(_batched_indices(self.sampler, self.batch_size))
+        if self.num_workers <= 0 or len(batches) <= 1:
+            for b in batches:
+                yield self._load(b)
+            return
+        yield from self._iter_readahead(batches)
+
+    def _iter_readahead(self, batches):
+        """N worker threads, bounded queues, strict batch order."""
+        import queue
+        import threading
+
+        nw = min(self.num_workers, len(batches))
+        qs = [queue.Queue(maxsize=2) for _ in range(nw)]
+        stop = threading.Event()
+
+        def work(w: int) -> None:
+            try:
+                for i in range(w, len(batches), nw):
+                    item = self._load(batches[i])
+                    while not stop.is_set():
+                        try:
+                            qs[w].put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # propagate into the consumer
+                qs[w].put(e)
+
+        threads = [threading.Thread(target=work, args=(w,), daemon=True)
+                   for w in range(nw)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                item = qs[i % nw].get()
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            for q in qs:  # unblock any worker parked in put()
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+            for t in threads:
+                t.join(timeout=5)
 
 
 def device_prefetch(loader, sharding=None,

@@ -38,9 +38,19 @@ class Split:
 
 
 def normalize_images(images: np.ndarray) -> np.ndarray:
-    """uint8 (n, H, W) -> float32 (n, H*W), the reference transform + flatten."""
-    x = np.asarray(images, np.float32) / 255.0
-    x = (x - MNIST_MEAN) / MNIST_STD
+    """uint8 (n, H, W) -> float32 (n, H*W), the reference transform + flatten.
+
+    Computed in place on one float32 buffer — bit-identical to the naive
+    `((x/255) - mean)/std` temporary chain (same ops, same order) but
+    without materializing three n*784*4-byte temporaries, which dominated
+    the streaming data path's CPU profile at 60k-row scale.
+    """
+    x = np.asarray(images, np.float32)
+    if x is images:  # never mutate a caller's float array in place
+        x = x.copy()
+    x /= 255.0
+    x -= MNIST_MEAN
+    x /= MNIST_STD
     return x.reshape(x.shape[0], -1)
 
 

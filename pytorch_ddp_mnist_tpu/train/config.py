@@ -51,8 +51,10 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                    help="data-parallel over the device mesh (DDP analog)")
     t.add_argument("--wireup_method", choices=WIREUP_CHOICES, default="auto")
     t.add_argument("--num_workers", type=int, default=0,
-                   help="accepted for reference-CLI parity; the prefetch "
-                        "loader is async without worker processes")
+                   help="readahead threads for the --netcdf streaming loader "
+                        "(the reference's DataLoader worker count, "
+                        "mnist_pnetcdf_cpu.py:58-60); the in-memory path is "
+                        "async via device prefetch regardless")
     t.add_argument("--device", type=int, default=0,
                    help="reference-CLI parity (per-rank device ordinal); "
                         "device placement is mesh-driven on TPU")

@@ -1,0 +1,77 @@
+"""Run the full bench variant matrix and print a markdown table + MFU.
+
+Each variant is one `bench.py` invocation (fresh process — fresh compile
+cache namespace, no cross-variant state). Usage:
+
+    python scripts/bench_matrix.py            # all variants on the default backend
+    python scripts/bench_matrix.py --quick    # fewer fused epochs (CI smoke)
+
+The MFU estimate uses the analytic FLOPs of the train step (see docs/PERF.md:
+fwd 118,016 MACs/img; backward adds ~2x for the dgrad+wgrad pairs) against a
+v5e bf16 peak of 197 TFLOP/s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+# (label, extra argv) — every combination that composes semantically.
+VARIANTS = [
+    ("f32 / XLA / threefry (flagship)", []),
+    ("f32 / Pallas fused step", ["--kernel", "pallas"]),
+    ("bf16 / XLA", ["--dtype", "bfloat16"]),
+    ("f32 / XLA / rbg PRNG", ["--impl", "rbg"]),
+    ("bf16 / XLA / rbg", ["--dtype", "bfloat16", "--impl", "rbg"]),
+    ("f32 / Pallas / rbg", ["--kernel", "pallas", "--impl", "rbg"]),
+]
+
+MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016
+FLOPS_PER_IMG = 3 * 2 * MACS_FWD_PER_IMG                  # fwd + ~2x bwd
+V5E_PEAK_BF16 = 197e12
+
+
+def run_variant(argv, epochs: int):
+    cmd = [sys.executable, "bench.py", "--epochs", str(epochs)] + argv
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        return None, ["timeout after 1200s"]
+    if out.returncode != 0:
+        return None, (out.stderr or out.stdout).strip().splitlines()[-1:]
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    return (json.loads(line[-1]) if line else None), None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true", help="5 fused epochs")
+    p.add_argument("--epochs", type=int, default=None)
+    a = p.parse_args(argv)
+    epochs = a.epochs if a.epochs else (5 if a.quick else 50)
+
+    rows = []
+    for label, extra in VARIANTS:
+        rec, err = run_variant(extra, epochs)
+        if rec is None:
+            print(f"  {label}: FAILED {err}", file=sys.stderr)
+            rows.append((label, None))
+            continue
+        rows.append((label, rec["value"]))
+        print(f"  {label}: {rec['value']:,.0f} img/s/chip", file=sys.stderr)
+
+    print("\n| Variant | images/sec/chip | TFLOP/s | MFU (vs 197T bf16 peak) |")
+    print("|---|---|---|---|")
+    for label, v in rows:
+        if v is None:
+            print(f"| {label} | (failed) | — | — |")
+            continue
+        tf = v * FLOPS_PER_IMG / 1e12
+        print(f"| {label} | {v:,.0f} | {tf:.2f} | {100 * tf * 1e12 / V5E_PEAK_BF16:.2f}% |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,7 +111,7 @@ def _run_world(argv, extra_env=None, timeout=240, attempts=3, *,
 
 
 def _golden_worker_run():
-    """Single-process replay of mp_worker.py's training on a 2-device mesh.
+    """Single-process replay of mp_worker.py's training on a WORLD-device mesh.
 
     Device d of the golden mesh sees exactly the rows process d loaded in the
     distributed run (make_array_from_process_local_data lays process shards

@@ -130,3 +130,77 @@ def test_netcdf_shard_loader_matches_in_memory(tmp_path):
         np.testing.assert_allclose(mx, dx, rtol=1e-6)
         np.testing.assert_array_equal(my, dy)
         assert dy.dtype == np.int32
+
+
+def test_netcdf_shard_loader_readahead_parity(tmp_path):
+    """num_workers>0 must yield bit-identical batches in identical order to
+    the synchronous path, across epoch reshuffles."""
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+
+    split = synthetic_mnist(200, seed=3)
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    sync = NetCDFShardLoader(path, batch_size=16, num_workers=0)
+    ahead = NetCDFShardLoader(path, batch_size=16, num_workers=3)
+    for ldr in (sync, ahead):
+        ldr.sampler = ShardedSampler(200, num_replicas=1, rank=0, seed=42)
+    for epoch in (0, 1):
+        sync.sampler.set_epoch(epoch)
+        ahead.sampler.set_epoch(epoch)
+        pairs = list(zip(sync, ahead))
+        assert len(pairs) == len(sync)
+        for (sx, sy), (ax, ay) in pairs:
+            np.testing.assert_array_equal(sx, ax)
+            np.testing.assert_array_equal(sy, ay)
+
+
+def test_netcdf_shard_loader_readahead_overlaps(tmp_path):
+    """With a busy consumer, readahead workers hide the load time: the
+    overlapped run must beat the synchronous run (VERDICT r1 item 4
+    done-condition). Sleeps release the GIL, so even a 1-CPU host overlaps."""
+    import time
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+
+    split = synthetic_mnist(160, seed=5)
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    delay = 0.02
+
+    def timed_run(nw):
+        ldr = NetCDFShardLoader(path, batch_size=16, num_workers=nw)
+        ldr.sampler = ShardedSampler(160, num_replicas=1, rank=0, seed=42)
+        ldr.sampler.set_epoch(0)
+        orig = ldr._load
+        ldr._load = lambda b: (time.sleep(delay), orig(b))[1]  # slow "disk"
+        t0 = time.perf_counter()
+        n = 0
+        for x, y in ldr:
+            time.sleep(delay)  # busy "train step"
+            n += 1
+        assert n == 10
+        return time.perf_counter() - t0
+
+    t_sync = timed_run(0)       # ~10*(delay_load + delay_step) = 0.4s
+    t_overlap = timed_run(2)    # loads hidden behind steps: ~0.2s + slack
+    assert t_overlap < 0.8 * t_sync, (t_sync, t_overlap)
+
+
+def test_netcdf_shard_loader_worker_exception_propagates(tmp_path):
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+
+    split = synthetic_mnist(64, seed=9)
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    ldr = NetCDFShardLoader(path, batch_size=16, num_workers=2)
+    ldr.sampler = ShardedSampler(64, num_replicas=1, rank=0, seed=42)
+    ldr.sampler.set_epoch(0)
+
+    def boom(b):
+        raise RuntimeError("disk exploded")
+
+    ldr._load = boom
+    with pytest.raises(RuntimeError, match="disk exploded"):
+        list(ldr)
