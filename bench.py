@@ -91,7 +91,7 @@ def main(argv=None) -> None:
     from pytorch_ddp_mnist_tpu.parallel.wireup import _honor_platform_env
     _honor_platform_env()
 
-    from pytorch_ddp_mnist_tpu.data import synthetic_mnist, normalize_images
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
     from pytorch_ddp_mnist_tpu.models import init_mlp
     from pytorch_ddp_mnist_tpu.parallel import ShardedSampler, data_parallel_mesh
     from pytorch_ddp_mnist_tpu.parallel.ddp import replicated
@@ -106,7 +106,11 @@ def main(argv=None) -> None:
     batch = per_chip_batch * n_chips
 
     split = synthetic_mnist(60000, seed=0)
-    x_all = jax.device_put(normalize_images(split.images), replicated(mesh))
+    # uint8-resident dataset: 47 MB in HBM instead of 188 MB, 4x less HBM
+    # read per batch gather; the scan body normalizes on device
+    # (train/scan.py _gathered_x — same math as the host normalize).
+    from pytorch_ddp_mnist_tpu.train.scan import resident_images
+    x_all = jax.device_put(resident_images(split.images), replicated(mesh))
     y_all = jax.device_put(split.labels.astype(np.int32), replicated(mesh))
 
     sampler = ShardedSampler(60000, num_replicas=1, rank=0, seed=42)

@@ -134,13 +134,16 @@ def main(argv=None) -> int:
         if dcfg["limit"] and dcfg["limit"] > 0:
             train.images = train.images[:dcfg["limit"]]
             train.labels = train.labels[:dcfg["limit"]]
-        x_train = normalize_images(train.images)
         x_test = normalize_images(test.images)
         test_labels = test.labels.astype(np.int32)
-        sampler = ShardedSampler(len(train), num_replicas=num_processes,
-                                 rank=process_index, shuffle=True, seed=42)
-        loader = BatchLoader(x_train, train.labels, sampler,
-                             batch_size=local_batch)
+        if not tcfg["cached"]:
+            # The streaming loop's loader; --cached instead hands raw uint8
+            # images to fit_cached below (no full-dataset host normalize).
+            sampler = ShardedSampler(len(train), num_replicas=num_processes,
+                                     rank=process_index, shuffle=True,
+                                     seed=42)
+            loader = BatchLoader(normalize_images(train.images), train.labels,
+                                 sampler, batch_size=local_batch)
 
     state = TrainState(init_mlp(jax.random.key(tcfg["seed"])),
                        jax.random.key(tcfg["seed"] + 1))
@@ -181,15 +184,17 @@ def main(argv=None) -> int:
             rows = (None if n_train == loader.num_samples
                     else np.arange(n_train))
             images, labels = read_mnist_netcdf(train_nc, rows)
-            x_train = normalize_images(images)
             y_train = labels.astype(np.int32)
         else:
             n_train = len(train)
+            images = train.images
             y_train = train.labels.astype(np.int32)
+        # Raw uint8 pixels go to HBM; the scan normalizes per gather
+        # (train/scan.py resident_images — 4x less HBM than resident f32).
         sampler = ShardedSampler(n_train, num_replicas=1, rank=0,
                                  shuffle=True, seed=42)
         with trace(tcfg["profile"]):
-            state = fit_cached(state, x_train, y_train, sampler, x_test,
+            state = fit_cached(state, images, y_train, sampler, x_test,
                                test_labels, epochs=tcfg["n_epochs"],
                                batch_size=global_batch, lr=tcfg["lr"],
                                mesh=mesh, dtype=tcfg["dtype"],

@@ -110,8 +110,7 @@ class NetCDFShardLoader:
                  if isinstance(self._reader.variables["images"], tuple)
                  else self._reader.variables["images"].shape)
         self.num_samples = int(shape[0])
-        self._labels = self._read(
-            "labels", np.arange(self.num_samples, dtype=np.int64))
+        self._labels = self._read("labels")  # whole-variable coalesced read
 
     def __len__(self) -> int:
         return math.ceil(len(self.sampler) / self.batch_size)

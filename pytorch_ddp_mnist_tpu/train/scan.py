@@ -36,12 +36,45 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from ..data.mnist import MNIST_MEAN, MNIST_STD
 from ..models.mlp import mlp_apply
 from ..ops.loss import cross_entropy
 from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
 from .loop import TrainState, epoch_summary, evaluate, make_eval_step
+
+
+def _gathered_x(x_all, batch_idx, compute_dt):
+    """Gather a batch from the resident dataset, normalizing on device when
+    the dataset is uint8-resident.
+
+    Storing raw uint8 pixels in HBM instead of normalized float32 cuts the
+    dataset footprint and the per-step gather's HBM read 4x (the scan step is
+    bandwidth/latency-bound, not MXU-bound — docs/PERF.md). The device
+    normalize replays normalize_images' op chain in float32 — the gathered
+    batch is mathematically identical to one from a host-normalized array;
+    XLA may fuse/reorder the chain into neighbors, so downstream values can
+    differ at float-rounding level (like any recompilation), never in
+    distribution or algorithm.
+    """
+    x = jnp.take(x_all, batch_idx, axis=0)
+    if x.dtype == jnp.uint8:
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        x = x / jnp.float32(255.0)
+        x = x - jnp.float32(MNIST_MEAN)
+        x = x / jnp.float32(MNIST_STD)
+    return x.astype(compute_dt)
+
+
+def resident_images(images: np.ndarray) -> np.ndarray:
+    """Host-side prep of the HBM-resident dataset: raw uint8 stays uint8
+    (flattened — normalization happens on device per gather); anything else
+    is assumed pre-normalized float32."""
+    arr = np.asarray(images)
+    if arr.dtype == np.uint8:
+        return np.ascontiguousarray(arr.reshape(arr.shape[0], -1))
+    return np.asarray(arr, np.float32)
 
 
 def epoch_batch_indices(sampler, batch_size: int) -> np.ndarray:
@@ -85,7 +118,7 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
     def body(carry, batch_idx, x_all, y_all):
         params, key = carry
         key, sub = jax.random.split(key)
-        x = jnp.take(x_all, batch_idx, axis=0).astype(compute_dt)
+        x = _gathered_x(x_all, batch_idx, compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
         loss, grads = _loss_and_grads(params, x, y, sub, kernel, interpret)
         return (sgd_step(params, grads, lr), key), loss
@@ -109,7 +142,7 @@ def _dp_step_body(x_all, y_all, me, lr, compute_dt, kernel="xla",
         params, key = carry
         key, sub = jax.random.split(key)
         rkey = jax.random.fold_in(sub, me)
-        x = jnp.take(x_all, batch_idx, axis=0).astype(compute_dt)
+        x = _gathered_x(x_all, batch_idx, compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
         loss, grads = _loss_and_grads(params, x, y, rkey, kernel, interpret)
         grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
@@ -210,13 +243,13 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         # process holds the (tiny) dataset and the same host-side sampler
         # state, and contributes its devices' shards.
         from ..parallel.ddp import replicate_state
-        x_all = replicate_state(mesh, np.asarray(x_train, np.float32))
+        x_all = replicate_state(mesh, resident_images(x_train))
         y_all = replicate_state(mesh, np.asarray(y_train, np.int32))
         epoch_fn = make_dp_epoch_fn(mesh, lr, dtype=dtype, kernel=kernel,
                                     interpret=interpret)
         idx_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     else:
-        x_all = jax.device_put(np.asarray(x_train, np.float32))
+        x_all = jax.device_put(resident_images(x_train))
         y_all = jax.device_put(np.asarray(y_train, np.int32))
         epoch_fn = make_epoch_fn(lr, dtype=dtype, kernel=kernel,
                                  interpret=interpret)

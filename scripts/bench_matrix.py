@@ -50,7 +50,9 @@ def main(argv=None) -> int:
     p.add_argument("--quick", action="store_true", help="5 fused epochs")
     p.add_argument("--epochs", type=int, default=None)
     a = p.parse_args(argv)
-    epochs = a.epochs if a.epochs else (5 if a.quick else 50)
+    epochs = a.epochs if a.epochs is not None else (5 if a.quick else 50)
+    if epochs < 1:
+        p.error("--epochs must be >= 1")
 
     rows = []
     for label, extra in VARIANTS:

@@ -80,6 +80,41 @@ def test_large_sharded_gather_threads(tmp_path):
         np.testing.assert_array_equal(r.read("d", idx), data[idx])
 
 
+def test_concurrent_gathers_share_the_pool(tmp_path):
+    """Multiple Python threads issuing pool-qualifying gathers at once (the
+    readahead-worker pattern; the GIL is released inside the ctypes call).
+    Pool::run is serialized across callers — results must be bit-exact."""
+    import threading
+
+    n, row = 1024, 784
+    data = (np.arange(n * row, dtype=np.int64) % 251).astype(np.uint8)
+    data = data.reshape(n, row)
+    path = str(tmp_path / "c.nc")
+    write_netcdf(path, {"n": n, "r": row}, {"d": (("n", "r"), data)})
+    rng = np.random.default_rng(7)
+    idxs = [rng.permutation(n)[:512] for _ in range(8)]
+    results = [None] * len(idxs)
+    errors = []
+
+    with NativeReader(path) as r:
+        def work(k):
+            try:
+                for _ in range(5):
+                    results[k] = r.read("d", idxs[k])
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(k,))
+                   for k in range(len(idxs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+    for k, out in enumerate(results):
+        np.testing.assert_array_equal(out, data[idxs[k]])
+
+
 def test_errors(tmp_path, split):
     path = str(tmp_path / "m.nc")
     write_mnist_netcdf(path, split.images, split.labels)

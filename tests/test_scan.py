@@ -131,6 +131,54 @@ def test_dp_run_fn_matches_per_epoch_calls():
     np.testing.assert_allclose(np.asarray(fused), np.stack(seq), rtol=2e-5)
 
 
+def test_uint8_resident_dataset_matches_f32():
+    """The HBM-resident uint8 dataset (device-side normalize per gather)
+    must reproduce the host-normalized f32 dataset to float-rounding level
+    (same math; XLA may fuse the normalize chain differently) — serially and
+    on the DP mesh."""
+    from pytorch_ddp_mnist_tpu.train.scan import resident_images, make_dp_run_fn
+    from pytorch_ddp_mnist_tpu.parallel.ddp import replicated
+    from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    train = synthetic_mnist(256, seed=0)
+    x_f32 = normalize_images(train.images)
+    x_u8 = resident_images(train.images)
+    assert x_u8.dtype == np.uint8 and x_u8.shape == (256, 784)
+    y = train.labels.astype(np.int32)
+    s = ShardedSampler(256, num_replicas=1, rank=0)
+    s.set_epoch(0)
+    idx = epoch_batch_indices(s, 64)
+
+    fn = make_epoch_fn(0.05)
+    out = {}
+    for name, x_all in (("f32", x_f32), ("u8", x_u8)):
+        p, k, losses = fn(init_mlp(jax.random.key(0)), jax.random.key(7),
+                          jnp.asarray(x_all), jnp.asarray(y), idx)
+        out[name] = (p, np.asarray(losses))
+    np.testing.assert_allclose(out["f32"][1], out["u8"][1],
+                               rtol=1e-6, atol=1e-7)
+    for a, b in zip(jax.tree_util.tree_leaves(out["f32"][0]),
+                    jax.tree_util.tree_leaves(out["u8"][0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+    mesh = make_mesh([4], ["dp"], jax.devices()[:4])
+    rep = replicated(mesh)
+    shard = NamedSharding(mesh, P(None, None, "dp"))
+    dp = make_dp_run_fn(mesh, 0.05)
+    dp_out = {}
+    for name, x_all in (("f32", x_f32), ("u8", x_u8)):
+        p, k, losses = dp(jax.device_put(init_mlp(jax.random.key(0)), rep),
+                          jax.device_put(jax.random.key(7), rep),
+                          jax.device_put(x_all, rep),
+                          jax.device_put(y, rep),
+                          jax.device_put(idx[None], shard))
+        dp_out[name] = np.asarray(losses)
+    np.testing.assert_allclose(dp_out["f32"], dp_out["u8"],
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_scan_pallas_kernel_matches_xla_kernel():
     """The scanned Pallas body must reproduce the scanned XLA body exactly
     (same dropout stream, interpreter math) — serial and DP variants."""
