@@ -20,9 +20,10 @@ reference-format epoch line. The DP variant runs the scan inside
 its replica's rows from the replicated dataset — no collective), gradients
 are `pmean`ed per step exactly like the streaming DP step.
 
-Scale note: this mode replicates the dataset in HBM (MNIST: 188 MB fp32),
-the right call at the reference's scale; the streaming loaders remain the
-path for datasets that don't fit.
+Scale note: this mode replicates the dataset in HBM — raw uint8 pixels
+(MNIST: ~47 MB; `resident_images`), normalized on device per gather — the
+right call at the reference's scale; the streaming loaders remain the path
+for datasets that don't fit.
 """
 
 from __future__ import annotations
