@@ -35,6 +35,8 @@ def main(argv=None) -> int:
     if use_pallas and tcfg["dtype"] != "float32":
         raise SystemExit("--kernel pallas computes in float32 "
                          "(MXU accumulation); drop --dtype bfloat16")
+    if tcfg["fused"] and not tcfg["cached"]:
+        raise SystemExit("--fused fuses the epoch scan; add --cached")
 
     def _pallas_interpret() -> bool:
         # The kernel needs Mosaic (TPU — incl. the axon plugin, which
@@ -162,7 +164,9 @@ def main(argv=None) -> int:
     # Epoch-granular checkpointing (added capability — the reference saves
     # only once, after training, ddp_tutorial_multi_gpu.py:143-144; rank-0
     # gating matches it). Atomic overwrite, so preemption at epoch k resumes
-    # from k-1 via --resume.
+    # from k-1 via --resume. Exception: --fused replays hooks after the
+    # whole-run program finishes, so mid-run preemption leaves no
+    # intermediate checkpoint (documented on the flag).
     hook = None
     if process_index == 0 and tcfg["checkpoint"]:
         hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
@@ -200,6 +204,7 @@ def main(argv=None) -> int:
                                mesh=mesh, dtype=tcfg["dtype"],
                                kernel=tcfg["kernel"],
                                interpret=use_pallas and _pallas_interpret(),
+                               fused=tcfg["fused"],
                                log=log, epoch_hook=hook)
     else:
         with trace(tcfg["profile"]):

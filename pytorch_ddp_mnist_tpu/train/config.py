@@ -78,6 +78,13 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                    help="cache the dataset in HBM and run each epoch as one "
                         "jitted lax.scan program (fastest path for datasets "
                         "that fit on device; multi-process capable)")
+    t.add_argument("--fused", action="store_true",
+                   help="with --cached: run ALL epochs as ONE device program "
+                        "(the bench.py path); per-epoch lines/checkpoints "
+                        "replay from on-device snapshots AFTER the run — "
+                        "fastest, but preemption mid-run leaves no "
+                        "intermediate checkpoint (use plain --cached for "
+                        "epoch-granular preemption resilience)")
     d = p.add_argument_group("data")
     d.add_argument("--path", "--data_path", type=str, default="data/",
                    help="dataset root (IDX or NetCDF files); --data_path is "
@@ -106,8 +113,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "seed": a.seed, "parallel": a.parallel,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
-            "dtype": a.dtype, "cached": a.cached, "profile": a.profile,
-            "kernel": a.kernel,
+            "dtype": a.dtype, "cached": a.cached, "fused": a.fused,
+            "profile": a.profile, "kernel": a.kernel,
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,
