@@ -171,6 +171,18 @@ def test_cli_train_download_end_to_end(mirror, tmp_path, monkeypatch, capsys):
     assert "Epoch=0" in out
 
 
+def test_download_module_cli(mirror, tmp_path, monkeypatch):
+    """python -m pytorch_ddp_mnist_tpu.data.download --root <dir>"""
+    url, manifest = mirror
+    import pytorch_ddp_mnist_tpu.data.download as dl_mod
+    monkeypatch.setattr(dl_mod, "MIRRORS", (url,))
+    monkeypatch.setattr(dl_mod, "FILES", manifest)
+    dest = tmp_path / "root"
+    assert dl_mod.main(["--root", str(dest)]) == 0
+    for name in manifest:
+        assert (dest / name).exists()
+
+
 def test_real_manifest_and_mirrors_shape():
     """The production manifest lists the four canonical artifacts with
     32-hex digests, and mirror URLs are well-formed."""
