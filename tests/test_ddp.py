@@ -13,7 +13,7 @@ import pytest
 from pytorch_ddp_mnist_tpu.models import init_mlp, mlp_apply
 from pytorch_ddp_mnist_tpu.ops import cross_entropy, sgd_step
 from pytorch_ddp_mnist_tpu.parallel.ddp import (
-    make_dp_train_step, batch_sharding, replicated, dp_mesh)
+    make_dp_train_step, batch_sharding, replicated)
 from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
 
 

@@ -26,7 +26,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 import jax
 

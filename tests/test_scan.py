@@ -231,7 +231,7 @@ def test_scan_pallas_kernel_matches_xla_kernel():
     """The scanned Pallas body must reproduce the scanned XLA body exactly
     (same dropout stream, interpreter math) — serial and DP variants."""
     from pytorch_ddp_mnist_tpu.train.scan import make_epoch_fn, make_dp_run_fn
-    from pytorch_ddp_mnist_tpu.parallel.ddp import replicated, batch_sharding
+    from pytorch_ddp_mnist_tpu.parallel.ddp import replicated
     from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -2,11 +2,10 @@
 (mnist_cpu_mp.py:41-191). Single-process here; the true multi-process
 rendezvous is exercised by tests/test_multiprocess.py."""
 
-import numpy as np
 import pytest
 
 from pytorch_ddp_mnist_tpu.parallel.wireup import (
-    Runtime, _derive, _first_host, detect_method, initialize_runtime)
+    _derive, _first_host, detect_method, initialize_runtime)
 
 
 def test_first_host_parsing():
