@@ -1,0 +1,44 @@
+"""bench.py driver contract: ONE JSON line with the agreed schema, in both
+modes. The driver parses exactly this output on real hardware after every
+round (BENCH_r{N}.json), so the contract is load-bearing."""
+
+import json
+import os
+import subprocess
+import sys
+
+# The bench must run on the host backend here: the suite's virtual-CPU
+# setup (conftest) is in-process only, and a spawned bench would otherwise
+# grab a possibly-absent TPU tunnel.
+ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+           XLA_FLAGS="--xla_force_host_platform_device_count=1")
+
+
+def _run(args):
+    out = subprocess.run([sys.executable, "bench.py"] + args, env=ENV,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {out.stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_train_mode_contract():
+    rec = _run(["--epochs", "1"])
+    assert rec["metric"] == "mnist_train_images_per_sec_per_chip"
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+
+
+def test_stream_mode_contract():
+    rec = _run(["--mode", "stream"])
+    assert rec["metric"] == "mnist_netcdf_stream_images_per_sec"
+    assert rec["unit"] == "images/sec"
+    assert rec["value"] > 0
+
+
+def test_epochs_validation():
+    out = subprocess.run([sys.executable, "bench.py", "--epochs", "0"],
+                         env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0
+    assert "--epochs" in out.stderr
