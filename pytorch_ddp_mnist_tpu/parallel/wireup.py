@@ -147,7 +147,13 @@ def _derive(method: str):
 
 
 def detect_method() -> str:
-    """Probe launcher env — the reference picks via CLI; 'auto' adds detection."""
+    """Probe launcher env — the reference picks via CLI; 'auto' adds detection.
+
+    Scheduler launchers (SLURM/MPI — explicit rank/size env) win over the
+    Cloud TPU pod markers: a job srun/mpiexec'd ONTO TPU VMs should follow
+    the launcher's topology, matching the reference's precedence of explicit
+    wireup choices.
+    """
     env = os.environ
     if "SLURM_PROCID" in env and "SLURM_NTASKS" in env:
         return "slurm"
@@ -157,6 +163,12 @@ def detect_method() -> str:
         return "mpich"
     if "RANK" in env and "WORLD_SIZE" in env:
         return "env"
+    # Cloud TPU pod: only when the runtime metadata names MULTIPLE workers —
+    # single-host TPU sessions also export TPU_WORKER_HOSTNAMES (one entry)
+    # and need no rendezvous. Explicit --wireup_method tpu remains available.
+    hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(hosts) > 1:
+        return "tpu"
     return "single"
 
 
@@ -192,6 +204,29 @@ def initialize_runtime(method: str = "auto") -> Runtime:
         method = detect_method()
     if method == "single":
         return Runtime(method="single")
+    if method == "tpu":
+        # Cloud TPU pod: no env-var maze at all — the TPU runtime's metadata
+        # (worker hostnames, task id) IS the topology, and
+        # jax.distributed.initialize() autodetects it. This is the path a
+        # bare multi-host TPU VM job takes with no scheduler in front
+        # (SURVEY.md §7 step 3's GCE-metadata analog of the reference's
+        # MASTER_ADDR derivation chains).
+        import jax
+        hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES",
+                                           "").split(",") if h]
+        if len(hosts) > 1:
+            # Rendezvous blocks until every pod worker joins — say so, and
+            # name the escape hatch for a lone interactive process.
+            print(f"wireup tpu: joining {len(hosts)}-worker pod rendezvous "
+                  f"(every worker must run this job; use --wireup_method "
+                  f"single for a one-process session)", flush=True)
+        jax.distributed.initialize()
+        # initialized tracks whether initialize() was CALLED (finalize must
+        # shut the client down even for a 1-process init, or a later
+        # initialize in this process raises 'already initialized').
+        return Runtime(method="tpu", rank=jax.process_index(),
+                       size=jax.process_count(),
+                       local_rank=0, coordinator=None, initialized=True)
     rank, size, local, coord = _derive(method)
     rt = Runtime(method=method, rank=rank, size=size, local_rank=local,
                  coordinator=coord)

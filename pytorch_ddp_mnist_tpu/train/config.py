@@ -29,6 +29,7 @@ WIREUP_CHOICES = (
     "openmpi",       # reference nccl-openmpi analog (PMIx env, :94-113)
     "mpich",         # reference nccl-mpich / mpich analog (PMI env, :118-142)
     "env",           # reference fallback env:// analog (:147-185)
+    "tpu",           # Cloud TPU pod metadata autodetection (no env maze)
     "single",        # no distributed init (serial / one-process multi-chip)
     # The reference's literal spellings, accepted verbatim so its launch
     # lines run unmodified (mnist_cpu_mp.py:47-188, train_cpu_mp.csh:1);

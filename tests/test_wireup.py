@@ -51,7 +51,7 @@ def test_mpich_derivation(monkeypatch):
 
 def test_env_fallback_and_single(monkeypatch):
     for k in ("SLURM_PROCID", "SLURM_NTASKS", "OMPI_COMM_WORLD_RANK",
-              "PMI_RANK", "RANK", "WORLD_SIZE"):
+              "PMI_RANK", "RANK", "WORLD_SIZE", "TPU_WORKER_HOSTNAMES"):
         monkeypatch.delenv(k, raising=False)
     assert detect_method() == "single"
     rt = initialize_runtime("auto")
@@ -97,6 +97,26 @@ def test_reference_alias_spellings(monkeypatch):
     assert cfg["trainer"]["wireup_method"] == "mpich"
     cfg = configure(["--parallel", "--wireup_method", "gloo"])
     assert cfg["trainer"]["wireup_method"] == "env"
+
+
+def test_tpu_pod_detection(monkeypatch):
+    """MULTI-worker Cloud TPU pod metadata detects as 'tpu'; a single-worker
+    hostname list (every TPU VM exports one) does NOT; explicit scheduler
+    env wins (a job srun'd onto TPU VMs follows the launcher)."""
+    for k in ("SLURM_PROCID", "SLURM_NTASKS", "OMPI_COMM_WORLD_RANK",
+              "PMI_RANK", "RANK", "WORLD_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0")
+    assert detect_method() == "single"
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1")
+    assert detect_method() == "tpu"
+    monkeypatch.setenv("SLURM_PROCID", "0")
+    monkeypatch.setenv("SLURM_NTASKS", "2")
+    assert detect_method() == "slurm"
+    # the CLI accepts the method name
+    from pytorch_ddp_mnist_tpu.train.config import configure
+    cfg = configure(["--parallel", "--wireup_method", "tpu"])
+    assert cfg["trainer"]["wireup_method"] == "tpu"
 
 
 def test_missing_env_named_errors(monkeypatch):
