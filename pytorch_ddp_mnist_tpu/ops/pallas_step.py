@@ -194,6 +194,11 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
     loss, gw1, gb1, gw2, gb2, gw3 = pl.pallas_call(
         _make_fused_kernel(batch, block),
         grid=(grid,),
+        # The gradient outputs accumulate across grid steps, so the batch
+        # grid MUST run sequentially — 'arbitrary' pins that down even on
+        # megacore parts (v4/v5p) where 'parallel' dims split across cores.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         out_shape=out_shapes,
         in_specs=[
             vmem((block, IN_DIM), lambda i: (i, 0)),             # x
