@@ -11,6 +11,9 @@ ddp_tutorial_multi_gpu.py does per rank, with full DDP semantics
 Measured path = the framework's epoch-scanned trainer (train/scan.py) with
 MULTIPLE epochs fused into one device program: the dataset lives in HBM,
 batch gathers/dropout/fwd/bwd/allreduce/SGD all run under a nested lax.scan.
+Default variant on TPU = the fused Pallas train-step kernel + rbg (hardware)
+PRNG dropout stream — the fastest semantics-preserving configuration of the
+round-2 variant matrix (docs/PERF.md); --kernel/--impl select the others.
 Fusing epochs removes host<->device round-trips from the measurement — on a
 tunneled/remote TPU a per-epoch sync costs ~70ms of RTT that says nothing
 about the hardware. Timing = full fetch of the loss curve (a guaranteed
@@ -61,17 +64,28 @@ def _stream_bench(a) -> None:
 
 
 def main(argv=None) -> None:
-    # Variant flags (benchmark experiments; the driver's default run is the
-    # flagship float32/XLA/threefry config and prints the same single line).
+    # Variant flags. The driver's flagless run resolves to the fastest
+    # measured variant (Pallas + rbg on TPU — docs/PERF.md matrix); explicit
+    # flags select the others, e.g. the reference-RNG-semantics
+    # --kernel xla --impl threefry2x32.
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--kernel", choices=("xla", "pallas"), default="xla")
+    p.add_argument("--kernel", choices=("auto", "xla", "pallas"),
+                   default="auto",
+                   help="auto (default): the fused Pallas step on TPU, XLA "
+                        "autodiff elsewhere (Pallas off-TPU would run in the "
+                        "slow interpreter)")
     p.add_argument("--dtype", choices=("float32", "bfloat16"),
                    default="float32")
-    p.add_argument("--impl", choices=("threefry2x32", "rbg"),
-                   default="threefry2x32",
+    p.add_argument("--impl", choices=("threefry2x32", "rbg"), default="rbg",
                    help="PRNG engine carried by the train key (dropout "
-                        "stream); rbg uses the TPU hardware generator")
+                        "stream); rbg (default) uses the TPU hardware "
+                        "generator — measured 1.7x the whole-step rate vs "
+                        "threefry key-derivation (docs/PERF.md)")
     p.add_argument("--epochs", type=int, default=FUSED_EPOCHS)
+    p.add_argument("--unroll", type=int, default=1,
+                   help="unroll factor for the per-step scan; measured "
+                        "SLOWER than 1 at 2/4/8 (docs/PERF.md) — kept for "
+                        "reproducing that negative result")
     p.add_argument("--mode", choices=("train", "stream"), default="train",
                    help="train: the flagship device-train metric (driver "
                         "default); stream: NetCDF disk-streaming loader "
@@ -121,12 +135,17 @@ def main(argv=None) -> None:
     idxs = jax.device_put(np.stack(idxs),
                           NamedSharding(mesh, P(None, None, DATA_AXIS)))
 
-    # Pallas needs Mosaic (TPU); interpret on CPU so every variant runs
-    # everywhere (same fallback as the trainer CLI).
-    interpret = (a.kernel == "pallas"
-                 and jax.default_backend() not in ("tpu", "axon"))
+    # Pallas needs Mosaic (TPU); `auto` resolves to it exactly there, and an
+    # explicit --kernel pallas elsewhere runs interpreted so every variant
+    # runs everywhere (same fallback as the trainer CLI).
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if a.kernel == "auto":
+        # Pallas computes in f32 (scan._check_kernel), so a bf16 sweep
+        # auto-resolves to the XLA kernel rather than erroring.
+        a.kernel = "pallas" if on_tpu and a.dtype == "float32" else "xla"
+    interpret = a.kernel == "pallas" and not on_tpu
     run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype, kernel=a.kernel,
-                            interpret=interpret)
+                            interpret=interpret, unroll=a.unroll)
     params_host = jax.tree_util.tree_map(np.asarray, init_mlp(jax.random.key(0)))
     key_host = np.asarray(jax.random.key_data(
         jax.random.key(1, impl=a.impl)))

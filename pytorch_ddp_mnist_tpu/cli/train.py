@@ -38,6 +38,18 @@ def main(argv=None) -> int:
     if tcfg["fused"] and not tcfg["cached"]:
         raise SystemExit("--fused fuses the epoch scan; add --cached")
 
+    # .pt/.pth checkpoint paths need torch — fail BEFORE training, not after
+    # a completed run's first save (which would lose the trained params).
+    from ..train.checkpoint import is_torch_path
+    if any(p and is_torch_path(p)
+           for p in (tcfg["resume"], tcfg["checkpoint"])):
+        try:
+            import torch  # noqa: F401
+        except ImportError:
+            raise SystemExit(
+                "a .pt/.pth checkpoint path requires torch (not installed); "
+                "use a .msgpack path for the torch-free format")
+
     def _pallas_interpret() -> bool:
         # The kernel needs Mosaic (TPU — incl. the axon plugin, which
         # aliases the tpu lowering rules); on CPU backends fall back to the

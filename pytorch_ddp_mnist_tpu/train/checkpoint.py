@@ -10,6 +10,14 @@ Added capability beyond the reference (which has no load path at all): a
 matching `load_checkpoint`, so checkpoints are actually usable, and an
 epoch-granular resume hook in the CLI. Format: flax msgpack serialization of
 the params pytree — single file, byte-stable, no torch dependency.
+
+Torch interop: a `.pt`/`.pth` path switches both functions to the reference's
+own checkpoint format — a torch state_dict with the exact key names the
+reference's nn.Sequential produces ('0.weight', '0.bias', '3.weight',
+'3.bias', '5.weight'; ddp_tutorial_cpu.py:45-51). A file we save loads into
+the reference model with `model.load_state_dict(torch.load('model.pt'))`,
+and a reference-produced `model.pt` seeds our trainer via `--resume` — the
+two frameworks' checkpoints are interchangeable.
 """
 
 from __future__ import annotations
@@ -20,18 +28,91 @@ import jax
 import numpy as np
 from flax import serialization
 
+# Our pytree layer -> the reference nn.Sequential's state_dict key stem
+# (ddp_tutorial_cpu.py:45-51: Linear at indices 0, 3, 5; fc3 has no bias).
+_TORCH_STEMS = (("fc1", "0"), ("fc2", "3"), ("fc3", "5"))
+
+
+def is_torch_path(path: str) -> bool:
+    """True if `path` selects the torch state_dict checkpoint format."""
+    return path.endswith((".pt", ".pth"))
+
+
+_is_torch_path = is_torch_path
+
+
+def params_to_torch_state_dict(params):
+    """Params pytree -> the reference model's state_dict (torch tensors).
+
+    Weights transpose from our (fan_in, fan_out) x@w layout to torch Linear's
+    (out, in)."""
+    import torch
+    # copies: jax gives read-only host buffers; torch wants writable memory
+    host = jax.tree_util.tree_map(lambda a: np.array(a, np.float32), params)
+    sd = {}
+    for ours, stem in _TORCH_STEMS:
+        sd[f"{stem}.weight"] = torch.from_numpy(
+            np.ascontiguousarray(host[ours]["w"].T))
+        if "b" in host[ours]:
+            sd[f"{stem}.bias"] = torch.from_numpy(host[ours]["b"])
+    return sd
+
+
+def params_from_torch_state_dict(sd):
+    """The reference model's state_dict (torch tensors or ndarrays) -> params
+    pytree, transposing weights back to (fan_in, fan_out)."""
+    def _np(v):
+        return v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+
+    params = {}
+    for ours, stem in _TORCH_STEMS:
+        layer = {"w": np.ascontiguousarray(_np(sd[f"{stem}.weight"]).T)}
+        if f"{stem}.bias" in sd:
+            layer["b"] = _np(sd[f"{stem}.bias"])
+        params[ours] = layer
+    return params
+
 
 def save_checkpoint(path: str, params) -> None:
-    """Serialize a params pytree to `path` (msgpack). Fully fetches to host."""
-    host_params = jax.tree_util.tree_map(np.asarray, params)
-    data = serialization.to_bytes(host_params)
+    """Serialize a params pytree to `path`. Fully fetches to host.
+
+    `.pt`/`.pth` -> reference-compatible torch state_dict; otherwise msgpack."""
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
+    if _is_torch_path(path):
+        import torch
+        torch.save(params_to_torch_state_dict(params), tmp)
+    else:
+        host_params = jax.tree_util.tree_map(np.asarray, params)
+        with open(tmp, "wb") as f:
+            f.write(serialization.to_bytes(host_params))
     os.replace(tmp, path)  # atomic: no torn checkpoint on crash
 
 
 def load_checkpoint(path: str, template):
-    """Restore a params pytree from `path` using `template` for structure."""
+    """Restore a params pytree from `path` using `template` for structure.
+
+    `.pt`/`.pth` -> read a torch state_dict (ours or one the reference's
+    `torch.save(model.state_dict(), 'model.pt')` wrote)."""
+    if _is_torch_path(path):
+        import torch
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        params = params_from_torch_state_dict(sd)
+        # Validate against the template like the msgpack branch does
+        # (structure/shape mismatches should fail HERE with a named error,
+        # not as an opaque XLA error mid-train).
+        if (jax.tree_util.tree_structure(params)
+                != jax.tree_util.tree_structure(template)):
+            raise ValueError(
+                f"{path}: checkpoint layer structure "
+                f"{jax.tree_util.tree_structure(params)} does not match the "
+                f"model's {jax.tree_util.tree_structure(template)}")
+        got = jax.tree_util.tree_leaves_with_path(params)
+        want = jax.tree_util.tree_leaves(template)
+        for (kp, have), exp in zip(got, want):
+            if np.shape(have) != np.shape(exp):
+                raise ValueError(
+                    f"{path}: checkpoint param {jax.tree_util.keystr(kp)} "
+                    f"has shape {np.shape(have)}, expected {np.shape(exp)}")
+        return params
     with open(path, "rb") as f:
         return serialization.from_bytes(template, f.read())

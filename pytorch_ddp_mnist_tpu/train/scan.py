@@ -127,9 +127,17 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
 
 
 def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
-                interpret: bool = False, snapshots: bool = False) -> Callable:
+                interpret: bool = False, snapshots: bool = False,
+                unroll: int = 1) -> Callable:
     """Serial analog of make_dp_run_fn: the whole E-epoch run as ONE jitted
-    nested-scan program, optionally with per-epoch params snapshots."""
+    nested-scan program, optionally with per-epoch params snapshots.
+
+    `unroll` unrolls the inner (per-step) scan body: the steps stay strictly
+    sequential (each SGD update feeds the next); XLA emits `unroll` step
+    bodies per loop iteration. Measured on hardware this is a NEGATIVE
+    result — 10-27% slower than unroll=1 on both kernels (docs/PERF.md:
+    loop bookkeeping is not the bottleneck, and the longer body schedules
+    worse). The knob exists to reproduce that measurement."""
     _check_kernel(kernel, dtype)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
@@ -146,7 +154,7 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
         step = partial(body, x_all=x_all, y_all=y_all)
 
         def epoch(carry, idx_e):
-            carry, losses = jax.lax.scan(step, carry, idx_e)
+            carry, losses = jax.lax.scan(step, carry, idx_e, unroll=unroll)
             return carry, ((losses, carry) if snapshots else losses)
 
         (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
@@ -204,7 +212,7 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
 
 def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                    kernel: str = "xla", interpret: bool = False,
-                   snapshots: bool = False) -> Callable:
+                   snapshots: bool = False, unroll: int = 1) -> Callable:
     """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
     (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
     sharded on the batch dim.
@@ -238,7 +246,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                              kernel=kernel, interpret=interpret)
 
         def epoch(carry, idx_e):
-            carry, losses = jax.lax.scan(body, carry, idx_e)
+            carry, losses = jax.lax.scan(body, carry, idx_e, unroll=unroll)
             out = (losses, carry) if snapshots else losses
             return carry, out
 

@@ -19,13 +19,20 @@ import subprocess
 import sys
 
 # (label, extra argv) — every combination that composes semantically.
+# Flags are explicit (never `auto`) so the matrix measures the same variant
+# on any backend regardless of bench.py's auto-resolution.
 VARIANTS = [
-    ("f32 / XLA / threefry (flagship)", []),
-    ("f32 / Pallas fused step", ["--kernel", "pallas"]),
-    ("bf16 / XLA", ["--dtype", "bfloat16"]),
-    ("f32 / XLA / rbg PRNG", ["--impl", "rbg"]),
-    ("bf16 / XLA / rbg", ["--dtype", "bfloat16", "--impl", "rbg"]),
-    ("f32 / Pallas / rbg", ["--kernel", "pallas", "--impl", "rbg"]),
+    ("f32 / XLA / threefry (reference semantics)",
+     ["--kernel", "xla", "--impl", "threefry2x32"]),
+    ("f32 / Pallas / threefry",
+     ["--kernel", "pallas", "--impl", "threefry2x32"]),
+    ("bf16 / XLA / threefry",
+     ["--kernel", "xla", "--dtype", "bfloat16", "--impl", "threefry2x32"]),
+    ("f32 / XLA / rbg", ["--kernel", "xla", "--impl", "rbg"]),
+    ("bf16 / XLA / rbg",
+     ["--kernel", "xla", "--dtype", "bfloat16", "--impl", "rbg"]),
+    ("f32 / Pallas / rbg (bench default on TPU)",
+     ["--kernel", "pallas", "--impl", "rbg"]),
 ]
 
 MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016

@@ -6,6 +6,7 @@ import re
 
 import jax
 import numpy as np
+import pytest
 
 from pytorch_ddp_mnist_tpu.data import synthetic_mnist, normalize_images, BatchLoader
 from pytorch_ddp_mnist_tpu.models import init_mlp
@@ -73,6 +74,103 @@ def test_checkpoint_round_trip(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(params),
                     jax.tree_util.tree_leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_torch_checkpoint_round_trip(tmp_path):
+    """A .pt path writes/reads the reference's torch state_dict format."""
+    pytest.importorskip("torch")
+    params = init_mlp(jax.random.key(7))
+    path = str(tmp_path / "model.pt")
+    save_checkpoint(path, params)
+    restored = load_checkpoint(path, init_mlp(jax.random.key(8)))
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_torch_checkpoint_loads_into_reference_model(tmp_path):
+    """The .pt file we save must be consumable by the reference consumer:
+    `model.load_state_dict(torch.load('model.pt'))` on the reference's own
+    nn.Sequential graph (ddp_tutorial_cpu.py:45-51), strict=True, with
+    matching forward logits."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+    from pytorch_ddp_mnist_tpu.models import mlp_apply
+
+    params = init_mlp(jax.random.key(11))
+    path = str(tmp_path / "model.pt")
+    save_checkpoint(path, params)
+
+    model = nn.Sequential(
+        nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
+        nn.Linear(128, 128), nn.ReLU(),
+        nn.Linear(128, 10, bias=False))
+    model.load_state_dict(torch.load(path, weights_only=True), strict=True)
+    model.eval()
+
+    x = np.random.default_rng(0).normal(size=(32, 784)).astype(np.float32)
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(x)).numpy()
+    ours = np.asarray(mlp_apply(params, jax.numpy.asarray(x), train=False))
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_resume_from_reference_produced_model_pt(tmp_path):
+    """The reverse direction: a model.pt written the reference's way
+    (torch.save(model.state_dict(), ...), ddp_tutorial_multi_gpu.py:143-144)
+    seeds our params pytree."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    torch.manual_seed(3)
+    model = nn.Sequential(
+        nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
+        nn.Linear(128, 128), nn.ReLU(),
+        nn.Linear(128, 10, bias=False))
+    path = str(tmp_path / "model.pt")
+    torch.save(model.state_dict(), path)
+
+    params = load_checkpoint(path, init_mlp(jax.random.key(0)))
+    sd = model.state_dict()
+    np.testing.assert_allclose(np.asarray(params["fc1"]["w"]),
+                               sd["0.weight"].numpy().T)
+    np.testing.assert_allclose(np.asarray(params["fc2"]["b"]),
+                               sd["3.bias"].numpy())
+    np.testing.assert_allclose(np.asarray(params["fc3"]["w"]),
+                               sd["5.weight"].numpy().T)
+    assert "b" not in params["fc3"]  # output layer is bias-free
+
+
+def test_torch_checkpoint_shape_mismatch_fails_at_load(tmp_path):
+    """A wrong-shape model.pt (e.g. hidden=64 variant) must fail AT LOAD with
+    a named error, not later as an opaque XLA shape error."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    model = nn.Sequential(
+        nn.Linear(784, 64), nn.ReLU(), nn.Dropout(0.2),
+        nn.Linear(64, 64), nn.ReLU(), nn.Linear(64, 10, bias=False))
+    path = str(tmp_path / "model.pt")
+    torch.save(model.state_dict(), path)
+
+    with pytest.raises(ValueError, match=r"fc1.*shape"):
+        load_checkpoint(path, init_mlp(jax.random.key(0)))
+
+
+def test_torch_checkpoint_structure_mismatch_fails_at_load(tmp_path):
+    """A state_dict whose layer structure differs (output layer WITH bias)
+    must fail at load with a structure error, not misattribute shapes."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    model = nn.Sequential(
+        nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
+        nn.Linear(128, 128), nn.ReLU(), nn.Linear(128, 10, bias=True))
+    path = str(tmp_path / "model.pt")
+    torch.save(model.state_dict(), path)
+
+    with pytest.raises(ValueError, match="structure"):
+        load_checkpoint(path, init_mlp(jax.random.key(0)))
 
 
 def test_epoch_hook_called_each_epoch():
