@@ -30,6 +30,13 @@ NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
 FUSED_EPOCHS = 50
 
 
+def resolve_kernel(dtype: str, on_tpu: bool) -> str:
+    """`--kernel auto`: fused Pallas step on TPU (fastest measured variant),
+    XLA autodiff elsewhere (interpreter-only) — and for bf16 anywhere, since
+    the Pallas kernel computes in f32 (scan._check_kernel would reject it)."""
+    return "pallas" if on_tpu and dtype == "float32" else "xla"
+
+
 def _stream_bench(a) -> None:
     """NetCDF streaming-loader throughput: gather + normalize of a full
     shuffled 60k-row epoch from disk (the mnist_pnetcdf_cpu_mp.py data
@@ -82,6 +89,10 @@ def main(argv=None) -> None:
                         "generator — measured 1.7x the whole-step rate vs "
                         "threefry key-derivation (docs/PERF.md)")
     p.add_argument("--epochs", type=int, default=FUSED_EPOCHS)
+    p.add_argument("--batch_size", type=int, default=128,
+                   help="PER-CHIP batch (the reference flagship is 128; "
+                        "larger values measure throughput scaling — the "
+                        "gridded Pallas kernel handles any size)")
     p.add_argument("--unroll", type=int, default=1,
                    help="unroll factor for the per-step scan; measured "
                         "SLOWER than 1 at 2/4/8 (docs/PERF.md) — kept for "
@@ -95,6 +106,8 @@ def main(argv=None) -> None:
     a = p.parse_args(argv)
     if a.epochs < 1:
         p.error("--epochs must be >= 1")
+    if a.batch_size < 1:
+        p.error("--batch_size must be >= 1")
 
     if a.mode == "stream":
         return _stream_bench(a)
@@ -117,7 +130,7 @@ def main(argv=None) -> None:
 
     mesh = data_parallel_mesh()
     n_chips = mesh.devices.size
-    per_chip_batch = 128
+    per_chip_batch = a.batch_size
     batch = per_chip_batch * n_chips
 
     split = synthetic_mnist(60000, seed=0)
@@ -140,9 +153,7 @@ def main(argv=None) -> None:
     # runs everywhere (same fallback as the trainer CLI).
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if a.kernel == "auto":
-        # Pallas computes in f32 (scan._check_kernel), so a bf16 sweep
-        # auto-resolves to the XLA kernel rather than erroring.
-        a.kernel = "pallas" if on_tpu and a.dtype == "float32" else "xla"
+        a.kernel = resolve_kernel(a.dtype, on_tpu)
     interpret = a.kernel == "pallas" and not on_tpu
     run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype, kernel=a.kernel,
                             interpret=interpret, unroll=a.unroll)

@@ -37,6 +37,24 @@ def test_stream_mode_contract():
     assert rec["value"] > 0
 
 
+def test_kernel_auto_composes_with_bfloat16():
+    """`--kernel auto` (the default) must resolve to a kernel that accepts
+    the requested dtype — bf16 + auto previously could pick the f32-only
+    Pallas kernel and die in _check_kernel."""
+    rec = _run(["--epochs", "1", "--dtype", "bfloat16"])
+    assert rec["value"] > 0
+
+
+def test_kernel_auto_resolution_table():
+    """The auto-resolution rule itself, both backends (the subprocess test
+    above can only exercise the CPU branch)."""
+    import bench
+    assert bench.resolve_kernel("float32", on_tpu=True) == "pallas"
+    assert bench.resolve_kernel("bfloat16", on_tpu=True) == "xla"
+    assert bench.resolve_kernel("float32", on_tpu=False) == "xla"
+    assert bench.resolve_kernel("bfloat16", on_tpu=False) == "xla"
+
+
 def test_epochs_validation():
     out = subprocess.run([sys.executable, "bench.py", "--epochs", "0"],
                          env=ENV, capture_output=True, text=True, timeout=120)
