@@ -29,12 +29,7 @@ import jax
 NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
 FUSED_EPOCHS = 50
 
-
-def resolve_kernel(dtype: str, on_tpu: bool) -> str:
-    """`--kernel auto`: fused Pallas step on TPU (fastest measured variant),
-    XLA autodiff elsewhere (interpreter-only) — and for bf16 anywhere, since
-    the Pallas kernel computes in f32 (scan._check_kernel would reject it)."""
-    return "pallas" if on_tpu and dtype == "float32" else "xla"
+from pytorch_ddp_mnist_tpu.train.scan import resolve_kernel  # noqa: E402
 
 
 def _stream_bench(a) -> None:

@@ -31,8 +31,7 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
-    use_pallas = tcfg["kernel"] == "pallas"
-    if use_pallas and tcfg["dtype"] != "float32":
+    if tcfg["kernel"] == "pallas" and tcfg["dtype"] != "float32":
         raise SystemExit("--kernel pallas computes in float32 "
                          "(MXU accumulation); drop --dtype bfloat16")
     if tcfg["fused"] and not tcfg["cached"]:
@@ -58,6 +57,16 @@ def main(argv=None) -> int:
         # jax.distributed.initialize must come first in multi-process runs.
         return jax.default_backend() not in ("tpu", "axon")
 
+    def _resolve_kernel() -> bool:
+        # '--kernel auto' -> the bench.py policy (pallas on TPU+f32). Same
+        # post-wireup constraint as _pallas_interpret; both branches below
+        # call this exactly once, before any kernel choice is consumed.
+        if tcfg["kernel"] == "auto":
+            from ..train.scan import resolve_kernel
+            tcfg["kernel"] = resolve_kernel(tcfg["dtype"],
+                                            not _pallas_interpret())
+        return tcfg["kernel"] == "pallas"
+
     process_index, num_processes = 0, 1
     train_step = None
     put = None
@@ -69,6 +78,7 @@ def main(argv=None) -> int:
                                     global_batch_from_local, replicate_state)
         runtime = initialize_runtime(tcfg["wireup_method"])
         process_index, num_processes = jax.process_index(), jax.process_count()
+        use_pallas = _resolve_kernel()
         mesh = dp_mesh()  # global: all devices of all processes
         if not tcfg["cached"]:  # the cached path builds its own step fns
             if use_pallas:
@@ -82,6 +92,7 @@ def main(argv=None) -> int:
         num_shards = mesh.devices.size  # data sharding is per-device
         local_shards = len(jax.local_devices())
     else:
+        use_pallas = _resolve_kernel()
         if use_pallas and not tcfg["cached"]:
             from ..ops.pallas_step import make_pallas_train_step
             train_step = make_pallas_train_step(

@@ -65,11 +65,14 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         " the reference has no load path)")
     t.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32",
                    help="compute dtype for the train step")
-    t.add_argument("--kernel", choices=("xla", "pallas"), default="xla",
-                   help="train-step implementation: 'xla' (jit + XLA fusion) "
-                        "or 'pallas' (the fused fwd+bwd VMEM-resident TPU "
-                        "kernel, ops/pallas_step.py; composes with --cached "
-                        "to run inside the epoch scan)")
+    t.add_argument("--kernel", choices=("auto", "xla", "pallas"),
+                   default="xla",
+                   help="train-step implementation: 'xla' (jit + XLA fusion; "
+                        "default), 'pallas' (the fused fwd+bwd VMEM-resident "
+                        "TPU kernel, ops/pallas_step.py; composes with "
+                        "--cached to run inside the epoch scan), or 'auto' "
+                        "(pallas on a TPU backend with f32, xla otherwise — "
+                        "the bench.py policy)")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "

@@ -85,6 +85,14 @@ def epoch_batch_indices(sampler, batch_size: int) -> np.ndarray:
     return np.stack(list(_batched_indices(sampler, batch_size))).astype(np.int32)
 
 
+def resolve_kernel(dtype: str, on_tpu: bool) -> str:
+    """The `--kernel auto` policy (bench.py and the trainer CLI): fused
+    Pallas step on TPU (fastest measured variant — docs/PERF.md), XLA
+    autodiff elsewhere (Pallas off-TPU is interpreter-only) — and for bf16
+    anywhere, since the Pallas kernel computes in f32 (_check_kernel)."""
+    return "pallas" if on_tpu and dtype == "float32" else "xla"
+
+
 def _check_kernel(kernel: str, dtype: str) -> None:
     if kernel not in ("xla", "pallas"):
         raise ValueError(f"unknown kernel {kernel!r}")

@@ -5,6 +5,9 @@
 # chips via the SPMD mesh; no per-rank process spawn is needed on a single
 # host. For a multi-host pod, run this once per host under your scheduler —
 # wireup (SLURM/OpenMPI/MPICH/env) is picked up from the environment.
+# --kernel auto picks the fused Pallas step on TPU backends (the fastest
+# measured variant, docs/PERF.md); trailing "$@" still overrides any flag.
 set -e
 cd "$(dirname "$0")/.."
-python -m pytorch_ddp_mnist_tpu.cli.train --parallel --n_epochs 10 "$@"
+python -m pytorch_ddp_mnist_tpu.cli.train --parallel --n_epochs 10 \
+    --kernel auto "$@"

@@ -42,6 +42,21 @@ def test_serial_end_to_end_and_resume(tmp_path, capsys):
     assert resumed < from_scratch * 0.5, (from_scratch, resumed)
 
 
+def test_kernel_auto_trains_and_torch_checkpoint(tmp_path, capsys):
+    """--kernel auto resolves post-wireup (xla on this CPU mesh) and a .pt
+    checkpoint path round-trips through the reference's torch format."""
+    pytest.importorskip("torch")
+    ckpt = tmp_path / "model.pt"
+    args = ["--limit", "256", "--batch_size", "64", "--kernel", "auto",
+            "--path", str(tmp_path / "nodata"), "--checkpoint", str(ckpt)]
+    assert main(args + ["--n_epochs", "1"]) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and ckpt.exists()
+    assert main(args + ["--n_epochs", "1", "--resume", str(ckpt)]) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1
+
+
 def test_empty_checkpoint_skips_save(tmp_path, capsys, monkeypatch):
     monkeypatch.chdir(tmp_path)
     assert main(["--limit", "256", "--batch_size", "64",
