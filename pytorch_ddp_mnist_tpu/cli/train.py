@@ -170,8 +170,11 @@ def main(argv=None) -> int:
             loader = BatchLoader(normalize_images(train.images), train.labels,
                                  sampler, batch_size=local_batch)
 
+    # Params init always uses threefry (bit-stable across --impl: the same
+    # seed gives the same initial weights); --impl only selects the engine
+    # of the TRAIN key, i.e. the dropout stream.
     state = TrainState(init_mlp(jax.random.key(tcfg["seed"])),
-                       jax.random.key(tcfg["seed"] + 1))
+                       jax.random.key(tcfg["seed"] + 1, impl=tcfg["impl"]))
     if tcfg["resume"]:
         state = TrainState(load_checkpoint(tcfg["resume"], state.params),
                            state.key)

@@ -140,7 +140,9 @@ def replicate_state(mesh: Mesh, tree):
             data = np.asarray(jax.random.key_data(a))
             g = jax.make_array_from_callback(
                 data.shape, rep, lambda idx: data[idx])
-            return jax.random.wrap_key_data(g)
+            # preserve the key's PRNG engine (--impl rbg keys have a
+            # different key_data shape than the threefry default)
+            return jax.random.wrap_key_data(g, impl=jax.random.key_impl(a))
         a = np.asarray(a)
         return jax.make_array_from_callback(a.shape, rep, lambda idx: a[idx])
 

@@ -65,6 +65,12 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         " the reference has no load path)")
     t.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32",
                    help="compute dtype for the train step")
+    t.add_argument("--impl", choices=("threefry2x32", "rbg"),
+                   default="threefry2x32",
+                   help="PRNG engine for the train key (dropout stream); "
+                        "rbg uses the TPU hardware generator — same "
+                        "Bernoulli keep distribution, different stream, "
+                        "measured 1.7x whole-step throughput (docs/PERF.md)")
     t.add_argument("--kernel", choices=("auto", "xla", "pallas"),
                    default="xla",
                    help="train-step implementation: 'xla' (jit + XLA fusion; "
@@ -117,7 +123,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "seed": a.seed, "parallel": a.parallel,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
-            "dtype": a.dtype, "cached": a.cached, "fused": a.fused,
+            "dtype": a.dtype, "impl": a.impl,
+            "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,
         },
         "data": {

@@ -139,3 +139,17 @@ def test_bf16_compute_path(mesh):
     assert np.isfinite(float(loss))
     # master params stay float32
     assert params["fc1"]["w"].dtype == jnp.float32
+
+
+def test_replicate_state_preserves_rbg_key_impl(mesh):
+    """replicate_state must rewrap PRNG keys with their own engine — an rbg
+    key (key_data shape (4,), not threefry's (2,)) used to crash the DP
+    --impl rbg path at wrap_key_data."""
+    from pytorch_ddp_mnist_tpu.parallel.ddp import replicate_state
+
+    key = jax.random.key(7, impl="rbg")
+    out = replicate_state(mesh, {"k": key})["k"]
+    assert str(jax.random.key_impl(out)) == str(jax.random.key_impl(key))
+    # and it must actually work as a key on the mesh
+    assert np.isfinite(
+        float(jax.random.uniform(jax.random.fold_in(out, 3))))
