@@ -146,7 +146,8 @@ def main(argv=None) -> None:
     # Pallas needs Mosaic (TPU); `auto` resolves to it exactly there, and an
     # explicit --kernel pallas elsewhere runs interpreted so every variant
     # runs everywhere (same fallback as the trainer CLI).
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    from pytorch_ddp_mnist_tpu.parallel.wireup import on_tpu_backend
+    on_tpu = on_tpu_backend()
     if a.kernel == "auto":
         a.kernel = resolve_kernel(a.dtype, on_tpu)
     interpret = a.kernel == "pallas" and not on_tpu

@@ -50,12 +50,11 @@ def main(argv=None) -> int:
                 "use a .msgpack path for the torch-free format")
 
     def _pallas_interpret() -> bool:
-        # The kernel needs Mosaic (TPU — incl. the axon plugin, which
-        # aliases the tpu lowering rules); on CPU backends fall back to the
+        # The kernel needs Mosaic (TPU); on CPU backends fall back to the
         # Pallas interpreter so the same CLI runs everywhere. Must only be
-        # called AFTER wireup: the backend query initializes JAX, and
-        # jax.distributed.initialize must come first in multi-process runs.
-        return jax.default_backend() not in ("tpu", "axon")
+        # called AFTER wireup (see on_tpu_backend).
+        from ..parallel.wireup import on_tpu_backend
+        return not on_tpu_backend()
 
     def _resolve_kernel() -> bool:
         # '--kernel auto' -> the bench.py policy (pallas on TPU+f32). Same

@@ -172,6 +172,14 @@ def detect_method() -> str:
     return "single"
 
 
+def on_tpu_backend() -> bool:
+    """True when the default backend is a TPU (incl. the axon PJRT plugin,
+    which aliases the tpu lowering rules). Initializes JAX: in multi-process
+    runs call only AFTER initialize_runtime (rendezvous must come first)."""
+    import jax
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def _honor_platform_env() -> None:
     """Make JAX_PLATFORMS from the launcher win over any backend already
     registered at interpreter start (e.g. a site-installed TPU plugin that

@@ -296,3 +296,25 @@ def test_two_process_two_devices_cached_scan(tmp_path):
     means = [float(re.search(r"mean_train=([0-9.]+|nan|inf)", ln).group(1))
              for ln in lines]
     assert np.isfinite(means).all() and means[1] < means[0], lines
+
+
+def test_two_process_two_devices_fused_run(tmp_path):
+    """The 2x2 topology through --cached --fused: the WHOLE multi-epoch run
+    as one device program over a multi-process mesh, with per-epoch snapshot
+    replay (reporting + rank-0 checkpoint hook) after it completes."""
+    ckpt = tmp_path / "model.msgpack"
+    outs = _run_world(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train",
+         "--parallel", "--cached", "--fused", "--wireup_method", "env",
+         "--n_epochs", "2", "--limit", "1024", "--batch_size", "32",
+         "--checkpoint", str(ckpt)],
+        world=2, devices_per_proc=2)
+    rank0_out = outs[0][1]
+    assert "devices=4 processes=2" in rank0_out, rank0_out
+    lines = [ln for ln in rank0_out.splitlines() if ln.startswith("Epoch=")]
+    assert len(lines) == 2, rank0_out
+    means = [float(re.search(r"mean_train=([0-9.]+|nan|inf)", ln).group(1))
+             for ln in lines]
+    assert np.isfinite(means).all() and means[1] < means[0], lines
+    assert "Epoch=" not in outs[1][1]
+    assert ckpt.exists()
