@@ -1,0 +1,43 @@
+"""`python -m pytorch_ddp_mnist_tpu <command>` — one front door to the
+framework's executables (each also runs standalone as its own module):
+
+    train      the unified trainer CLI (cli/train.py; the reference's five
+               entry scripts behind one config surface)
+    convert    IDX -> NetCDF converter (data/convert.py; the
+               mnist_to_netcdf.ipynb workflow)
+    download   mirrored, checksum-verified MNIST IDX fetch (data/download.py)
+"""
+
+from __future__ import annotations
+
+import sys
+
+_COMMANDS = {
+    "train": ("pytorch_ddp_mnist_tpu.cli.train", "the unified trainer"),
+    "convert": ("pytorch_ddp_mnist_tpu.data.convert",
+                "IDX -> NetCDF converter"),
+    "download": ("pytorch_ddp_mnist_tpu.data.download", "MNIST IDX fetch"),
+}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        lines = [f"  {name:<10} {desc}  (python -m {mod})"
+                 for name, (mod, desc) in _COMMANDS.items()]
+        usage = ("usage: python -m pytorch_ddp_mnist_tpu <command> [args]\n\n"
+                 "commands:\n" + "\n".join(lines))
+        # --help goes to stdout (success); the no-command error to stderr
+        print(usage, file=sys.stdout if argv else sys.stderr)
+        return 0 if argv else 2
+    if argv[0] not in _COMMANDS:
+        print(f"unknown command {argv[0]!r}; expected one of "
+              f"{', '.join(_COMMANDS)}", file=sys.stderr)
+        return 2
+    import importlib
+    mod = importlib.import_module(_COMMANDS[argv[0]][0])
+    return mod.main(argv[1:]) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

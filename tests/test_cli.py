@@ -122,3 +122,23 @@ def test_pallas_cached_runs(tmp_path, capsys):
 def test_pallas_bfloat16_conflict():
     with pytest.raises(SystemExit, match="bfloat16"):
         main(["--kernel", "pallas", "--dtype", "bfloat16"])
+
+
+def test_package_main_dispatcher(tmp_path, capsys):
+    """python -m pytorch_ddp_mnist_tpu <command> routes to the right CLI."""
+    from pytorch_ddp_mnist_tpu.__main__ import main as pkg_main
+
+    assert pkg_main([]) == 2
+    assert pkg_main(["--help"]) == 0
+    out = capsys.readouterr().out
+    assert "train" in out and "convert" in out and "download" in out
+    assert pkg_main(["bogus"]) == 2
+    capsys.readouterr()
+    assert pkg_main(["convert", "--synthetic", "64:16",
+                     "--out_dir", str(tmp_path)]) == 0
+    assert (tmp_path / "mnist_train_images.nc").exists()
+    assert pkg_main(["train", "--limit", "128", "--batch_size", "64",
+                     "--n_epochs", "1", "--path", str(tmp_path / "nodata"),
+                     "--checkpoint", ""]) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1
