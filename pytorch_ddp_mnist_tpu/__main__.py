@@ -36,6 +36,9 @@ def main(argv=None) -> int:
         return 2
     import importlib
     mod = importlib.import_module(_COMMANDS[argv[0]][0])
+    # argparse derives `prog` from sys.argv[0]; name the subcommand so its
+    # usage/error text says how to re-invoke it through the front door.
+    sys.argv[0] = f"python -m pytorch_ddp_mnist_tpu {argv[0]}"
     return mod.main(argv[1:]) or 0
 
 
