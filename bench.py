@@ -71,11 +71,14 @@ def main(argv=None) -> None:
     # flags select the others, e.g. the reference-RNG-semantics
     # --kernel xla --impl threefry2x32.
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--kernel", choices=("auto", "xla", "pallas"),
+    p.add_argument("--kernel",
+                   choices=("auto", "xla", "pallas", "pallas_rng"),
                    default="auto",
                    help="auto (default): the fused Pallas step on TPU, XLA "
                         "autodiff elsewhere (Pallas off-TPU would run in the "
-                        "slow interpreter)")
+                        "slow interpreter); pallas_rng additionally draws "
+                        "dropout inside the kernel from the TPU core PRNG "
+                        "(real TPU only)")
     p.add_argument("--dtype", choices=("float32", "bfloat16"),
                    default="float32")
     p.add_argument("--impl", choices=("threefry2x32", "rbg"), default="rbg",
@@ -150,6 +153,9 @@ def main(argv=None) -> None:
     on_tpu = on_tpu_backend()
     if a.kernel == "auto":
         a.kernel = resolve_kernel(a.dtype, on_tpu)
+    if a.kernel == "pallas_rng" and not on_tpu:
+        p.error("--kernel pallas_rng needs a real TPU (the core PRNG has "
+                "no interpreter lowering)")
     interpret = a.kernel == "pallas" and not on_tpu
     run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype, kernel=a.kernel,
                             interpret=interpret, unroll=a.unroll)

@@ -31,9 +31,12 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
-    if tcfg["kernel"] == "pallas" and tcfg["dtype"] != "float32":
-        raise SystemExit("--kernel pallas computes in float32 "
+    if tcfg["kernel"].startswith("pallas") and tcfg["dtype"] != "float32":
+        raise SystemExit(f"--kernel {tcfg['kernel']} computes in float32 "
                          "(MXU accumulation); drop --dtype bfloat16")
+    if tcfg["kernel"] == "pallas_rng" and not tcfg["cached"]:
+        raise SystemExit("--kernel pallas_rng runs inside the epoch scan; "
+                         "add --cached")
     if tcfg["fused"] and not tcfg["cached"]:
         raise SystemExit("--fused fuses the epoch scan; add --cached")
 
@@ -64,6 +67,9 @@ def main(argv=None) -> int:
             from ..train.scan import resolve_kernel
             tcfg["kernel"] = resolve_kernel(tcfg["dtype"],
                                             not _pallas_interpret())
+        if tcfg["kernel"] == "pallas_rng" and _pallas_interpret():
+            raise SystemExit("--kernel pallas_rng draws dropout with the "
+                             "TPU core PRNG; it needs a real TPU backend")
         return tcfg["kernel"] == "pallas"
 
     process_index, num_processes = 0, 1

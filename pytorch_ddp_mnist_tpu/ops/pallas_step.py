@@ -61,7 +61,13 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _make_fused_kernel(total_batch: int, block: int):
+# P(bits < _KEEP_THRESH) = 1 - DROPOUT_RATE for uniform uint32 bits — the
+# in-kernel Bernoulli of the pallas_rng variant.
+_KEEP_THRESH = int(round((1.0 - DROPOUT_RATE) * 2**32))
+
+
+def _make_fused_kernel(total_batch: int, block: int,
+                       in_kernel_rng: bool = False):
     """Build the fwd+bwd kernel for a batch grid of `block`-row steps.
 
     TPU grid iterations run sequentially on a core, so gradient outputs (whose
@@ -69,6 +75,11 @@ def _make_fused_kernel(total_batch: int, block: int):
     initialized at program_id 0, `+=` thereafter. Rows past `total_batch`
     (tail padding to a block multiple) are masked out of the loss and — by
     zeroing their dlogits — out of every gradient.
+
+    `in_kernel_rng`: the third input is a (1,) int32 SMEM seed instead of a
+    pre-drawn mask block; the kernel seeds the core PRNG with seed+program_id
+    (an independent stream per batch block) and draws the pre-scaled dropout
+    mask from hardware bits — no mask array ever exists in HBM.
     """
 
     def kernel(x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref,
@@ -76,15 +87,22 @@ def _make_fused_kernel(total_batch: int, block: int):
                gw3_ref):
         """One block, whole fwd+bwd. Shapes (Bb = block):
         x (Bb,784) f32 · y (Bb,1) i32 · m (Bb,128) f32 pre-scaled dropout
-        mask · w1 (784,128) · b1 (1,128) · w2 (128,128) · b2 (1,128) ·
-        w3 (128,PADDED_CLASSES) zero-padded past column NUM_CLASSES.
-        Outputs: loss (1,1) SMEM · grads matching each weight input's shape,
-        all accumulated over the batch grid.
+        mask OR (1,) i32 seed (in_kernel_rng) · w1 (784,128) · b1 (1,128) ·
+        w2 (128,128) · b2 (1,128) · w3 (128,PADDED_CLASSES) zero-padded past
+        column NUM_CLASSES. Outputs: loss (1,1) SMEM · grads matching each
+        weight input's shape, all accumulated over the batch grid.
         """
         f32 = jnp.float32
         pid = pl.program_id(0)
         x = x_ref[:]
-        m = m_ref[:]
+        if in_kernel_rng:
+            pltpu.prng_seed(m_ref[0] + pid)
+            bits = pltpu.bitcast(
+                pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
+            m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
+                          f32(1.0 / (1.0 - DROPOUT_RATE)), f32(0.0))
+        else:
+            m = m_ref[:]
         # validity of each row of this block in the ORIGINAL batch
         rows = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0) + pid * block
         valid = (rows < total_batch).astype(f32)           # (Bb,1)
@@ -166,6 +184,27 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
     zero-padded to a block multiple and masked out inside the kernel, so any
     batch size works. `interpret=True` runs the Pallas interpreter (CPU
     tests)."""
+    return _run_fused(params, x, y, scaled_mask, in_kernel_rng=False,
+                      interpret=interpret)
+
+
+def fused_loss_and_grads_rng(params, x, y, seed):
+    """The kernel with the dropout mask drawn INSIDE it from the TPU core
+    PRNG (`--kernel pallas_rng`): (params, x (B,784), y (B,) int, seed ()
+    or (1,) int32) -> (mean_loss, grads pytree).
+
+    vs fused_loss_and_grads: no (B,128) mask array is materialized in HBM or
+    streamed into VMEM — the seed is one SMEM scalar, and each batch block
+    draws its own hardware-PRNG stream (seed + block index). Same
+    Bernoulli(1-DROPOUT_RATE) keep distribution and 1/keep pre-scaling as
+    every other engine; yet another stream, like threefry vs rbg. Mosaic
+    (real TPU) only: pltpu.prng_* has no interpreter lowering."""
+    seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    return _run_fused(params, x, y, seed, in_kernel_rng=True,
+                      interpret=False)
+
+
+def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
     batch = x.shape[0]
     f32 = jnp.float32
     # Block = whole batch when it fits (rounded to the f32 sublane multiple
@@ -179,7 +218,8 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
     if padded != batch:
         pad = ((0, padded - batch), (0, 0))
         x = jnp.pad(x.astype(f32), pad)
-        scaled_mask = jnp.pad(scaled_mask.astype(f32), pad)
+        if not in_kernel_rng:
+            mask_or_seed = jnp.pad(mask_or_seed.astype(f32), pad)
         y = jnp.pad(y.astype(jnp.int32), ((0, padded - batch),))
     vmem = partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     resident = lambda shape: vmem(shape, lambda i: (0, 0))  # noqa: E731
@@ -191,8 +231,12 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
         jax.ShapeDtypeStruct((1, HIDDEN2), f32),                 # gb2
         jax.ShapeDtypeStruct((HIDDEN2, PADDED_CLASSES), f32),    # gw3 (padded)
     )
+    mask_spec = (pl.BlockSpec((1,), lambda i: (0,),
+                              memory_space=pltpu.SMEM)
+                 if in_kernel_rng
+                 else vmem((block, HIDDEN1), lambda i: (i, 0)))
     loss, gw1, gb1, gw2, gb2, gw3 = pl.pallas_call(
-        _make_fused_kernel(batch, block),
+        _make_fused_kernel(batch, block, in_kernel_rng=in_kernel_rng),
         grid=(grid,),
         # The gradient outputs accumulate across grid steps, so the batch
         # grid MUST run sequentially — 'arbitrary' pins that down even on
@@ -203,7 +247,7 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
         in_specs=[
             vmem((block, IN_DIM), lambda i: (i, 0)),             # x
             vmem((block, 1), lambda i: (i, 0)),                  # y
-            vmem((block, HIDDEN1), lambda i: (i, 0)),            # mask
+            mask_spec,                                           # mask | seed
             resident((IN_DIM, HIDDEN1)),                         # w1
             resident((1, HIDDEN1)),                              # b1
             resident((HIDDEN1, HIDDEN2)),                        # w2
@@ -223,7 +267,7 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
     )(
         x.astype(f32),
         y.astype(jnp.int32)[:, None],
-        scaled_mask.astype(f32),
+        mask_or_seed if in_kernel_rng else mask_or_seed.astype(f32),
         params["fc1"]["w"].astype(f32),
         params["fc1"]["b"].astype(f32)[None, :],
         params["fc2"]["w"].astype(f32),

@@ -71,14 +71,17 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "rbg uses the TPU hardware generator — same "
                         "Bernoulli keep distribution, different stream, "
                         "measured 1.7x whole-step throughput (docs/PERF.md)")
-    t.add_argument("--kernel", choices=("auto", "xla", "pallas"),
+    t.add_argument("--kernel",
+                   choices=("auto", "xla", "pallas", "pallas_rng"),
                    default="xla",
                    help="train-step implementation: 'xla' (jit + XLA fusion; "
                         "default), 'pallas' (the fused fwd+bwd VMEM-resident "
                         "TPU kernel, ops/pallas_step.py; composes with "
-                        "--cached to run inside the epoch scan), or 'auto' "
+                        "--cached to run inside the epoch scan), 'auto' "
                         "(pallas on a TPU backend with f32, xla otherwise — "
-                        "the bench.py policy)")
+                        "the bench.py policy), or 'pallas_rng' (dropout "
+                        "drawn inside the kernel from the TPU core PRNG; "
+                        "real TPU + --cached only)")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "

@@ -94,17 +94,28 @@ def resolve_kernel(dtype: str, on_tpu: bool) -> str:
 
 
 def _check_kernel(kernel: str, dtype: str) -> None:
-    if kernel not in ("xla", "pallas"):
+    if kernel not in ("xla", "pallas", "pallas_rng"):
         raise ValueError(f"unknown kernel {kernel!r}")
-    if kernel == "pallas" and dtype != "float32":
-        raise ValueError("the pallas kernel computes in float32 "
+    if kernel.startswith("pallas") and dtype != "float32":
+        raise ValueError(f"kernel {kernel!r} computes in float32 "
                          "(MXU f32 accumulation); drop dtype=bfloat16")
 
 
 def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):
-    """Per-step fwd+bwd, either XLA autodiff or the fused Pallas kernel.
-    Both draw the dropout mask from the same bernoulli stream for the same
-    key, so the choice changes the schedule, not the numbers."""
+    """Per-step fwd+bwd: XLA autodiff or the fused Pallas kernel. 'pallas'
+    draws the dropout mask from the same bernoulli stream as 'xla' for the
+    same key (bitwise-matched schedule change); 'pallas_rng' draws it inside
+    the kernel from the TPU core PRNG, seeded per step from the key — same
+    keep distribution, its own stream (like threefry vs rbg)."""
+    if kernel == "pallas_rng":
+        if interpret:
+            raise ValueError("kernel 'pallas_rng' draws dropout bits with "
+                             "the TPU core PRNG (no interpreter lowering); "
+                             "use 'pallas' off-TPU")
+        from ..ops.pallas_step import fused_loss_and_grads_rng
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.key_data(dropout_key).ravel()[0], jnp.int32)
+        return fused_loss_and_grads_rng(params, x, y, seed)
     if kernel == "pallas":
         from ..ops.pallas_step import dropout_mask, fused_loss_and_grads
         mask = dropout_mask(dropout_key, x.shape[0])
@@ -240,7 +251,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     """
     _check_kernel(kernel, dtype)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    use_pallas = kernel == "pallas"
+    use_pallas = kernel.startswith("pallas")
 
     def shard_fn(params, key, x_all, y_all, idxs):
         if not use_pallas:

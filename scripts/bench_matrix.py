@@ -33,6 +33,9 @@ VARIANTS = [
      ["--kernel", "xla", "--dtype", "bfloat16", "--impl", "rbg"]),
     ("f32 / Pallas / rbg (bench default on TPU)",
      ["--kernel", "pallas", "--impl", "rbg"]),
+    # TPU-only (core-PRNG dropout inside the kernel); FAILS on CPU hosts by
+    # design — measured ~3% below the default (docs/PERF.md).
+    ("f32 / Pallas / in-kernel PRNG", ["--kernel", "pallas_rng"]),
 ]
 
 MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016

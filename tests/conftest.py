@@ -14,17 +14,25 @@ drop any already-initialized backend set.
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax.extend.backend import clear_backends
-    clear_backends()
-except Exception:
+if os.environ.get("PDMT_TPU_TESTS") == "1":
+    # Hardware mode: keep the session's real TPU backend so the
+    # Mosaic-only tests (marked tpu_only, skipped on CPU) actually run.
+    # Intended for targeted selections on a TPU-attached machine, e.g.
+    #   PDMT_TPU_TESTS=1 pytest tests/test_pallas_step.py -k pallas_rng
+    # NOT for the full suite: most tests assume the 8-device CPU mesh.
     pass
+else:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax.extend.backend import clear_backends
+        clear_backends()
+    except Exception:
+        pass
