@@ -27,7 +27,12 @@ import numpy as np
 import jax
 
 NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
-FUSED_EPOCHS = 50
+# Window length: each timing window carries a fixed ~30 ms of program
+# dispatch + sync RTT over the TPU tunnel (measured: 50/100/200/400-epoch
+# windows report 15.5/16.7/17.3/18.1M img/s — a 1/x approach to the ~18.5M
+# steady state). 400 epochs (~24M images, ~1.3 s/window) amortizes that to
+# <3% while keeping the whole bench under ~a minute.
+FUSED_EPOCHS = 400
 
 from pytorch_ddp_mnist_tpu.train.scan import resolve_kernel  # noqa: E402
 
@@ -175,9 +180,10 @@ def main(argv=None) -> None:
 
     from pytorch_ddp_mnist_tpu.utils import Timer
     best = float("inf")
-    # best-of-5: each window is one fused-run dispatch (~2s at 50 epochs);
-    # the tunneled chip shows ~15% invocation-to-invocation swing
-    # (docs/PERF.md), so extra windows buy a tighter floor nearly for free.
+    # best-of-5: each window is one fused-run dispatch (~1.3s at the
+    # 400-epoch default); the tunneled chip shows ~15% invocation-to-
+    # invocation swing (docs/PERF.md), so extra windows buy a tighter
+    # floor nearly for free.
     for _ in range(5):
         p, k = fresh()
         with Timer("window") as t:
