@@ -35,6 +35,27 @@ NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
 FUSED_EPOCHS = 400
 
 from pytorch_ddp_mnist_tpu.train.scan import resolve_kernel  # noqa: E402
+from pytorch_ddp_mnist_tpu.ops.pallas_step import (  # noqa: E402
+    EPOCH_KERNEL_MAX_BATCH)
+
+
+def resolve_bench_kernel(kernel: str, dtype: str, on_tpu: bool,
+                         n_chips: int, batch: int = 128,
+                         unroll: int = 1) -> str:
+    """bench's `--kernel auto`: the shared CLI policy, plus the single-chip
+    promotion to the whole-epoch kernel — a 1-device mesh's DP semantics
+    reduce to exactly it (the per-step pmean is the identity), and it is the
+    fastest measured variant (docs/PERF.md). Multi-chip keeps the per-step
+    kernel with the real allreduce; so do batches the epoch kernel can't
+    take (not 8-aligned, or past its one-VMEM-block budget) and --unroll
+    experiments (an epoch-kernel has no step scan to unroll)."""
+    if kernel != "auto":
+        return kernel
+    kernel = resolve_kernel(dtype, on_tpu)
+    if (kernel == "pallas" and n_chips == 1 and unroll == 1
+            and batch % 8 == 0 and batch <= EPOCH_KERNEL_MAX_BATCH):
+        kernel = "pallas_epoch"
+    return kernel
 
 
 def _stream_bench(a) -> None:
@@ -77,13 +98,17 @@ def main(argv=None) -> None:
     # --kernel xla --impl threefry2x32.
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--kernel",
-                   choices=("auto", "xla", "pallas", "pallas_rng"),
+                   choices=("auto", "xla", "pallas", "pallas_rng",
+                            "pallas_epoch"),
                    default="auto",
-                   help="auto (default): the fused Pallas step on TPU, XLA "
-                        "autodiff elsewhere (Pallas off-TPU would run in the "
-                        "slow interpreter); pallas_rng additionally draws "
-                        "dropout inside the kernel from the TPU core PRNG "
-                        "(real TPU only)")
+                   help="auto (default): on a single TPU chip the "
+                        "whole-epoch kernel (pallas_epoch: weights "
+                        "VMEM-resident across all steps, in-kernel SGD + "
+                        "PRNG dropout; needs batch%%8==0 and batch<="
+                        f"{EPOCH_KERNEL_MAX_BATCH}), on multi-chip meshes "
+                        "the fused per-step Pallas kernel (real per-step "
+                        "allreduce), off-TPU XLA autodiff. pallas_rng draws "
+                        "dropout inside the per-step kernel (real TPU only)")
     p.add_argument("--dtype", choices=("float32", "bfloat16"),
                    default="float32")
     p.add_argument("--impl", choices=("threefry2x32", "rbg"), default="rbg",
@@ -156,14 +181,26 @@ def main(argv=None) -> None:
     # runs everywhere (same fallback as the trainer CLI).
     from pytorch_ddp_mnist_tpu.parallel.wireup import on_tpu_backend
     on_tpu = on_tpu_backend()
-    if a.kernel == "auto":
-        a.kernel = resolve_kernel(a.dtype, on_tpu)
-    if a.kernel == "pallas_rng" and not on_tpu:
-        p.error("--kernel pallas_rng needs a real TPU (the core PRNG has "
+    a.kernel = resolve_bench_kernel(a.kernel, a.dtype, on_tpu, n_chips,
+                                    batch=a.batch_size, unroll=a.unroll)
+    if a.kernel in ("pallas_rng", "pallas_epoch") and not on_tpu:
+        p.error(f"--kernel {a.kernel} needs a real TPU (the core PRNG has "
                 "no interpreter lowering)")
     interpret = a.kernel == "pallas" and not on_tpu
-    run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype, kernel=a.kernel,
-                            interpret=interpret, unroll=a.unroll)
+    if a.kernel == "pallas_epoch":
+        # Whole-epoch kernel: single-replica semantics (no per-step
+        # allreduce exists inside a kernel). On the 1-chip mesh that IS the
+        # DP program (pmean over one device is the identity).
+        if n_chips != 1:
+            p.error("--kernel pallas_epoch is single-chip (no per-step "
+                    "allreduce inside a kernel); this mesh has "
+                    f"{n_chips} devices")
+        from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+        run_fn = make_run_fn(lr=0.01, dtype=a.dtype, kernel=a.kernel)
+    else:
+        run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype,
+                                kernel=a.kernel, interpret=interpret,
+                                unroll=a.unroll)
     params_host = jax.tree_util.tree_map(np.asarray, init_mlp(jax.random.key(0)))
     key_host = np.asarray(jax.random.key_data(
         jax.random.key(1, impl=a.impl)))

@@ -34,9 +34,21 @@ def main(argv=None) -> int:
     if tcfg["kernel"].startswith("pallas") and tcfg["dtype"] != "float32":
         raise SystemExit(f"--kernel {tcfg['kernel']} computes in float32 "
                          "(MXU accumulation); drop --dtype bfloat16")
-    if tcfg["kernel"] == "pallas_rng" and not tcfg["cached"]:
-        raise SystemExit("--kernel pallas_rng runs inside the epoch scan; "
-                         "add --cached")
+    if tcfg["kernel"] in ("pallas_rng", "pallas_epoch") and not tcfg["cached"]:
+        raise SystemExit(f"--kernel {tcfg['kernel']} runs inside the epoch "
+                         "scan; add --cached")
+    if tcfg["kernel"] == "pallas_epoch" and tcfg["parallel"]:
+        raise SystemExit("--kernel pallas_epoch fuses the whole epoch in "
+                         "one kernel with no per-step allreduce (single-"
+                         "replica semantics); drop --parallel")
+    if tcfg["kernel"] == "pallas_epoch":
+        from ..ops.pallas_step import EPOCH_KERNEL_MAX_BATCH
+        if (tcfg["batch_size"] % 8 != 0
+                or tcfg["batch_size"] > EPOCH_KERNEL_MAX_BATCH):
+            raise SystemExit(
+                f"--kernel pallas_epoch needs a batch divisible by 8 and "
+                f"<= {EPOCH_KERNEL_MAX_BATCH} (one VMEM block per step); "
+                f"got {tcfg['batch_size']} — use --kernel pallas instead")
     if tcfg["fused"] and not tcfg["cached"]:
         raise SystemExit("--fused fuses the epoch scan; add --cached")
 
@@ -67,9 +79,10 @@ def main(argv=None) -> int:
             from ..train.scan import resolve_kernel
             tcfg["kernel"] = resolve_kernel(tcfg["dtype"],
                                             not _pallas_interpret())
-        if tcfg["kernel"] == "pallas_rng" and _pallas_interpret():
-            raise SystemExit("--kernel pallas_rng draws dropout with the "
-                             "TPU core PRNG; it needs a real TPU backend")
+        if (tcfg["kernel"] in ("pallas_rng", "pallas_epoch")
+                and _pallas_interpret()):
+            raise SystemExit(f"--kernel {tcfg['kernel']} uses the TPU core "
+                             "PRNG; it needs a real TPU backend")
         return tcfg["kernel"] == "pallas"
 
     process_index, num_processes = 0, 1

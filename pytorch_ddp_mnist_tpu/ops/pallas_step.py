@@ -65,6 +65,13 @@ def _round_up(n: int, m: int) -> int:
 # in-kernel Bernoulli of the pallas_rng variant.
 _KEEP_THRESH = int(round((1.0 - DROPOUT_RATE) * 2**32))
 
+# Largest per-step batch the whole-epoch kernel takes: its x input streams
+# as ONE (B, 784) f32 block (double-buffered ~3.2 MB x2 at B=1024) next to
+# two resident weight copies (~1.1 MB) and (B, 128) activations — ~10 MB at
+# B=1024, inside the ~16 MB/core VMEM; B=2048 is not. (The per-step kernel
+# instead grids over MAX_BATCH_BLOCK rows and takes any size.)
+EPOCH_KERNEL_MAX_BATCH = 1024
+
 
 def _make_fused_kernel(total_batch: int, block: int,
                        in_kernel_rng: bool = False):
@@ -280,6 +287,178 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
         "fc3": {"w": gw3[:, :NUM_CLASSES]},
     }
     return loss[0, 0], grads
+
+
+def _make_epoch_kernel(block: int, lr: float):
+    """Whole-EPOCH kernel: grid = (nsteps,), one SGD step per grid iteration,
+    weights VMEM-RESIDENT for the entire epoch.
+
+    This removes the dominant remaining HBM term of the per-step design: the
+    per-step kernel reads and writes every weight from/to HBM each step
+    (~1.4 MB/step); here weights enter once, live in VMEM across all grid
+    iterations (copied into the pinned output refs at iteration 0, updated in
+    place by the in-kernel SGD), and are flushed once at epoch end. The
+    epoch's batches stream through the pipelined x/y input blocks; dropout is
+    drawn in-kernel per step (core PRNG, seed+step stream, same Bernoulli
+    keep distribution as every other engine)."""
+
+    def kernel(x_ref, y_ref, seed_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+               w3_ref, loss_ref, ow1, ob1, ow2, ob2, ow3):
+        f32 = jnp.float32
+        pid = pl.program_id(0)
+
+        @pl.when(pid == 0)
+        def _init():
+            ow1[:] = w1_ref[:]
+            ob1[:] = b1_ref[:]
+            ow2[:] = w2_ref[:]
+            ob2[:] = b2_ref[:]
+            ow3[:] = w3_ref[:]
+
+        pltpu.prng_seed(seed_ref[0] + pid)
+        bits = pltpu.bitcast(
+            pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
+        m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
+                      f32(1.0 / (1.0 - DROPOUT_RATE)), f32(0.0))
+
+        x = x_ref[:]
+        # ---- forward (weights read from the resident, updated refs) ----
+        z1 = jax.lax.dot_general(x, ow1[:], (((1,), (0,)), ((), ())),
+                                 preferred_element_type=f32) + ob1[:]
+        h1 = jnp.maximum(z1, 0.0)
+        d1 = h1 * m
+        z2 = jax.lax.dot_general(d1, ow2[:], (((1,), (0,)), ((), ())),
+                                 preferred_element_type=f32) + ob2[:]
+        h2 = jnp.maximum(z2, 0.0)
+        logits = jax.lax.dot_general(h2, ow3[:], (((1,), (0,)), ((), ())),
+                                     preferred_element_type=f32)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block, PADDED_CLASSES), 1)
+        logits = jnp.where(cols < NUM_CLASSES, logits, _NEG_INF)
+
+        mx = jnp.max(logits, axis=1, keepdims=True)
+        ex = jnp.exp(logits - mx)
+        se = jnp.sum(ex, axis=1, keepdims=True)
+        onehot = (cols == y_ref[:]).astype(f32)
+        logit_y = jnp.sum(jnp.where(onehot > 0, logits, 0.0), axis=1,
+                          keepdims=True)
+        # Per-step loss into an (8,128)-tiled VMEM output: grid step i owns
+        # row i%8 of block i//8 (Mosaic needs ≥(8,128) blocks; a (1,1) SMEM
+        # slot per step would be an illegal block shape for a (S,1) array).
+        # The block is revisited for 8 consecutive sequential steps; on first
+        # visit (i%8==0) the whole block is initialized, afterwards merged.
+        step_loss = jnp.sum((mx + jnp.log(se)) - logit_y) / block
+        lrow = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+        sel = lrow == (pid % 8)
+        prev = jnp.where(pid % 8 == 0, jnp.zeros((8, 128), f32),
+                         loss_ref[:])
+        loss_ref[:] = jnp.where(sel, step_loss, prev)
+
+        # ---- backward + in-kernel SGD (every row valid: the sampler
+        # wrap-pads the epoch to nsteps*block rows exactly) ----
+        dlogits = (ex / se - onehot) * (1.0 / block)
+        gw3 = jax.lax.dot_general(h2, dlogits, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=f32)
+        dh2 = jax.lax.dot_general(dlogits, ow3[:], (((1,), (1,)), ((), ())),
+                                  preferred_element_type=f32)
+        dz2 = dh2 * (z2 > 0.0).astype(f32)
+        gw2 = jax.lax.dot_general(d1, dz2, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=f32)
+        gb2 = jnp.sum(dz2, axis=0, keepdims=True)
+        dd1 = jax.lax.dot_general(dz2, ow2[:], (((1,), (1,)), ((), ())),
+                                  preferred_element_type=f32)
+        dz1 = (dd1 * m) * (z1 > 0.0).astype(f32)
+        gw1 = jax.lax.dot_general(x, dz1, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=f32)
+        gb1 = jnp.sum(dz1, axis=0, keepdims=True)
+
+        ow1[:] -= lr * gw1
+        ob1[:] -= lr * gb1
+        ow2[:] -= lr * gw2
+        ob2[:] -= lr * gb2
+        ow3[:] -= lr * gw3
+
+    return kernel
+
+
+def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int):
+    """One ENTIRE epoch as a single kernel (`--kernel pallas_epoch`):
+    (params, xp (S*B, 784) f32 pre-gathered epoch rows, yp (S*B,) int32,
+    seed () int32, lr, batch=B) -> (params', losses (S,)).
+
+    The caller flattens the epoch's sampler index rows (already wrap-padded
+    to full batches) into xp/yp; grid step i trains on rows [i*B, (i+1)*B).
+    Mosaic only (in-kernel PRNG + resident-weight update). Single-replica
+    semantics: the per-step DDP allreduce has no in-kernel analog here, so
+    DP meshes with more than one device must keep the per-step kernels
+    (a 1-device mesh is exactly this)."""
+    rows, dim = xp.shape
+    assert dim == IN_DIM
+    f32 = jnp.float32
+    block = batch
+    if block % 8 != 0:
+        raise ValueError(f"pallas_epoch needs a batch divisible by 8 (the "
+                         f"f32 sublane tile); got {block}")
+    if block > EPOCH_KERNEL_MAX_BATCH:
+        raise ValueError(
+            f"pallas_epoch streams each step's batch as ONE VMEM block; "
+            f"batch {block} > {EPOCH_KERNEL_MAX_BATCH} exceeds its budget "
+            f"(double-buffered (B,784) f32 inputs + resident weights). "
+            f"Use the gridded per-step kernel (--kernel pallas) instead")
+    nsteps = rows // block
+    assert nsteps * block == rows, (rows, block)
+    seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    vmem = partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    resident = lambda shape: vmem(shape, lambda i: (0, 0))  # noqa: E731
+    w_shapes = (
+        jax.ShapeDtypeStruct((IN_DIM, HIDDEN1), f32),
+        jax.ShapeDtypeStruct((1, HIDDEN1), f32),
+        jax.ShapeDtypeStruct((HIDDEN1, HIDDEN2), f32),
+        jax.ShapeDtypeStruct((1, HIDDEN2), f32),
+        jax.ShapeDtypeStruct((HIDDEN2, PADDED_CLASSES), f32),
+    )
+    nblocks8 = -(-nsteps // 8)
+    out_shapes = (jax.ShapeDtypeStruct((nblocks8 * 8, 128), f32),) + w_shapes
+    loss, w1, b1, w2, b2, w3 = pl.pallas_call(
+        _make_epoch_kernel(block, lr),
+        grid=(nsteps,),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),  # steps are sequential
+        out_shape=out_shapes,
+        in_specs=[
+            vmem((block, IN_DIM), lambda i: (i, 0)),          # x block
+            vmem((block, 1), lambda i: (i, 0)),               # y block
+            pl.BlockSpec((1,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),            # seed
+            resident((IN_DIM, HIDDEN1)),                      # w1 in
+            resident((1, HIDDEN1)),
+            resident((HIDDEN1, HIDDEN2)),
+            resident((1, HIDDEN2)),
+            resident((HIDDEN2, PADDED_CLASSES)),
+        ],
+        out_specs=(
+            vmem((8, 128), lambda i: (i // 8, 0)),            # per-step loss
+            resident((IN_DIM, HIDDEN1)),                      # w1 out
+            resident((1, HIDDEN1)),
+            resident((HIDDEN1, HIDDEN2)),
+            resident((1, HIDDEN2)),
+            resident((HIDDEN2, PADDED_CLASSES)),
+        ),
+    )(
+        xp.astype(f32),
+        yp.astype(jnp.int32)[:, None],
+        seed,
+        params["fc1"]["w"].astype(f32),
+        params["fc1"]["b"].astype(f32)[None, :],
+        params["fc2"]["w"].astype(f32),
+        params["fc2"]["b"].astype(f32)[None, :],
+        pad_fc3(params["fc3"]["w"].astype(f32)),
+    )
+    new_params = {
+        "fc1": {"w": w1, "b": b1[0]},
+        "fc2": {"w": w2, "b": b2[0]},
+        "fc3": {"w": w3[:, :NUM_CLASSES]},
+    }
+    return new_params, loss[:nsteps, 0]
 
 
 def dropout_mask(key: jax.Array, batch: int, *, train: bool = True):

@@ -72,16 +72,20 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "Bernoulli keep distribution, different stream, "
                         "measured 1.7x whole-step throughput (docs/PERF.md)")
     t.add_argument("--kernel",
-                   choices=("auto", "xla", "pallas", "pallas_rng"),
+                   choices=("auto", "xla", "pallas", "pallas_rng",
+                            "pallas_epoch"),
                    default="xla",
                    help="train-step implementation: 'xla' (jit + XLA fusion; "
                         "default), 'pallas' (the fused fwd+bwd VMEM-resident "
                         "TPU kernel, ops/pallas_step.py; composes with "
                         "--cached to run inside the epoch scan), 'auto' "
                         "(pallas on a TPU backend with f32, xla otherwise — "
-                        "the bench.py policy), or 'pallas_rng' (dropout "
+                        "the bench.py policy), 'pallas_rng' (dropout "
                         "drawn inside the kernel from the TPU core PRNG; "
-                        "real TPU + --cached only)")
+                        "real TPU + --cached only), or 'pallas_epoch' "
+                        "(the WHOLE epoch as one kernel, weights "
+                        "VMEM-resident across steps; real TPU + --cached, "
+                        "single-replica — no --parallel)")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "

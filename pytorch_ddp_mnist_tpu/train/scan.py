@@ -94,7 +94,7 @@ def resolve_kernel(dtype: str, on_tpu: bool) -> str:
 
 
 def _check_kernel(kernel: str, dtype: str) -> None:
-    if kernel not in ("xla", "pallas", "pallas_rng"):
+    if kernel not in ("xla", "pallas", "pallas_rng", "pallas_epoch"):
         raise ValueError(f"unknown kernel {kernel!r}")
     if kernel.startswith("pallas") and dtype != "float32":
         raise ValueError(f"kernel {kernel!r} computes in float32 "
@@ -167,6 +167,38 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
         y = jnp.take(y_all, batch_idx, axis=0)
         loss, grads = _loss_and_grads(params, x, y, sub, kernel, interpret)
         return (sgd_step(params, grads, lr), key), loss
+
+    if kernel == "pallas_epoch":
+        if interpret:
+            raise ValueError("kernel 'pallas_epoch' needs a real TPU "
+                             "(in-kernel PRNG + resident-weight update "
+                             "have no interpreter lowering)")
+        from ..ops.pallas_step import epoch_fused_sgd
+
+        @partial(jax.jit, donate_argnums=(0, 1), static_argnames=())
+        def run_epochal(params, key, x_all, y_all, idxs):
+            batch = idxs.shape[2]
+
+            def epoch(carry, idx_e):
+                params, key = carry
+                key, sub = jax.random.split(key)
+                seed = jax.lax.bitcast_convert_type(
+                    jax.random.key_data(sub).ravel()[0], jnp.int32)
+                rows = idx_e.reshape(-1)
+                xp = _gathered_x(x_all, rows, jnp.float32)
+                yp = jnp.take(y_all, rows, axis=0)
+                params, losses = epoch_fused_sgd(params, xp, yp, seed,
+                                                 lr, batch)
+                out = ((losses, ((params, key))) if snapshots else losses)
+                return (params, key), out
+
+            (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
+            if snapshots:
+                losses, (p_snaps, k_snaps) = out
+                return params, key, losses, (p_snaps, k_snaps)
+            return params, key, out
+
+        return run_epochal
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def run(params, key, x_all, y_all, idxs):
@@ -249,6 +281,12 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     TrainState) without breaking the fused program (118k params ->
     ~0.5 MB/epoch, trivial).
     """
+    if kernel == "pallas_epoch":
+        raise ValueError(
+            "kernel 'pallas_epoch' fuses the whole epoch into one kernel "
+            "with no per-step allreduce — DP meshes need the per-step "
+            "kernels; on a single device use the serial path (make_run_fn), "
+            "whose semantics a 1-device mesh reduces to")
     _check_kernel(kernel, dtype)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     use_pallas = kernel.startswith("pallas")

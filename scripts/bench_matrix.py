@@ -34,8 +34,12 @@ VARIANTS = [
     ("f32 / Pallas / rbg (bench default on TPU)",
      ["--kernel", "pallas", "--impl", "rbg"]),
     # TPU-only (core-PRNG dropout inside the kernel); FAILS on CPU hosts by
-    # design — measured ~3% below the default (docs/PERF.md).
+    # design — measured ~3% below the per-step default (docs/PERF.md).
     ("f32 / Pallas / in-kernel PRNG", ["--kernel", "pallas_rng"]),
+    # TPU-only, single-chip: the whole-epoch kernel — the headline variant
+    # (weights VMEM-resident across all steps; docs/PERF.md).
+    ("f32 / whole-epoch kernel (single-chip headline)",
+     ["--kernel", "pallas_epoch"]),
 ]
 
 MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016

@@ -55,6 +55,28 @@ def test_kernel_auto_resolution_table():
     assert bench.resolve_kernel("bfloat16", on_tpu=False) == "xla"
 
 
+def test_bench_kernel_resolution_table():
+    """bench's own auto policy incl. the single-chip whole-epoch promotion —
+    the exact decision the driver's flagless TPU run takes."""
+    import bench
+    r = bench.resolve_bench_kernel
+    assert r("auto", "float32", on_tpu=True, n_chips=1) == "pallas_epoch"
+    assert r("auto", "float32", on_tpu=True, n_chips=8) == "pallas"
+    assert r("auto", "bfloat16", on_tpu=True, n_chips=1) == "xla"
+    assert r("auto", "float32", on_tpu=False, n_chips=1) == "xla"
+    # batches the epoch kernel can't take, and unroll experiments, fall
+    # back to the gridded per-step kernel instead of erroring
+    assert r("auto", "float32", on_tpu=True, n_chips=1,
+             batch=100) == "pallas"
+    assert r("auto", "float32", on_tpu=True, n_chips=1,
+             batch=2048) == "pallas"
+    assert r("auto", "float32", on_tpu=True, n_chips=1,
+             unroll=2) == "pallas"
+    # explicit flags never get promoted/overridden
+    assert r("pallas", "float32", on_tpu=True, n_chips=1) == "pallas"
+    assert r("xla", "float32", on_tpu=True, n_chips=1) == "xla"
+
+
 def test_epochs_validation():
     out = subprocess.run([sys.executable, "bench.py", "--epochs", "0"],
                          env=ENV, capture_output=True, text=True, timeout=120)

@@ -142,3 +142,16 @@ def test_package_main_dispatcher(tmp_path, capsys):
                      "--checkpoint", ""]) == 0
     _, lines = _epoch_lines(capsys)
     assert len(lines) == 1
+
+
+def test_pallas_epoch_cli_guards():
+    """pallas_epoch misuse fails with named errors before any device work:
+    --parallel, missing --cached, and untakeable batch sizes."""
+    with pytest.raises(SystemExit, match="parallel"):
+        main(["--kernel", "pallas_epoch", "--cached", "--parallel"])
+    with pytest.raises(SystemExit, match="cached"):
+        main(["--kernel", "pallas_epoch"])
+    with pytest.raises(SystemExit, match="divisible by 8"):
+        main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "100"])
+    with pytest.raises(SystemExit, match="divisible by 8"):
+        main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "2048"])

@@ -240,3 +240,62 @@ def test_pallas_rng_rejected_on_interpreter():
     with pytest.raises(ValueError, match="pallas_rng"):
         _loss_and_grads(params, jnp.asarray(x), jnp.asarray(y),
                         jax.random.key(0), "pallas_rng", True)
+
+
+@tpu_only
+def test_epoch_kernel_trains_and_matches_per_step_kernel():
+    """pallas_epoch (whole epoch, VMEM-resident weights, in-kernel SGD) must
+    track the per-step pallas kernel's curve within dropout-stream noise."""
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist, normalize_images
+
+    split = synthetic_mnist(4096, seed=3)
+    x_all = jnp.asarray(normalize_images(split.images))
+    y_all = jnp.asarray(split.labels.astype(np.int32))
+    idxs = jnp.asarray(
+        np.arange(4096, dtype=np.int32).reshape(1, 32, 128).repeat(3, 0))
+
+    means = {}
+    for kern in ("pallas", "pallas_epoch"):
+        run = make_run_fn(lr=0.01, kernel=kern)
+        _, _, losses = run(init_mlp(jax.random.key(0)), jax.random.key(1),
+                           x_all, y_all, idxs)
+        losses = np.asarray(losses)
+        assert np.isfinite(losses).all()
+        means[kern] = losses.mean(axis=1)
+    a, b = means["pallas"], means["pallas_epoch"]
+    assert b[-1] < b[0] * 0.7          # it actually trains
+    np.testing.assert_allclose(a, b, rtol=0.15)  # same curve, other stream
+
+
+@tpu_only
+def test_epoch_kernel_deterministic_per_seed():
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(256)
+    p1, l1 = epoch_fused_sgd(params, x, y, 5, 0.01, 128)
+    p2, l2 = epoch_fused_sgd(params, x, y, 5, 0.01, 128)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    for u, v in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+    assert l1.shape == (2,)  # 256 rows / batch 128 -> 2 per-step losses
+
+
+def test_epoch_kernel_rejects_unaligned_batch():
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(200)
+    with pytest.raises(ValueError, match="divisible by 8"):
+        epoch_fused_sgd(params, x, y, 1, 0.01, 100)
+
+
+def test_epoch_kernel_rejected_by_dp_and_interpreter():
+    """make_dp_run_fn must refuse pallas_epoch (no per-step allreduce), and
+    the serial path must refuse it off-TPU."""
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn, make_run_fn
+    mesh = data_parallel_mesh()
+    with pytest.raises(ValueError, match="allreduce"):
+        make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch")
+    with pytest.raises(ValueError, match="pallas_epoch"):
+        make_run_fn(lr=0.01, kernel="pallas_epoch", interpret=True)
