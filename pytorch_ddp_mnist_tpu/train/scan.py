@@ -175,7 +175,7 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
                              "have no interpreter lowering)")
         from ..ops.pallas_step import epoch_fused_sgd
 
-        @partial(jax.jit, donate_argnums=(0, 1), static_argnames=())
+        @partial(jax.jit, donate_argnums=(0, 1))
         def run_epochal(params, key, x_all, y_all, idxs):
             batch = idxs.shape[2]
 
@@ -189,7 +189,7 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
                 yp = jnp.take(y_all, rows, axis=0)
                 params, losses = epoch_fused_sgd(params, xp, yp, seed,
                                                  lr, batch)
-                out = ((losses, ((params, key))) if snapshots else losses)
+                out = ((losses, (params, key)) if snapshots else losses)
                 return (params, key), out
 
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
