@@ -87,9 +87,10 @@ def epoch_batch_indices(sampler, batch_size: int) -> np.ndarray:
 
 def resolve_kernel(dtype: str, on_tpu: bool) -> str:
     """The `--kernel auto` policy (bench.py and the trainer CLI): fused
-    Pallas step on TPU (fastest measured variant — docs/PERF.md), XLA
-    autodiff elsewhere (Pallas off-TPU is interpreter-only) — and for bf16
-    anywhere, since the Pallas kernel computes in f32 (_check_kernel)."""
+    Pallas step on TPU (fastest measured PER-STEP variant — docs/PERF.md;
+    bench additionally promotes single-chip runs to the whole-epoch kernel),
+    XLA autodiff elsewhere (Pallas off-TPU is interpreter-only) — and for
+    bf16 anywhere, since the Pallas kernel computes in f32 (_check_kernel)."""
     return "pallas" if on_tpu and dtype == "float32" else "xla"
 
 
