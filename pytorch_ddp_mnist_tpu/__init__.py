@@ -16,4 +16,4 @@ Layer map (mirrors reference SURVEY.md §1):
   L1 model/loop  -> pytorch_ddp_mnist_tpu.models, .ops, .train
 """
 
-__version__ = "0.2.0"
+__version__ = "0.4.0"

@@ -38,9 +38,14 @@ def main(argv=None) -> int:
         raise SystemExit(f"--kernel {tcfg['kernel']} runs inside the epoch "
                          "scan; add --cached")
     if tcfg["kernel"] == "pallas_epoch" and tcfg["parallel"]:
-        raise SystemExit("--kernel pallas_epoch fuses the whole epoch in "
-                         "one kernel with no per-step allreduce (single-"
-                         "replica semantics); drop --parallel")
+        # stderr (stdout stays machine-parseable epoch lines); printed
+        # pre-wireup so a user sees it even if rendezvous then hangs —
+        # worth the per-process duplication in multi-process runs.
+        print("[experimental] --kernel pallas_epoch --parallel: per-step "
+              "DDP mean-gradients via the IN-KERNEL ICI ring allreduce "
+              "(weights stay VMEM-resident on every chip). Semantically "
+              "pinned by tests at 1 device; the multi-chip ring has not "
+              "executed on real hardware yet", file=sys.stderr, flush=True)
     if tcfg["kernel"] == "pallas_epoch":
         from ..ops.pallas_step import EPOCH_KERNEL_MAX_BATCH
         if (tcfg["batch_size"] % 8 != 0

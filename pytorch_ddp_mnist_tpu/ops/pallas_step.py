@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..data.mnist import MNIST_MEAN, MNIST_STD
 from ..models.mlp import MLP_DIMS, DROPOUT_RATE
 
 IN_DIM, HIDDEN1, HIDDEN2, NUM_CLASSES = MLP_DIMS
@@ -71,6 +72,17 @@ _KEEP_THRESH = int(round((1.0 - DROPOUT_RATE) * 2**32))
 # B=1024, inside the ~16 MB/core VMEM; B=2048 is not. (The per-step kernel
 # instead grids over MAX_BATCH_BLOCK rows and takes any size.)
 EPOCH_KERNEL_MAX_BATCH = 1024
+
+# DP epoch kernel: the gradient comm buffer packs every grad tensor into one
+# (EPOCH_COMM_ROWS, 128) f32 block — gw1 rows [0,784), gb1 [784], gw2
+# [785,913), gb2 [913], gw3 [914,1042).
+EPOCH_COMM_ROWS = IN_DIM + 1 + HIDDEN2 + 1 + PADDED_CLASSES   # 1042
+# The ring all-gather keeps one comm slot PER DEVICE in VMEM (n x 533 KB) so
+# every replica can sum contributions in the same fixed order (bitwise-
+# identical averaged grads -> weights stay in lockstep without a broadcast).
+# 8 slots ≈ 4.3 MB next to the resident weights and batch blocks; past that
+# the design owes a reduce-scatter ring instead (documented in docs/PERF.md).
+EPOCH_KERNEL_MAX_DEVICES = 8
 
 
 def _make_fused_kernel(total_batch: int, block: int,
@@ -103,7 +115,9 @@ def _make_fused_kernel(total_batch: int, block: int,
         pid = pl.program_id(0)
         x = x_ref[:]
         if in_kernel_rng:
-            pltpu.prng_seed(m_ref[0] + pid)
+            # hardware-hashed (seed, block) pair — see _make_epoch_kernel's
+            # seed note for why this is not seed + pid
+            pltpu.prng_seed(m_ref[0], pid)
             bits = pltpu.bitcast(
                 pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
             m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
@@ -289,7 +303,9 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
     return loss[0, 0], grads
 
 
-def _make_epoch_kernel(block: int, lr: float):
+def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
+                       uint8_in: bool = False, axis_name: str | None = None,
+                       n_devices: int = 1):
     """Whole-EPOCH kernel: grid = (nsteps,), one SGD step per grid iteration,
     weights VMEM-RESIDENT for the entire epoch.
 
@@ -299,11 +315,41 @@ def _make_epoch_kernel(block: int, lr: float):
     iterations (copied into the pinned output refs at iteration 0, updated in
     place by the in-kernel SGD), and are flushed once at epoch end. The
     epoch's batches stream through the pipelined x/y input blocks; dropout is
-    drawn in-kernel per step (core PRNG, seed+step stream, same Bernoulli
-    keep distribution as every other engine)."""
+    drawn in-kernel per step by default (core PRNG, hardware-hashed
+    (seed, step) stream, same Bernoulli keep distribution as every other
+    engine).
 
-    def kernel(x_ref, y_ref, seed_ref, w1_ref, b1_ref, w2_ref, b2_ref,
-               w3_ref, loss_ref, ow1, ob1, ow2, ob2, ow3):
+    `in_kernel_rng=False`: the third input is a streamed (block, HIDDEN1)
+    pre-scaled mask block instead of the SMEM seed — no Mosaic-only PRNG ops,
+    so the kernel runs under the Pallas interpreter (CPU CI coverage of the
+    whole wrapper; the seeds->mask mapping is abstracted to the caller).
+
+    `uint8_in=True`: x blocks arrive as RAW uint8 pixels and the kernel
+    normalizes on the VPU (/255 -> -mean -> /std, the normalize_images
+    chain) — the epoch's input stream through HBM/VMEM is 4x smaller than
+    pre-normalized f32, and no f32 epoch image array is ever materialized.
+
+    `n_devices > 1` (with `axis_name`, called inside shard_map): the DDP
+    variant — after each step's local grads, an in-kernel ICI ring
+    all-gathers every replica's packed gradient block, each replica sums the
+    slots in the same fixed order (bitwise-identical mean on every chip, so
+    the VMEM-resident weights stay in lockstep with no broadcast), and the
+    SGD update applies the mean. This is the per-step DDP allreduce riding
+    ICI remote DMAs *inside* the kernel grid — the one thing the
+    single-replica epoch kernel couldn't express (VERDICT r2 #8). Per step:
+    a 2-neighbor handshake (regular semaphores) fences the previous step's
+    slot reuse, then n-1 pipelined hops forward origin-indexed slots around
+    the ring (per-hop DMA semaphores — no cross-hop signal conflation)."""
+    dp = n_devices > 1
+
+    def kernel(*refs):
+        if dp:
+            (x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
+             loss_ref, ow1, ob1, ow2, ob2, ow3,
+             comm, send_sems, recv_sems, lsem, rsem) = refs
+        else:
+            (x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
+             loss_ref, ow1, ob1, ow2, ob2, ow3) = refs
         f32 = jnp.float32
         pid = pl.program_id(0)
 
@@ -315,13 +361,35 @@ def _make_epoch_kernel(block: int, lr: float):
             ob2[:] = b2_ref[:]
             ow3[:] = w3_ref[:]
 
-        pltpu.prng_seed(seed_ref[0] + pid)
-        bits = pltpu.bitcast(
-            pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
-        m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
-                      f32(1.0 / (1.0 - DROPOUT_RATE)), f32(0.0))
+        me = jax.lax.axis_index(axis_name) if dp else None
+        if in_kernel_rng:
+            # Multi-word seed: the hardware hashes (epoch_seed[, replica],
+            # step) into the stream state, so per-step streams are mixed
+            # non-linearly — no contiguous seed-range reuse across epochs (a
+            # seed+pid sum makes nearby epochs' step ranges overlap at
+            # percent-level probability over long runs). The replica word
+            # gives each DP rank an independent dropout stream (SURVEY.md §7
+            # parity item 4).
+            if dp:
+                pltpu.prng_seed(m_ref[0], me, pid)
+            else:
+                pltpu.prng_seed(m_ref[0], pid)
+            bits = pltpu.bitcast(
+                pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
+            m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
+                          f32(1.0 / (1.0 - DROPOUT_RATE)), f32(0.0))
+        else:
+            m = m_ref[:]
 
         x = x_ref[:]
+        if uint8_in:
+            # normalize_images' op chain, per block, on the VPU. Mosaic has
+            # no direct u8->f32 convert; widen through int32 (exact for
+            # 0..255, so the math is identical to the host/XLA normalize).
+            x = x.astype(jnp.int32).astype(f32)
+            x = x / f32(255.0)
+            x = x - f32(MNIST_MEAN)
+            x = x / f32(MNIST_STD)
         # ---- forward (weights read from the resident, updated refs) ----
         z1 = jax.lax.dot_general(x, ow1[:], (((1,), (0,)), ((), ())),
                                  preferred_element_type=f32) + ob1[:]
@@ -371,6 +439,73 @@ def _make_epoch_kernel(block: int, lr: float):
                                   preferred_element_type=f32)
         gb1 = jnp.sum(dz1, axis=0, keepdims=True)
 
+        if dp:
+            n = n_devices
+            left = jax.lax.rem(me + (n - 1), n)
+            right = jax.lax.rem(me + 1, n)
+            did = pltpu.DeviceIdType.LOGICAL
+
+            @pl.when(pid == 0)
+            def _entry_barrier():
+                # Gate the FIRST remote signal of this kernel invocation on
+                # both neighbors having entered the kernel: the per-step
+                # handshake below signals scratch REGULAR semaphores, which
+                # is only safe once the neighbor's kernel (and its scratch
+                # allocation) is live. The global barrier semaphore (bound
+                # to collective_id) exists exactly for this cross-entry
+                # rendezvous.
+                bsem = pltpu.get_barrier_semaphore()
+                pltpu.semaphore_signal(bsem, inc=1, device_id=(left,),
+                                       device_id_type=did)
+                pltpu.semaphore_signal(bsem, inc=1, device_id=(right,),
+                                       device_id_type=did)
+                pltpu.semaphore_wait(bsem, 2)
+
+            # Pack this replica's grads into its origin-indexed comm slot.
+            comm[me, pl.ds(0, IN_DIM), :] = gw1
+            comm[me, pl.ds(IN_DIM, 1), :] = gb1
+            comm[me, pl.ds(IN_DIM + 1, HIDDEN2), :] = gw2
+            comm[me, pl.ds(IN_DIM + 1 + HIDDEN2, 1), :] = gb2
+            comm[me, pl.ds(IN_DIM + 2 + HIDDEN2, PADDED_CLASSES), :] = gw3
+            # Per-step neighbor handshake: my hop-0 send overwrites a slot on
+            # `right` that its PREVIOUS step read during the fixed-order sum,
+            # so I must not send until both neighbors have finished their
+            # previous step. Dedicated per-neighbor semaphores (I signal
+            # right's lsem as its left neighbor, and vice versa) — a shared
+            # counter could conflate one neighbor running two steps ahead.
+            pltpu.semaphore_signal(lsem, inc=1, device_id=(right,),
+                                   device_id_type=did)
+            pltpu.semaphore_signal(rsem, inc=1, device_id=(left,),
+                                   device_id_type=did)
+            pltpu.semaphore_wait(lsem, 1)
+            pltpu.semaphore_wait(rsem, 1)
+            # Ring all-gather: hop h forwards the slot received at hop h-1
+            # (hop 0: my own) to the right; slots keep their ORIGIN index on
+            # every device. Per-hop DMA semaphores so an out-of-order arrival
+            # of hop h+1's signal can never satisfy hop h's wait.
+            for h in range(n - 1):
+                send_slot = jax.lax.rem(me - h + n * 2, n)
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=comm.at[send_slot],
+                    dst_ref=comm.at[send_slot],
+                    send_sem=send_sems.at[h],
+                    recv_sem=recv_sems.at[h],
+                    device_id=(right,), device_id_type=did)
+                rdma.start()
+                rdma.wait()   # my send done AND my hop-h chunk arrived
+            # Fixed-order sum over origin slots: every replica reduces in the
+            # identical order -> bitwise-identical mean grads on all chips ->
+            # the resident weights stay in lockstep with no broadcast.
+            tot = comm[0]
+            for d in range(1, n):
+                tot = tot + comm[d]
+            g = tot * f32(1.0 / n)
+            gw1 = g[0:IN_DIM]
+            gb1 = g[IN_DIM:IN_DIM + 1]
+            gw2 = g[IN_DIM + 1:IN_DIM + 1 + HIDDEN2]
+            gb2 = g[IN_DIM + 1 + HIDDEN2:IN_DIM + 2 + HIDDEN2]
+            gw3 = g[IN_DIM + 2 + HIDDEN2:]
+
         ow1[:] -= lr * gw1
         ob1[:] -= lr * gb1
         ow2[:] -= lr * gw2
@@ -380,17 +515,41 @@ def _make_epoch_kernel(block: int, lr: float):
     return kernel
 
 
-def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int):
+def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
+                    masks=None, interpret: bool = False,
+                    axis_name: str | None = None, axis_size: int = 1):
     """One ENTIRE epoch as a single kernel (`--kernel pallas_epoch`):
-    (params, xp (S*B, 784) f32 pre-gathered epoch rows, yp (S*B,) int32,
+    (params, xp (S*B, 784) pre-gathered epoch rows, yp (S*B,) int32,
     seed () int32, lr, batch=B) -> (params', losses (S,)).
+
+    `xp` may be float32 (pre-normalized) or RAW uint8 pixels — uint8 streams
+    a 4x smaller input through HBM/VMEM and is normalized in-kernel on the
+    VPU (no f32 epoch array is ever materialized); the math is the same
+    normalize chain, so results match the f32 path to float rounding.
 
     The caller flattens the epoch's sampler index rows (already wrap-padded
     to full batches) into xp/yp; grid step i trains on rows [i*B, (i+1)*B).
-    Mosaic only (in-kernel PRNG + resident-weight update). Single-replica
-    semantics: the per-step DDP allreduce has no in-kernel analog here, so
-    DP meshes with more than one device must keep the per-step kernels
-    (a 1-device mesh is exactly this)."""
+    Without `axis_size` the semantics are single-replica (a 1-device DP mesh
+    is exactly this); `axis_size > 1` below adds the in-kernel DDP
+    allreduce.
+
+    `masks`: optional (S*B, HIDDEN1) pre-scaled dropout masks streamed per
+    step INSTEAD of the in-kernel PRNG draw (`seed` is then unused). With
+    masks the kernel contains no Mosaic-only ops, so `interpret=True` runs
+    it on CPU — the CI path that covers this wrapper (loss detiling, batch
+    validation, weight residency) without a TPU; `epoch_sgd_reference` is
+    the matching pure-JAX oracle. The default (masks=None) draws in-kernel
+    from the core PRNG and is Mosaic-only.
+
+    `axis_size > 1` (with `axis_name`; must be called inside shard_map over
+    that axis): the DDP epoch kernel — batch/xp/yp/masks are this REPLICA's
+    shard, and each step's SGD applies the cross-replica mean gradient via
+    the in-kernel ICI ring allreduce (see _make_epoch_kernel). The returned
+    losses are this replica's shard-local per-step means (pmean them outside
+    for the DDP-reported loss); the returned params are bitwise-identical on
+    every replica. EXPERIMENTAL: CI-covered via the n=1 degenerate + named
+    errors; the ring itself needs real multi-chip hardware to execute, which
+    this session does not have."""
     rows, dim = xp.shape
     assert dim == IN_DIM
     f32 = jnp.float32
@@ -402,13 +561,41 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int):
         raise ValueError(
             f"pallas_epoch streams each step's batch as ONE VMEM block; "
             f"batch {block} > {EPOCH_KERNEL_MAX_BATCH} exceeds its budget "
-            f"(double-buffered (B,784) f32 inputs + resident weights). "
+            f"(double-buffered (B,784) inputs + resident weights). "
             f"Use the gridded per-step kernel (--kernel pallas) instead")
     nsteps = rows // block
     assert nsteps * block == rows, (rows, block)
-    seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    in_kernel_rng = masks is None
+    if in_kernel_rng and interpret:
+        raise ValueError("the in-kernel-PRNG epoch kernel has no interpreter "
+                         "lowering; pass explicit `masks` to interpret")
+    dp = axis_size > 1
+    if dp and axis_name is None:
+        raise ValueError("epoch_fused_sgd: axis_size > 1 needs axis_name "
+                         "(the shard_map mesh axis of the DP ring)")
+    if dp and interpret:
+        raise ValueError(
+            "the DP epoch kernel's ICI ring allreduce (remote DMAs + "
+            "cross-chip semaphores) has no interpreter lowering; interpret "
+            "the n=1 degenerate or use kernel='pallas' for interpreted DP")
+    if axis_size > EPOCH_KERNEL_MAX_DEVICES:
+        raise ValueError(
+            f"pallas_epoch DP keeps one {EPOCH_COMM_ROWS}x128 f32 comm slot "
+            f"per replica in VMEM for the fixed-order ring sum; "
+            f"{axis_size} replicas > {EPOCH_KERNEL_MAX_DEVICES} exceeds the "
+            f"budget. Use the per-step kernel (--kernel pallas) on larger "
+            f"meshes")
+    uint8_in = xp.dtype == jnp.uint8
     vmem = partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     resident = lambda shape: vmem(shape, lambda i: (0, 0))  # noqa: E731
+    if in_kernel_rng:
+        third = jnp.asarray(seed, jnp.int32).reshape((1,))
+        third_spec = pl.BlockSpec((1,), lambda i: (0,),
+                                  memory_space=pltpu.SMEM)  # seed
+    else:
+        assert masks.shape == (rows, HIDDEN1), masks.shape
+        third = masks.astype(f32)
+        third_spec = vmem((block, HIDDEN1), lambda i: (i, 0))  # mask block
     w_shapes = (
         jax.ShapeDtypeStruct((IN_DIM, HIDDEN1), f32),
         jax.ShapeDtypeStruct((1, HIDDEN1), f32),
@@ -418,17 +605,33 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int):
     )
     nblocks8 = -(-nsteps // 8)
     out_shapes = (jax.ShapeDtypeStruct((nblocks8 * 8, 128), f32),) + w_shapes
+    if dp:
+        scratch_shapes = [
+            pltpu.VMEM((axis_size, EPOCH_COMM_ROWS, 128), f32),  # ring slots
+            pltpu.SemaphoreType.DMA((axis_size - 1,)),           # send, /hop
+            pltpu.SemaphoreType.DMA((axis_size - 1,)),           # recv, /hop
+            pltpu.SemaphoreType.REGULAR,                         # left ready
+            pltpu.SemaphoreType.REGULAR,                         # right ready
+        ]
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            collective_id=7, has_side_effects=True)
+    else:
+        scratch_shapes = []
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))  # steps are sequential
     loss, w1, b1, w2, b2, w3 = pl.pallas_call(
-        _make_epoch_kernel(block, lr),
+        _make_epoch_kernel(block, lr, in_kernel_rng=in_kernel_rng,
+                           uint8_in=uint8_in, axis_name=axis_name,
+                           n_devices=axis_size),
         grid=(nsteps,),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),  # steps are sequential
+        compiler_params=compiler_params,
+        scratch_shapes=scratch_shapes,
         out_shape=out_shapes,
         in_specs=[
             vmem((block, IN_DIM), lambda i: (i, 0)),          # x block
             vmem((block, 1), lambda i: (i, 0)),               # y block
-            pl.BlockSpec((1,), lambda i: (0,),
-                         memory_space=pltpu.SMEM),            # seed
+            third_spec,                                       # seed | masks
             resident((IN_DIM, HIDDEN1)),                      # w1 in
             resident((1, HIDDEN1)),
             resident((HIDDEN1, HIDDEN2)),
@@ -443,10 +646,11 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int):
             resident((1, HIDDEN2)),
             resident((HIDDEN2, PADDED_CLASSES)),
         ),
+        interpret=interpret,
     )(
-        xp.astype(f32),
+        xp if uint8_in else xp.astype(f32),
         yp.astype(jnp.int32)[:, None],
-        seed,
+        third,
         params["fc1"]["w"].astype(f32),
         params["fc1"]["b"].astype(f32)[None, :],
         params["fc2"]["w"].astype(f32),
@@ -459,6 +663,47 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int):
         "fc3": {"w": w3[:, :NUM_CLASSES]},
     }
     return new_params, loss[:nsteps, 0]
+
+
+def epoch_sgd_reference(params, xp, yp, masks, lr: float, batch: int):
+    """Pure-JAX oracle for the epoch kernel's step recurrence: same inputs
+    as epoch_fused_sgd(masks=...), implemented as a lax.scan of
+    value_and_grad steps. Runs on any backend — CI asserts the (interpreted)
+    masked kernel and the run_epochal wrapper against it, so the epoch path
+    has coverage when the Mosaic-only tests skip. Matches the kernel to
+    float-rounding (different op/reduction order), not bitwise."""
+    from .loss import cross_entropy
+    from .sgd import sgd_step
+
+    rows = xp.shape[0]
+    nsteps = rows // batch
+    assert nsteps * batch == rows, (rows, batch)
+    f32 = jnp.float32
+    xs = xp.reshape(nsteps, batch, IN_DIM)
+    ys = yp.reshape(nsteps, batch).astype(jnp.int32)
+    ms = masks.reshape(nsteps, batch, HIDDEN1).astype(f32)
+
+    def step(p, xym):
+        xb, yb, mb = xym
+        if xb.dtype == jnp.uint8:
+            xb = xb.astype(f32)
+            xb = xb / f32(255.0)
+            xb = xb - f32(MNIST_MEAN)
+            xb = xb / f32(MNIST_STD)
+        else:
+            xb = xb.astype(f32)
+
+        def loss_fn(pp):
+            z1 = xb @ pp["fc1"]["w"] + pp["fc1"]["b"]
+            d1 = jnp.maximum(z1, 0.0) * mb      # pre-scaled inverted dropout
+            z2 = d1 @ pp["fc2"]["w"] + pp["fc2"]["b"]
+            h2 = jnp.maximum(z2, 0.0)
+            return cross_entropy(h2 @ pp["fc3"]["w"], yb)
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        return sgd_step(p, grads, lr), loss
+
+    return jax.lax.scan(step, params, (xs, ys, ms))
 
 
 def dropout_mask(key: jax.Array, batch: int, *, train: bool = True):

@@ -60,6 +60,12 @@ def _topology_device_array(axis_sizes, devices):
             # Factor the FIRST axis across granules: dp jobs shard data over
             # granules first (DCN), then within each granule's chips (ICI).
             if shape[0] % n_granules != 0:
+                import warnings
+                warnings.warn(
+                    f"mesh axis 0 (size {shape[0]}) is not divisible by the "
+                    f"{n_granules} DCN granules (slices/processes); falling "
+                    f"back to process-major device order — ring collectives "
+                    f"may take DCN-crossing hops", RuntimeWarning)
                 return None
             dcn_shape = (n_granules,) + (1,) * (len(shape) - 1)
             ici_shape = (shape[0] // n_granules,) + shape[1:]

@@ -198,6 +198,50 @@ def _honor_platform_env() -> None:
             pass
 
 
+class BackendUnavailableError(RuntimeError):
+    """The accelerator backend stayed unavailable for the whole retry budget."""
+
+
+def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0):
+    """Poll jax.devices() until the backend initializes; bounded retry.
+
+    A tunneled/remote TPU backend can be transiently UNAVAILABLE (the tunnel
+    drops and recovers); a bare first query would kill the job on a blip the
+    next poll would have survived. xla_bridge caches a failed init, so each
+    retry clears the backend cache before re-probing. Returns the live device
+    list; raises BackendUnavailableError once max_wait_s is exhausted.
+
+    The healthy path costs nothing extra: the first probe is immediate and
+    its result is returned directly.
+    """
+    import time
+
+    import jax
+
+    deadline = time.monotonic() + max_wait_s
+    attempt = 0
+    while True:
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            attempt += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise BackendUnavailableError(
+                    f"backend unavailable after {attempt} attempts over "
+                    f"{max_wait_s:.0f}s: {e}") from e
+            import sys
+            print(f"wireup: backend unavailable (attempt {attempt}), "
+                  f"retrying for another {remaining:.0f}s: {e}",
+                  file=sys.stderr, flush=True)  # keep stdout machine-readable
+            time.sleep(min(poll_s, max(remaining, 0.1)))
+            try:
+                from jax._src import xla_bridge
+                xla_bridge._clear_backends()
+            except Exception:
+                pass  # older/newer jax: fall through and re-probe anyway
+
+
 def initialize_runtime(method: str = "auto") -> Runtime:
     """Resolve topology and (if multi-process) rendezvous via
     jax.distributed.initialize. Safe to call in single-process runs.

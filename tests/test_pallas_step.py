@@ -282,6 +282,55 @@ def test_epoch_kernel_deterministic_per_seed():
     assert l1.shape == (2,)  # 256 rows / batch 128 -> 2 per-step losses
 
 
+@tpu_only
+def test_epoch_kernel_dp_wrapper_matches_serial_on_hardware():
+    """make_dp_run_fn(kernel='pallas_epoch') on the real chip's 1-device
+    mesh: Mosaic-compiles the shard_map-wrapped epoch kernel (the DP entry
+    path; ring degenerate) and must equal the serial run exactly — same
+    seed words, same kernel."""
+    from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn, make_run_fn
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.integers(0, 256, (512, 784), dtype=np.uint8))
+    y = jnp.asarray(rng.integers(0, 10, 512).astype(np.int32))
+    idxs = jnp.asarray(np.stack([
+        np.random.default_rng(e).permutation(512).reshape(4, 128)
+        for e in range(3)]).astype(np.int32))
+    mesh1 = make_mesh([1], ["dp"], jax.devices()[:1])
+
+    def fresh():
+        return (init_mlp(jax.random.key(0)), jax.random.key(3))
+
+    p_dp, _, l_dp = make_dp_run_fn(mesh1, lr=0.01,
+                                   kernel="pallas_epoch")(*fresh(), x, y,
+                                                          idxs)
+    p_s, _, l_s = make_run_fn(lr=0.01, kernel="pallas_epoch")(*fresh(), x,
+                                                              y, idxs)
+    np.testing.assert_array_equal(np.asarray(l_dp), np.asarray(l_s))
+    _tree_allclose(p_dp, p_s, rtol=0, atol=0)
+
+
+@tpu_only
+def test_epoch_kernel_uint8_matches_f32_on_hardware():
+    """The uint8-streaming epoch kernel (in-kernel VPU normalize) must match
+    the pre-normalized f32 path: same seed -> same in-kernel dropout masks,
+    and the int32-widened normalize is exact for 0..255 — observed bitwise
+    equal on hardware."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    from pytorch_ddp_mnist_tpu.data.mnist import MNIST_MEAN, MNIST_STD
+    rng = np.random.default_rng(2)
+    x_u8 = rng.integers(0, 256, (512, 784), dtype=np.uint8)
+    y = jnp.asarray(rng.integers(0, 10, 512).astype(np.int32))
+    params = init_mlp(jax.random.key(0))
+    pu, lu = epoch_fused_sgd(params, jnp.asarray(x_u8), y, 11, 0.01, 128)
+    xf = (x_u8.astype(np.float32) / np.float32(255.0)
+          - np.float32(MNIST_MEAN)) / np.float32(MNIST_STD)
+    pf, lf = epoch_fused_sgd(params, jnp.asarray(xf), y, 11, 0.01, 128)
+    np.testing.assert_allclose(np.asarray(lu), np.asarray(lf),
+                               rtol=1e-6, atol=1e-7)
+    _tree_allclose(pu, pf, rtol=1e-6, atol=1e-7)
+
+
 def test_epoch_kernel_rejects_unaligned_batch():
     from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
     params = init_mlp(jax.random.key(0))
@@ -290,12 +339,145 @@ def test_epoch_kernel_rejects_unaligned_batch():
         epoch_fused_sgd(params, x, y, 1, 0.01, 100)
 
 
-def test_epoch_kernel_rejected_by_dp_and_interpreter():
-    """make_dp_run_fn must refuse pallas_epoch (no per-step allreduce), and
-    the serial path must refuse it off-TPU."""
+def test_epoch_kernel_dp_named_errors():
+    """The DP epoch kernel's constraint surface: no interpreter for the
+    multi-device ring, no unroll, bounded replica count."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        EPOCH_KERNEL_MAX_DEVICES, epoch_fused_sgd)
     from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn, make_run_fn
-    mesh = data_parallel_mesh()
-    with pytest.raises(ValueError, match="allreduce"):
-        make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch")
-    with pytest.raises(ValueError, match="pallas_epoch"):
-        make_run_fn(lr=0.01, kernel="pallas_epoch", interpret=True)
+    mesh = data_parallel_mesh()   # 8 virtual CPU devices
+    with pytest.raises(ValueError, match="interpreter"):
+        make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch", interpret=True)
+    with pytest.raises(ValueError, match="unroll"):
+        make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch", unroll=2)
+    with pytest.raises(ValueError, match="unroll"):
+        make_run_fn(lr=0.01, kernel="pallas_epoch", unroll=4)
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(16)
+    with pytest.raises(ValueError, match=str(EPOCH_KERNEL_MAX_DEVICES)):
+        epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_name="dp",
+                        axis_size=EPOCH_KERNEL_MAX_DEVICES + 1)
+    with pytest.raises(ValueError, match="axis_name"):
+        epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_size=2)
+
+
+def test_epoch_kernel_dp_single_device_mesh_matches_serial_interpret():
+    """kernel='pallas_epoch' through make_dp_run_fn on a 1-device mesh (the
+    ring degenerates away) must reproduce the serial run_epochal bit-for-bit
+    on the interpreter — pins the shard_map wrapper's gather/pmean/key
+    plumbing for the DP epoch path."""
+    from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn, make_run_fn
+    nsteps, batch, epochs = 4, 16, 2
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, 256, (nsteps * batch, 784),
+                                 dtype=np.uint8))
+    y = jnp.asarray(rng.integers(0, 10, nsteps * batch).astype(np.int32))
+    idxs = jnp.asarray(np.stack([
+        np.random.default_rng(e).permutation(nsteps * batch).reshape(
+            nsteps, batch) for e in range(epochs)]).astype(np.int32))
+    mesh1 = make_mesh([1], ["dp"], jax.devices()[:1])
+
+    def fresh():
+        return (init_mlp(jax.random.key(0)), jax.random.key(3))
+
+    run_dp = make_dp_run_fn(mesh1, lr=0.05, kernel="pallas_epoch",
+                            interpret=True)
+    p_dp, _, l_dp = run_dp(*fresh(), x, y, idxs)
+    run_s = make_run_fn(lr=0.05, kernel="pallas_epoch", interpret=True)
+    p_s, _, l_s = run_s(*fresh(), x, y, idxs)
+    np.testing.assert_allclose(np.asarray(l_dp), np.asarray(l_s), rtol=1e-6)
+    _tree_allclose(p_dp, p_s, rtol=1e-6)
+
+
+def test_epoch_in_kernel_rng_rejected_on_interpreter():
+    """The in-kernel-PRNG epoch kernel (masks=None) has no interpreter
+    lowering; the wrapper must say so by name."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(16)
+    with pytest.raises(ValueError, match="masks"):
+        epoch_fused_sgd(params, x, y, 1, 0.01, 16, interpret=True)
+
+
+def _epoch_data(nsteps=4, batch=16, seed=0, uint8=False):
+    rng = np.random.default_rng(seed)
+    rows = nsteps * batch
+    if uint8:
+        x = jnp.asarray(rng.integers(0, 256, (rows, 784), dtype=np.uint8))
+    else:
+        x = jnp.asarray(rng.normal(size=(rows, 784)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, rows).astype(np.int32))
+    return x, y
+
+
+def _epoch_masks(key, nsteps, batch):
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import HIDDEN1
+    masks = jax.vmap(lambda k: dropout_mask(k, batch))(
+        jax.random.split(key, nsteps))
+    return masks.reshape(nsteps * batch, HIDDEN1)
+
+
+@pytest.mark.parametrize("uint8", [False, True])
+def test_epoch_masked_kernel_matches_pure_jax_oracle(uint8):
+    """CPU CI coverage of the epoch-kernel wrapper (VERDICT r2 #4): the
+    interpreted masked kernel — loss detiling from the (8,128) output, block
+    streaming, in-kernel normalize (uint8), weight residency/update — must
+    reproduce the pure-JAX oracle of the same recurrence. Observed exact on
+    CPU (same f32 ops); tolerance covers reduction-order freedom."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (epoch_fused_sgd,
+                                                       epoch_sgd_reference)
+    nsteps, batch = 12, 16   # crosses an (8,128) loss-tile boundary
+    x, y = _epoch_data(nsteps, batch, seed=3, uint8=uint8)
+    masks = _epoch_masks(jax.random.key(5), nsteps, batch)
+    params = init_mlp(jax.random.key(0))
+    pk, kl = epoch_fused_sgd(params, x, y, None, 0.05, batch,
+                             masks=masks, interpret=True)
+    pr, rl = epoch_sgd_reference(params, x, y, masks, 0.05, batch)
+    assert kl.shape == (nsteps,)
+    np.testing.assert_allclose(np.asarray(kl), np.asarray(rl),
+                               rtol=1e-5, atol=1e-6)
+    _tree_allclose(pk, pr, rtol=1e-5, atol=1e-6)
+
+
+def test_epoch_wrapper_interpret_snapshots_plumbing():
+    """run_epochal's plumbing (key split chain, per-epoch gather, snapshot
+    stacking) on CPU: the interpreted kernel='pallas_epoch' run must equal
+    composing epoch_fused_sgd by hand with the same key chain and the same
+    seeds->mask mapping."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+    nsteps, batch, epochs = 4, 16, 3
+    x, y = _epoch_data(nsteps, batch, seed=7, uint8=True)
+    idxs = jnp.asarray(np.stack([
+        np.random.default_rng(e).permutation(nsteps * batch).reshape(
+            nsteps, batch) for e in range(epochs)]).astype(np.int32))
+    params, key = init_mlp(jax.random.key(0)), jax.random.key(9)
+
+    run = make_run_fn(lr=0.05, kernel="pallas_epoch", interpret=True,
+                      snapshots=True)
+    # run() donates params/key; hand it copies so the manual loop below
+    # can still use the originals
+    rp, rkey, losses, (p_snaps, k_snaps) = run(
+        jax.tree_util.tree_map(jnp.array, params),
+        jax.random.wrap_key_data(jnp.array(jax.random.key_data(key))),
+        x, y, idxs)
+    assert losses.shape == (epochs, nsteps)
+
+    # manual composition with the identical key/mask schedule
+    mp, mkey = params, key
+    for e in range(epochs):
+        mkey, sub = jax.random.split(mkey)
+        rows = idxs[e].reshape(-1)
+        masks = _epoch_masks(sub, nsteps, batch)
+        mp, le = epoch_fused_sgd(mp, jnp.take(x, rows, axis=0),
+                                 jnp.take(y, rows, axis=0), None, 0.05,
+                                 batch, masks=masks, interpret=True)
+        np.testing.assert_allclose(np.asarray(losses[e]), np.asarray(le),
+                                   rtol=1e-6)
+        # snapshot e must be the params AFTER epoch e
+        _tree_allclose(jax.tree_util.tree_map(lambda a, _e=e: a[_e], p_snaps),
+                       mp, rtol=1e-6)
+    _tree_allclose(rp, mp, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(rkey)),
+                                  np.asarray(jax.random.key_data(mkey)))

@@ -141,6 +141,37 @@ def test_resume_from_reference_produced_model_pt(tmp_path):
     assert "b" not in params["fc3"]  # output layer is bias-free
 
 
+def test_torch_checkpoint_ddp_wrapped_module_prefix_loads(tmp_path):
+    """A still-DDP-wrapped save ('module.'-prefixed keys — the reference
+    always unwraps first, ddp_tutorial_multi_gpu.py:118, but a user's own
+    save may not) loads by stripping the uniform prefix."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    torch.manual_seed(4)
+    model = nn.Sequential(
+        nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
+        nn.Linear(128, 128), nn.ReLU(),
+        nn.Linear(128, 10, bias=False))
+    wrapped = {f"module.{k}": v for k, v in model.state_dict().items()}
+    path = str(tmp_path / "model.pt")
+    torch.save(wrapped, path)
+
+    params = load_checkpoint(path, init_mlp(jax.random.key(0)))
+    np.testing.assert_allclose(np.asarray(params["fc1"]["w"]),
+                               model.state_dict()["0.weight"].numpy().T)
+
+
+def test_torch_checkpoint_unknown_layout_names_expected_keys(tmp_path):
+    """A state_dict with non-reference key names must fail with a ValueError
+    listing the expected reference keys, not a bare KeyError."""
+    torch = pytest.importorskip("torch")
+    path = str(tmp_path / "model.pt")
+    torch.save({"encoder.weight": torch.zeros(2, 2)}, path)
+    with pytest.raises(ValueError, match=r"0\.weight.*expected"):
+        load_checkpoint(path, init_mlp(jax.random.key(0)))
+
+
 def test_torch_checkpoint_shape_mismatch_fails_at_load(tmp_path):
     """A wrong-shape model.pt (e.g. hidden=64 variant) must fail AT LOAD with
     a named error, not later as an opaque XLA shape error."""
