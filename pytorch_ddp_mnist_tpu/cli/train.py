@@ -31,9 +31,14 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
-    if tcfg["kernel"].startswith("pallas") and tcfg["dtype"] != "float32":
-        raise SystemExit(f"--kernel {tcfg['kernel']} computes in float32 "
-                         "(MXU accumulation); drop --dtype bfloat16")
+    if tcfg["kernel"] != "auto":
+        # single source of truth for kernel/dtype compatibility (e.g.
+        # pallas_epoch composes with bfloat16, the per-step kernels do not)
+        from ..train.scan import _check_kernel
+        try:
+            _check_kernel(tcfg["kernel"], tcfg["dtype"])
+        except ValueError as e:
+            raise SystemExit(str(e))
     if tcfg["kernel"] in ("pallas_rng", "pallas_epoch") and not tcfg["cached"]:
         raise SystemExit(f"--kernel {tcfg['kernel']} runs inside the epoch "
                          "scan; add --cached")

@@ -97,7 +97,10 @@ def resolve_kernel(dtype: str, on_tpu: bool) -> str:
 def _check_kernel(kernel: str, dtype: str) -> None:
     if kernel not in ("xla", "pallas", "pallas_rng", "pallas_epoch"):
         raise ValueError(f"unknown kernel {kernel!r}")
-    if kernel.startswith("pallas") and dtype != "float32":
+    # pallas_epoch composes with bfloat16 (bf16 matmul operands, f32
+    # accumulation + f32 master weights — ops/pallas_step.py); the per-step
+    # kernels stay f32-only.
+    if (kernel in ("pallas", "pallas_rng") and dtype != "float32"):
         raise ValueError(f"kernel {kernel!r} computes in float32 "
                          "(MXU f32 accumulation); drop dtype=bfloat16")
 
@@ -148,7 +151,8 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
 
 def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
                        pmean_axis: str | None = None,
-                       axis_size: int = 1) -> Callable:
+                       axis_size: int = 1,
+                       compute_bf16: bool = False) -> Callable:
     """The shared per-EPOCH scan body of the kernel='pallas_epoch' programs
     (serial make_run_fn and DP make_dp_run_fn): derive the epoch's dropout
     source from the key chain, gather the epoch rows (uint8 pass-through —
@@ -179,14 +183,15 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
             masks = jax.vmap(lambda k: dropout_mask(k, batch))(subs)
             params, losses = epoch_fused_sgd(
                 params, xp, yp, None, lr, batch,
-                masks=masks.reshape(rows.shape[0], -1), interpret=True)
+                masks=masks.reshape(rows.shape[0], -1), interpret=True,
+                compute_bf16=compute_bf16)
         else:
             seed = jax.lax.bitcast_convert_type(
                 jax.random.key_data(sub).ravel()[0], jnp.int32)
             params, losses = epoch_fused_sgd(
                 params, xp, yp, seed, lr, batch,
                 axis_name=pmean_axis if axis_size > 1 else None,
-                axis_size=axis_size)
+                axis_size=axis_size, compute_bf16=compute_bf16)
         if pmean_axis is not None:
             # the DDP-reported loss: mean over replicas of the shard-local
             # per-step means (params are already lockstep-identical)
@@ -229,7 +234,8 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
         @partial(jax.jit, donate_argnums=(0, 1))
         def run_epochal(params, key, x_all, y_all, idxs):
             epoch = _make_epochal_body(x_all, y_all, lr, interpret=interpret,
-                                       snapshots=snapshots)
+                                       snapshots=snapshots,
+                                       compute_bf16=dtype == "bfloat16")
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
             if snapshots:
                 losses, (p_snaps, k_snaps) = out
@@ -352,7 +358,8 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
             epoch = _make_epochal_body(x_all, y_all, lr, interpret=interpret,
                                        snapshots=snapshots,
                                        pmean_axis=DATA_AXIS,
-                                       axis_size=n_dev)
+                                       axis_size=n_dev,
+                                       compute_bf16=dtype == "bfloat16")
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
             if snapshots:
                 losses, (p_snaps, k_snaps) = out

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import os
 import sys
-from typing import Callable, Iterable, Iterator, TypeVar
+from typing import Callable, Iterable, TypeVar
 
 T = TypeVar("T")
 
@@ -50,7 +50,7 @@ def rank_zero_log(log: Callable[[str], None] = print) -> Callable[[str], None]:
 
 
 def progress(iterable: Iterable[T], desc: str = "", *,
-             disable: bool | None = None) -> Iterator[T]:
+             disable: bool | None = None) -> Iterable[T]:
     """tqdm-style progress iteration (reference: tqdm wraps both hot loops,
     ddp_tutorial_multi_gpu.py:85,101). Falls back to a plain iterator when
     tqdm is unavailable, `disable` is set, DISABLE_TQDM=1, stderr is not a
@@ -71,4 +71,7 @@ def progress(iterable: Iterable[T], desc: str = "", *,
         from tqdm import tqdm
     except ImportError:
         return iter(iterable)
-    return iter(tqdm(iterable, desc=desc))
+    # the tqdm INSTANCE, not iter(instance): tqdm is itself iterable, and
+    # callers (train.loop._LiveLoss) need its set_postfix_str for the async
+    # live-loss display — iter() would hand back a bare generator without it
+    return tqdm(iterable, desc=desc)

@@ -43,6 +43,11 @@ VARIANTS = [
     # and bench.py prints a warning to stderr.
     ("f32 / whole-epoch kernel, uint8 streaming (single-chip headline)",
      ["--kernel", "pallas_epoch"]),
+    # bf16 matmul operands inside the epoch kernel (f32 master weights +
+    # accumulation): the f32 epoch kernel is MXU-bound, so this targets the
+    # dominant term directly.
+    ("bf16-matmul / whole-epoch kernel, uint8 streaming",
+     ["--kernel", "pallas_epoch", "--dtype", "bfloat16"]),
 ]
 
 MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016

@@ -418,6 +418,33 @@ def _epoch_masks(key, nsteps, batch):
     return masks.reshape(nsteps * batch, HIDDEN1)
 
 
+@pytest.mark.parametrize("bf16", [False, True])
+def test_epoch_masked_kernel_bf16_matches_oracle(bf16):
+    """The bf16-matmul epoch kernel variant (bf16 operands, f32 accumulation
+    + f32 master weights) against the oracle restating the same cast points;
+    the f32 case doubles as a no-op-cast sanity check of the shared path."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (epoch_fused_sgd,
+                                                       epoch_sgd_reference)
+    nsteps, batch = 4, 16
+    x, y = _epoch_data(nsteps, batch, seed=11, uint8=True)
+    masks = _epoch_masks(jax.random.key(6), nsteps, batch)
+    params = init_mlp(jax.random.key(0))
+    pk, kl = epoch_fused_sgd(params, x, y, None, 0.05, batch,
+                             masks=masks, interpret=True, compute_bf16=bf16)
+    pr, rl = epoch_sgd_reference(params, x, y, masks, 0.05, batch,
+                                 compute_bf16=bf16)
+    tol = dict(rtol=1e-3, atol=1e-4) if bf16 else dict(rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kl), np.asarray(rl), **tol)
+    _tree_allclose(pk, pr, **tol)
+    if bf16:
+        # bf16 matmuls genuinely differ from f32 ones (sanity: the flag did
+        # something), but train the same model to similar losses
+        _, rl32 = epoch_sgd_reference(params, x, y, masks, 0.05, batch)
+        assert not np.array_equal(np.asarray(rl), np.asarray(rl32))
+        np.testing.assert_allclose(np.asarray(rl), np.asarray(rl32),
+                                   rtol=0.05)
+
+
 @pytest.mark.parametrize("uint8", [False, True])
 def test_epoch_masked_kernel_matches_pure_jax_oracle(uint8):
     """CPU CI coverage of the epoch-kernel wrapper (VERDICT r2 #4): the

@@ -141,6 +141,37 @@ def test_resume_from_reference_produced_model_pt(tmp_path):
     assert "b" not in params["fc3"]  # output layer is bias-free
 
 
+def test_live_loss_polls_ready_values_without_sync():
+    """The async per-step loss display: shows the newest COMPLETED value,
+    never touches a pending one (no forced device sync), no-ops on bars
+    without postfix support."""
+    import types
+    import jax.numpy as jnp
+    from pytorch_ddp_mnist_tpu.train.loop import _LiveLoss
+
+    msgs = []
+    ll = _LiveLoss(types.SimpleNamespace(set_postfix_str=msgs.append),
+                   interval=0.0)
+    losses = [jnp.float32(0.5)]
+    ll.poll(losses)
+    assert msgs and msgs[-1].endswith("@0") and "0.5" in msgs[-1]
+
+    class Pending:
+        def is_ready(self):
+            return False
+
+        def __float__(self):
+            raise AssertionError("fetched a value that was not ready")
+
+    losses.append(Pending())
+    ll.poll(losses)                      # nothing newly ready -> no update
+    assert len(msgs) == 1
+    losses.append(jnp.float32(0.25))
+    ll.poll(losses)                      # newest ready wins, pending skipped
+    assert len(msgs) == 2 and msgs[-1].endswith("@2")
+    _LiveLoss(object(), interval=0.0).poll(losses)   # no postfix API: no-op
+
+
 def test_torch_checkpoint_ddp_wrapped_module_prefix_loads(tmp_path):
     """A still-DDP-wrapped save ('module.'-prefixed keys — the reference
     always unwraps first, ddp_tutorial_multi_gpu.py:118, but a user's own

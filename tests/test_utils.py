@@ -5,6 +5,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from pytorch_ddp_mnist_tpu.utils import (Timer, CumulativeTimer, trace,
                                          device_sync, rank_zero_log, progress)
@@ -71,3 +72,15 @@ def test_progress_disabled_passthrough():
 def test_progress_default_in_test_env():
     # stderr is not a tty under pytest -> plain iterator, still yields all
     assert list(progress([1, 2, 3])) == [1, 2, 3]
+
+
+def test_progress_enabled_returns_live_loss_capable_bar():
+    """With tqdm forced on, progress() must hand back the tqdm INSTANCE
+    (set_postfix_str available — what train.loop._LiveLoss drives), not a
+    bare iterator; iterating it still yields the items. Guards the
+    integration the live-loss feature depends on."""
+    pytest.importorskip("tqdm")
+    bar = progress([1, 2, 3], desc="t", disable=False)
+    assert hasattr(bar, "set_postfix_str")
+    bar.set_postfix_str("loss=0.1@0")
+    assert list(bar) == [1, 2, 3]
