@@ -32,8 +32,8 @@ def main(argv=None) -> int:
     tcfg, dcfg = config["trainer"], config["data"]
 
     if tcfg["kernel"] != "auto":
-        # single source of truth for kernel/dtype compatibility (e.g.
-        # pallas_epoch composes with bfloat16, the per-step kernels do not)
+        # single source of truth for kernel/dtype compatibility
+        # (train.scan._check_kernel; every kernel composes with bfloat16)
         from ..train.scan import _check_kernel
         try:
             _check_kernel(tcfg["kernel"], tcfg["dtype"])
@@ -112,7 +112,8 @@ def main(argv=None) -> int:
             if use_pallas:
                 from ..ops.pallas_step import make_pallas_dp_train_step
                 train_step = make_pallas_dp_train_step(
-                    mesh, tcfg["lr"], interpret=_pallas_interpret())
+                    mesh, tcfg["lr"], interpret=_pallas_interpret(),
+                    dtype=tcfg["dtype"])
             else:
                 train_step = make_dp_train_step(mesh, tcfg["lr"],
                                                 dtype=tcfg["dtype"])
@@ -124,7 +125,8 @@ def main(argv=None) -> int:
         if use_pallas and not tcfg["cached"]:
             from ..ops.pallas_step import make_pallas_train_step
             train_step = make_pallas_train_step(
-                tcfg["lr"], interpret=_pallas_interpret())
+                tcfg["lr"], interpret=_pallas_interpret(),
+                dtype=tcfg["dtype"])
         num_shards = local_shards = 1
 
     global_batch = tcfg["batch_size"] * num_shards

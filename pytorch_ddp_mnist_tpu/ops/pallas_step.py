@@ -86,7 +86,8 @@ EPOCH_KERNEL_MAX_DEVICES = 8
 
 
 def _make_fused_kernel(total_batch: int, block: int,
-                       in_kernel_rng: bool = False):
+                       in_kernel_rng: bool = False,
+                       compute_bf16: bool = False):
     """Build the fwd+bwd kernel for a batch grid of `block`-row steps.
 
     TPU grid iterations run sequentially on a core, so gradient outputs (whose
@@ -99,7 +100,13 @@ def _make_fused_kernel(total_batch: int, block: int,
     pre-drawn mask block; the kernel seeds the core PRNG with seed+program_id
     (an independent stream per batch block) and draws the pre-scaled dropout
     mask from hardware bits — no mask array ever exists in HBM.
+
+    `compute_bf16`: matmul operands cast to bfloat16 (f32 MXU accumulation
+    via preferred_element_type); everything else — loss, grads, elementwise,
+    accumulator outputs — stays f32. Same recipe as the epoch kernel's
+    bf16 mode (see _make_epoch_kernel).
     """
+    mm_dt = jnp.bfloat16 if compute_bf16 else jnp.float32
 
     def kernel(x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref,
                w3_ref, loss_ref, gw1_ref, gb1_ref, gw2_ref, gb2_ref,
@@ -128,15 +135,21 @@ def _make_fused_kernel(total_batch: int, block: int,
         rows = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0) + pid * block
         valid = (rows < total_batch).astype(f32)           # (Bb,1)
 
-        # ---- forward ----
-        z1 = jax.lax.dot_general(x, w1_ref[:], (((1,), (0,)), ((), ())),
+        # ---- forward (matmul operands in mm_dt; casts are no-ops for f32
+        # compute, and x arrives already in mm_dt from the wrapper) ----
+        xm = x.astype(mm_dt)
+        w1m, w2m, w3m = (w1_ref[:].astype(mm_dt), w2_ref[:].astype(mm_dt),
+                         w3_ref[:].astype(mm_dt))
+        z1 = jax.lax.dot_general(xm, w1m, (((1,), (0,)), ((), ())),
                                  preferred_element_type=f32) + b1_ref[:]
         h1 = jnp.maximum(z1, 0.0)
         d1 = h1 * m                                    # inverted dropout
-        z2 = jax.lax.dot_general(d1, w2_ref[:], (((1,), (0,)), ((), ())),
+        d1m = d1.astype(mm_dt)
+        z2 = jax.lax.dot_general(d1m, w2m, (((1,), (0,)), ((), ())),
                                  preferred_element_type=f32) + b2_ref[:]
         h2 = jnp.maximum(z2, 0.0)
-        logits = jax.lax.dot_general(h2, w3_ref[:], (((1,), (0,)), ((), ())),
+        h2m = h2.astype(mm_dt)
+        logits = jax.lax.dot_general(h2m, w3m, (((1,), (0,)), ((), ())),
                                      preferred_element_type=f32)
 
         cols = jax.lax.broadcasted_iota(jnp.int32, (block, PADDED_CLASSES), 1)
@@ -155,20 +168,23 @@ def _make_fused_kernel(total_batch: int, block: int,
         # (Bb,128); 0 on padded cols AND padded rows — zeroing dlogits for
         # pad rows kills their contribution to every downstream gradient.
         dlogits = (ex / se - onehot) * (valid * (1.0 / total_batch))
+        dlm = dlogits.astype(mm_dt)
         # gw3 = h2^T @ dlogits (contract batch)
-        gw3 = jax.lax.dot_general(h2, dlogits, (((0,), (0,)), ((), ())),
+        gw3 = jax.lax.dot_general(h2m, dlm, (((0,), (0,)), ((), ())),
                                   preferred_element_type=f32)
         # dh2 = dlogits @ w3^T (contract class)
-        dh2 = jax.lax.dot_general(dlogits, w3_ref[:], (((1,), (1,)), ((), ())),
+        dh2 = jax.lax.dot_general(dlm, w3m, (((1,), (1,)), ((), ())),
                                   preferred_element_type=f32)
         dz2 = dh2 * (z2 > 0.0).astype(f32)
-        gw2 = jax.lax.dot_general(d1, dz2, (((0,), (0,)), ((), ())),
+        dz2m = dz2.astype(mm_dt)
+        gw2 = jax.lax.dot_general(d1m, dz2m, (((0,), (0,)), ((), ())),
                                   preferred_element_type=f32)
         gb2 = jnp.sum(dz2, axis=0, keepdims=True)
-        dd1 = jax.lax.dot_general(dz2, w2_ref[:], (((1,), (1,)), ((), ())),
+        dd1 = jax.lax.dot_general(dz2m, w2m, (((1,), (1,)), ((), ())),
                                   preferred_element_type=f32)
         dz1 = (dd1 * m) * (z1 > 0.0).astype(f32)
-        gw1 = jax.lax.dot_general(x, dz1, (((0,), (0,)), ((), ())),
+        gw1 = jax.lax.dot_general(xm, dz1.astype(mm_dt),
+                                  (((0,), (0,)), ((), ())),
                                   preferred_element_type=f32)
         gb1 = jnp.sum(dz1, axis=0, keepdims=True)
 
@@ -204,7 +220,9 @@ def fused_loss_and_grads(params, x, y, scaled_mask, *, interpret=False):
     gradient accumulation across the (sequential) grid steps; the tail is
     zero-padded to a block multiple and masked out inside the kernel, so any
     batch size works. `interpret=True` runs the Pallas interpreter (CPU
-    tests)."""
+    tests). A bfloat16 `x` selects the bf16-matmul kernel (bf16 MXU
+    operands, f32 accumulation/loss/grads — the --dtype bfloat16 recipe);
+    any other dtype computes in f32."""
     return _run_fused(params, x, y, scaled_mask, in_kernel_rng=False,
                       interpret=interpret)
 
@@ -216,10 +234,11 @@ def fused_loss_and_grads_rng(params, x, y, seed):
 
     vs fused_loss_and_grads: no (B,128) mask array is materialized in HBM or
     streamed into VMEM — the seed is one SMEM scalar, and each batch block
-    draws its own hardware-PRNG stream (seed + block index). Same
+    draws its own hardware-PRNG stream (seed, block index). Same
     Bernoulli(1-DROPOUT_RATE) keep distribution and 1/keep pre-scaling as
     every other engine; yet another stream, like threefry vs rbg. Mosaic
-    (real TPU) only: pltpu.prng_* has no interpreter lowering."""
+    (real TPU) only: pltpu.prng_* has no interpreter lowering. bf16-matmul
+    mode selected by a bfloat16 `x`, as in fused_loss_and_grads."""
     seed = jnp.asarray(seed, jnp.int32).reshape((1,))
     return _run_fused(params, x, y, seed, in_kernel_rng=True,
                       interpret=False)
@@ -228,6 +247,10 @@ def fused_loss_and_grads_rng(params, x, y, seed):
 def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
     batch = x.shape[0]
     f32 = jnp.float32
+    # bf16 compute is selected by the caller handing a bf16 batch (the scan
+    # body casts x to the compute dtype); the kernel keeps f32 accumulation
+    compute_bf16 = x.dtype == jnp.bfloat16
+    in_dt = jnp.bfloat16 if compute_bf16 else f32
     # Block = whole batch when it fits (rounded to the f32 sublane multiple
     # of 8 for Mosaic); one grid step then reproduces the ungridded kernel
     # exactly. Larger batches split into the fewest ≤MAX_BATCH_BLOCK grid
@@ -238,7 +261,7 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
     padded = grid * block
     if padded != batch:
         pad = ((0, padded - batch), (0, 0))
-        x = jnp.pad(x.astype(f32), pad)
+        x = jnp.pad(x.astype(in_dt), pad)
         if not in_kernel_rng:
             mask_or_seed = jnp.pad(mask_or_seed.astype(f32), pad)
         y = jnp.pad(y.astype(jnp.int32), ((0, padded - batch),))
@@ -257,7 +280,8 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
                  if in_kernel_rng
                  else vmem((block, HIDDEN1), lambda i: (i, 0)))
     loss, gw1, gb1, gw2, gb2, gw3 = pl.pallas_call(
-        _make_fused_kernel(batch, block, in_kernel_rng=in_kernel_rng),
+        _make_fused_kernel(batch, block, in_kernel_rng=in_kernel_rng,
+                           compute_bf16=compute_bf16),
         grid=(grid,),
         # The gradient outputs accumulate across grid steps, so the batch
         # grid MUST run sequentially — 'arbitrary' pins that down even on
@@ -286,7 +310,7 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
         ),
         interpret=interpret,
     )(
-        x.astype(f32),
+        x.astype(in_dt),
         y.astype(jnp.int32)[:, None],
         mask_or_seed if in_kernel_rng else mask_or_seed.astype(f32),
         params["fc1"]["w"].astype(f32),
@@ -701,16 +725,9 @@ def epoch_sgd_reference(params, xp, yp, masks, lr: float, batch: int,
     nsteps = rows // batch
     assert nsteps * batch == rows, (rows, batch)
     f32 = jnp.float32
-    mm_dt = jnp.bfloat16 if compute_bf16 else f32
     xs = xp.reshape(nsteps, batch, IN_DIM)
     ys = yp.reshape(nsteps, batch).astype(jnp.int32)
     ms = masks.reshape(nsteps, batch, HIDDEN1).astype(f32)
-
-    def _mm(a, b):
-        # the kernel's matmul contract: mm_dt operands, f32 accumulation
-        return jax.lax.dot_general(a.astype(mm_dt), b.astype(mm_dt),
-                                   (((1,), (0,)), ((), ())),
-                                   preferred_element_type=f32)
 
     def step(p, xym):
         xb, yb, mb = xym
@@ -723,43 +740,7 @@ def epoch_sgd_reference(params, xp, yp, masks, lr: float, batch: int,
             xb = xb.astype(f32)
 
         if compute_bf16:
-            # Explicit fwd/bwd restating the kernel's exact cast points
-            # (autodiff of a cast chain would not place the bwd casts the
-            # same way the hand-written kernel does).
-            w1, b1 = p["fc1"]["w"], p["fc1"]["b"]
-            w2, b2 = p["fc2"]["w"], p["fc2"]["b"]
-            w3 = p["fc3"]["w"]
-            z1 = _mm(xb, w1) + b1
-            h1 = jnp.maximum(z1, 0.0)
-            d1 = h1 * mb
-            z2 = _mm(d1, w2) + b2
-            h2 = jnp.maximum(z2, 0.0)
-            logits = _mm(h2, w3)
-            loss = cross_entropy(logits, yb)
-            oh = jax.nn.one_hot(yb, logits.shape[1], dtype=f32)
-            dlogits = (jax.nn.softmax(logits, axis=1) - oh) / batch
-            gw3 = jax.lax.dot_general(
-                h2.astype(mm_dt), dlogits.astype(mm_dt),
-                (((0,), (0,)), ((), ())), preferred_element_type=f32)
-            dh2 = jax.lax.dot_general(
-                dlogits.astype(mm_dt), w3.astype(mm_dt),
-                (((1,), (1,)), ((), ())), preferred_element_type=f32)
-            dz2 = dh2 * (z2 > 0.0).astype(f32)
-            gw2 = jax.lax.dot_general(
-                d1.astype(mm_dt), dz2.astype(mm_dt),
-                (((0,), (0,)), ((), ())), preferred_element_type=f32)
-            gb2 = dz2.sum(axis=0)
-            dd1 = jax.lax.dot_general(
-                dz2.astype(mm_dt), w2.astype(mm_dt),
-                (((1,), (1,)), ((), ())), preferred_element_type=f32)
-            dz1 = (dd1 * mb) * (z1 > 0.0).astype(f32)
-            gw1 = jax.lax.dot_general(
-                xb.astype(mm_dt), dz1.astype(mm_dt),
-                (((0,), (0,)), ((), ())), preferred_element_type=f32)
-            gb1 = dz1.sum(axis=0)
-            grads = {"fc1": {"w": gw1, "b": gb1},
-                     "fc2": {"w": gw2, "b": gb2},
-                     "fc3": {"w": gw3}}
+            loss, grads = step_reference_bf16(p, xb, yb, mb)
             return sgd_step(p, grads, lr), loss
 
         def loss_fn(pp):
@@ -775,6 +756,50 @@ def epoch_sgd_reference(params, xp, yp, masks, lr: float, batch: int,
     return jax.lax.scan(step, params, (xs, ys, ms))
 
 
+def step_reference_bf16(params, xb, yb, mb):
+    """Pure-JAX oracle of ONE bf16-matmul train step: explicit fwd/bwd
+    restating the kernels' exact cast points — bf16 operands into every
+    dot_general, f32 accumulation, f32 elementwise/grads (autodiff of a cast
+    chain would not place the bwd casts where the hand-written kernels do).
+    Shared by the epoch oracle above and the per-step kernel's CI tests.
+    Returns (mean_loss, grads pytree)."""
+    from .loss import cross_entropy
+
+    f32 = jnp.float32
+    mm_dt = jnp.bfloat16
+    batch = xb.shape[0]
+
+    def _mm(a, b, dims):
+        return jax.lax.dot_general(a.astype(mm_dt), b.astype(mm_dt), dims,
+                                   preferred_element_type=f32)
+
+    fwd = (((1,), (0,)), ((), ()))
+    w1, b1 = params["fc1"]["w"], params["fc1"]["b"]
+    w2, b2 = params["fc2"]["w"], params["fc2"]["b"]
+    w3 = params["fc3"]["w"]
+    z1 = _mm(xb, w1, fwd) + b1
+    h1 = jnp.maximum(z1, 0.0)
+    d1 = h1 * mb
+    z2 = _mm(d1, w2, fwd) + b2
+    h2 = jnp.maximum(z2, 0.0)
+    logits = _mm(h2, w3, fwd)
+    loss = cross_entropy(logits, yb)
+    oh = jax.nn.one_hot(yb, logits.shape[1], dtype=f32)
+    dlogits = (jax.nn.softmax(logits, axis=1) - oh) / batch
+    gw3 = _mm(h2, dlogits, (((0,), (0,)), ((), ())))
+    dh2 = _mm(dlogits, w3, (((1,), (1,)), ((), ())))
+    dz2 = dh2 * (z2 > 0.0).astype(f32)
+    gw2 = _mm(d1, dz2, (((0,), (0,)), ((), ())))
+    gb2 = dz2.sum(axis=0)
+    dd1 = _mm(dz2, w2, (((1,), (1,)), ((), ())))
+    dz1 = (dd1 * mb) * (z1 > 0.0).astype(f32)
+    gw1 = _mm(xb, dz1, (((0,), (0,)), ((), ())))
+    gb1 = dz1.sum(axis=0)
+    return loss, {"fc1": {"w": gw1, "b": gb1},
+                  "fc2": {"w": gw2, "b": gb2},
+                  "fc3": {"w": gw3}}
+
+
 def dropout_mask(key: jax.Array, batch: int, *, train: bool = True):
     """The pre-scaled mask the kernel consumes, drawn EXACTLY like
     models/mlp.py's dropout (same bernoulli stream for the same key), so the
@@ -786,38 +811,47 @@ def dropout_mask(key: jax.Array, batch: int, *, train: bool = True):
     return mask.astype(jnp.float32) / keep
 
 
-def make_pallas_train_step(lr: float, *, interpret: bool = False):
+def make_pallas_train_step(lr: float, *, interpret: bool = False,
+                           dtype: str = "float32"):
     """Drop-in replacement for train.loop.make_train_step: one jitted
     (params, key, x, y) -> (params', key', loss) whose fwd+bwd is the fused
     kernel; the SGD update fuses into the surrounding jit. Same
-    jax.random.split chain as the unfused step -> same dropout masks."""
+    jax.random.split chain as the unfused step -> same dropout masks.
+    dtype='bfloat16' selects the kernel's bf16-matmul mode (x cast here —
+    the kernel keys its mode off the batch dtype)."""
     from .sgd import sgd_step
+
+    compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, key, x, y):
         key, sub = jax.random.split(key)
         mask = dropout_mask(sub, x.shape[0])
-        loss, grads = fused_loss_and_grads(params, x, y, mask,
-                                           interpret=interpret)
+        loss, grads = fused_loss_and_grads(params, x.astype(compute_dt), y,
+                                           mask, interpret=interpret)
         return sgd_step(params, grads, lr), key, loss
 
     return step
 
 
-def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False):
+def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False,
+                              dtype: str = "float32"):
     """SPMD data-parallel fused step over the 'dp' mesh — the
     parallel.ddp.make_dp_train_step shape (per-replica kernel, pmean'd
-    grads, redundant SGD) with the Pallas kernel as the local compute."""
+    grads, redundant SGD) with the Pallas kernel as the local compute.
+    dtype='bfloat16' as in make_pallas_train_step."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
     from ..parallel.mesh import DATA_AXIS
     from .sgd import sgd_step
 
+    compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
     def _shard_fn(params, sub, x, y):
         rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
         mask = dropout_mask(rkey, x.shape[0])
-        loss, grads = fused_loss_and_grads(params, x, y, mask,
-                                           interpret=interpret)
+        loss, grads = fused_loss_and_grads(params, x.astype(compute_dt), y,
+                                           mask, interpret=interpret)
         grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
         loss = jax.lax.pmean(loss, DATA_AXIS)
         return grads, loss

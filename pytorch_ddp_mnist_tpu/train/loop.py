@@ -170,11 +170,14 @@ class _LiveLoss:
         now = time.perf_counter()
         if now - self._last < self._interval:
             return
+        # Throttle from poll ATTEMPT, not success: when the device lags and
+        # nothing is ready yet, the next scan still waits a full interval —
+        # otherwise every step would rescan the whole pending queue.
+        self._last = now
         # newest completed value, searching back from the freshest dispatch
         for i in range(len(losses) - 1, self._shown, -1):
             arr = losses[i]
             if not hasattr(arr, "is_ready") or arr.is_ready():
-                self._last = now
                 self._shown = i
                 self._set(f"loss={float(arr):.4f}@{i}")
                 return

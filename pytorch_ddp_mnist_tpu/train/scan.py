@@ -89,20 +89,21 @@ def resolve_kernel(dtype: str, on_tpu: bool) -> str:
     """The `--kernel auto` policy (bench.py and the trainer CLI): fused
     Pallas step on TPU (fastest measured PER-STEP variant — docs/PERF.md;
     bench additionally promotes single-chip runs to the whole-epoch kernel),
-    XLA autodiff elsewhere (Pallas off-TPU is interpreter-only) — and for
-    bf16 anywhere, since the Pallas kernel computes in f32 (_check_kernel)."""
+    XLA autodiff elsewhere (Pallas off-TPU is interpreter-only). bf16 keeps
+    xla: the bf16-matmul Pallas kernels exist (explicit --kernel selects
+    them) but auto only promotes to hardware-measured-fastest variants."""
     return "pallas" if on_tpu and dtype == "float32" else "xla"
 
 
 def _check_kernel(kernel: str, dtype: str) -> None:
+    """Kernel/dtype compatibility — the single source of truth (the CLI
+    converts this ValueError to a SystemExit). Every kernel now composes
+    with bfloat16: the Pallas kernels select bf16-matmul mode (bf16 MXU
+    operands, f32 accumulation/master weights) when handed a bf16 batch."""
     if kernel not in ("xla", "pallas", "pallas_rng", "pallas_epoch"):
         raise ValueError(f"unknown kernel {kernel!r}")
-    # pallas_epoch composes with bfloat16 (bf16 matmul operands, f32
-    # accumulation + f32 master weights — ops/pallas_step.py); the per-step
-    # kernels stay f32-only.
-    if (kernel in ("pallas", "pallas_rng") and dtype != "float32"):
-        raise ValueError(f"kernel {kernel!r} computes in float32 "
-                         "(MXU f32 accumulation); drop dtype=bfloat16")
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown dtype {dtype!r}")
 
 
 def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):

@@ -133,9 +133,17 @@ def test_pallas_cached_runs(tmp_path, capsys):
     assert len(lines) == 1
 
 
-def test_pallas_bfloat16_conflict():
-    with pytest.raises(SystemExit, match="bfloat16"):
-        main(["--kernel", "pallas", "--dtype", "bfloat16"])
+def test_pallas_bfloat16_trains(tmp_path, capsys):
+    """--kernel pallas --dtype bfloat16 selects the kernel's bf16-matmul
+    mode (bf16 MXU operands, f32 master weights) and trains end-to-end —
+    interpreted on this CPU backend. Replaces the old rejection: every
+    kernel now composes with bfloat16."""
+    args = ["--limit", "256", "--batch_size", "64", "--n_epochs", "1",
+            "--kernel", "pallas", "--dtype", "bfloat16",
+            "--path", str(tmp_path / "nodata"), "--checkpoint", ""]
+    assert main(args) == 0
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1
 
 
 def test_package_main_dispatcher(tmp_path, capsys):

@@ -361,6 +361,36 @@ def test_epoch_kernel_dp_named_errors():
         epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_size=2)
 
 
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_epoch_kernel_ring_slot_schedule_algebra(n):
+    """Pure simulation of the DP ring's slot schedule — the exact index
+    formulas the kernel uses (hop h: device me forwards slot (me-h) mod n to
+    its right neighbor, same origin-slot index on the receiver). The
+    multi-chip ring cannot execute in this 1-chip session, so the protocol
+    algebra is pinned here instead: every device ends holding all n origin
+    slots, each (device, slot) is written exactly once per step (no reuse
+    hazard), and each hop forwards exactly what arrived the hop before."""
+    held = {d: {d} for d in range(n)}          # slots present per device
+    writes = {d: [] for d in range(n)}         # remote writes received
+    for h in range(n - 1):
+        sends = {}
+        for me in range(n):
+            send_slot = (me - h) % n
+            # the kernel forwards only data it already holds: own slot at
+            # hop 0, afterwards the slot received at hop h-1
+            assert send_slot in held[me], (h, me, send_slot)
+            if h > 0:
+                assert send_slot == (me - (h - 1) - 1) % n  # prev hop's recv
+            sends[(me + 1) % n] = send_slot
+        for dst, slot in sends.items():
+            assert slot not in held[dst], "slot delivered twice"
+            writes[dst].append(slot)
+            held[dst].add(slot)
+    for d in range(n):
+        assert held[d] == set(range(n))        # all-gather complete
+        assert len(writes[d]) == len(set(writes[d])) == n - 1  # 1 write/slot
+
+
 def test_epoch_kernel_dp_single_device_mesh_matches_serial_interpret():
     """kernel='pallas_epoch' through make_dp_run_fn on a 1-device mesh (the
     ring degenerates away) must reproduce the serial run_epochal bit-for-bit
@@ -416,6 +446,23 @@ def _epoch_masks(key, nsteps, batch):
     masks = jax.vmap(lambda k: dropout_mask(k, batch))(
         jax.random.split(key, nsteps))
     return masks.reshape(nsteps * batch, HIDDEN1)
+
+
+def test_per_step_kernel_bf16_matches_cast_point_oracle():
+    """A bf16 batch selects the per-step kernel's bf16-matmul mode; the
+    result must match step_reference_bf16 (the cast-point-exact oracle) and
+    genuinely differ from the f32 kernel."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import step_reference_bf16
+    params = init_mlp(jax.random.key(2))
+    x, y = _data(64, seed=9)
+    mask = dropout_mask(jax.random.key(4), 64)
+    kl, kg = fused_loss_and_grads(params, x.astype(jnp.bfloat16), y, mask,
+                                  interpret=True)
+    rl, rg = step_reference_bf16(params, x, y, mask)
+    np.testing.assert_allclose(float(kl), float(rl), rtol=1e-3)
+    _tree_allclose(kg, rg, rtol=2e-3, atol=1e-4)
+    fl, _ = fused_loss_and_grads(params, x, y, mask, interpret=True)
+    assert float(kl) != float(fl)     # the mode switch did something
 
 
 @pytest.mark.parametrize("bf16", [False, True])
