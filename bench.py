@@ -133,11 +133,13 @@ def main(argv=None) -> None:
                         "throughput (the PnetCDF-path data plane)")
     p.add_argument("--num_workers", type=int, default=0,
                    help="stream mode: readahead threads")
+    from pytorch_ddp_mnist_tpu.parallel.wireup import backend_wait_env
     p.add_argument("--backend_wait", type=float,
-                   default=float(os.environ.get("PDMT_BACKEND_WAIT", "300")),
+                   default=backend_wait_env(300.0),
                    help="seconds to keep polling for the accelerator backend "
                         "before giving up (the tunneled TPU is known to drop "
-                        "and recover; 0 = single immediate probe)")
+                        "and recover; 0 = single immediate probe; "
+                        "PDMT_BACKEND_WAIT sets the default)")
     a = p.parse_args(argv)
     if a.epochs < 1:
         p.error("--epochs must be >= 1")

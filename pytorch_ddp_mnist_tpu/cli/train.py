@@ -31,6 +31,24 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
+    # Opt-in bounded backend retry (PDMT_BACKEND_WAIT=<seconds>): a serial
+    # training job launched into a transient accelerator outage polls
+    # instead of dying at its first device query — same machinery as
+    # bench.py's --backend_wait, off by default so interactive errors stay
+    # immediate. NOT applied to --parallel runs: probing devices initializes
+    # the local backend, which must not happen before
+    # jax.distributed.initialize's rendezvous (initialize_runtime below).
+    if not tcfg["parallel"]:
+        from ..parallel.wireup import (BackendUnavailableError,
+                                       backend_wait_env, wait_for_backend)
+        wait_s = backend_wait_env(0.0)
+        if wait_s > 0:
+            try:
+                wait_for_backend(max_wait_s=wait_s)
+            except BackendUnavailableError as e:
+                raise SystemExit(f"accelerator backend unavailable after "
+                                 f"PDMT_BACKEND_WAIT={wait_s:.0f}s: {e}")
+
     if tcfg["kernel"] != "auto":
         # single source of truth for kernel/dtype compatibility
         # (train.scan._check_kernel; every kernel composes with bfloat16)

@@ -604,7 +604,10 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         raise ValueError(
             f"pallas_epoch streams each step's batch as ONE VMEM block; "
             f"batch {block} > {EPOCH_KERNEL_MAX_BATCH} exceeds its budget "
-            f"(double-buffered (B,784) inputs + resident weights). "
+            f"(double-buffered (B,784) inputs + resident weights and "
+            f"block-sized activations — the uint8 input is materialized as "
+            f"f32 in VMEM after the in-kernel normalize, so raw-uint8 "
+            f"epochs share the cap). "
             f"Use the gridded per-step kernel (--kernel pallas) instead")
     nsteps = rows // block
     assert nsteps * block == rows, (rows, block)

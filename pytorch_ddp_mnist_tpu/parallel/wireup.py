@@ -202,6 +202,31 @@ class BackendUnavailableError(RuntimeError):
     """The accelerator backend stayed unavailable for the whole retry budget."""
 
 
+def backend_wait_env(default: float) -> float:
+    """PDMT_BACKEND_WAIT (seconds) from the environment, tolerantly parsed:
+    unset/empty, malformed, non-finite, or negative values fall back to
+    `default` (with a stderr note for the malformed cases) instead of
+    crashing the entry point with a float() traceback. Shared by bench.py
+    and the trainer CLI so the variable means one thing."""
+    import math
+    import sys
+
+    raw = os.environ.get("PDMT_BACKEND_WAIT")
+    if raw is None or raw.strip() == "":
+        return default
+    try:
+        val = float(raw)
+    except ValueError:
+        print(f"PDMT_BACKEND_WAIT={raw!r} is not a number; using "
+              f"{default:.0f}s", file=sys.stderr)
+        return default
+    if not math.isfinite(val) or val < 0:
+        print(f"PDMT_BACKEND_WAIT={raw!r} is not a non-negative finite "
+              f"number of seconds; using {default:.0f}s", file=sys.stderr)
+        return default
+    return val
+
+
 def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0):
     """Poll jax.devices() until the backend initializes; bounded retry.
 

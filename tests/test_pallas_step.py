@@ -311,6 +311,31 @@ def test_epoch_kernel_dp_wrapper_matches_serial_on_hardware():
 
 
 @tpu_only
+def test_epoch_kernel_bf16_trains_on_hardware():
+    """The bf16-matmul epoch kernel (in-kernel RNG, uint8 streaming) on the
+    real chip: trains to a falling, finite curve that tracks the f32 kernel
+    within bf16 noise."""
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.integers(0, 256, (2048, 784), dtype=np.uint8))
+    yl = jnp.asarray((rng.integers(0, 256, 2048) % 10).astype(np.int32))
+    idxs = jnp.asarray(np.stack([
+        np.random.default_rng(e).permutation(2048).reshape(16, 128)
+        for e in range(4)]).astype(np.int32))
+    curves = {}
+    for dt in ("float32", "bfloat16"):
+        run = make_run_fn(lr=0.05, kernel="pallas_epoch", dtype=dt)
+        _, _, losses = run(init_mlp(jax.random.key(0)), jax.random.key(1),
+                           x, yl, idxs)
+        losses = np.asarray(losses)
+        assert np.isfinite(losses).all()
+        curves[dt] = losses.mean(axis=1)
+    assert curves["bfloat16"][-1] < curves["bfloat16"][0]
+    np.testing.assert_allclose(curves["bfloat16"], curves["float32"],
+                               rtol=0.1)
+
+
+@tpu_only
 def test_epoch_kernel_uint8_matches_f32_on_hardware():
     """The uint8-streaming epoch kernel (in-kernel VPU normalize) must match
     the pre-normalized f32 path: same seed -> same in-kernel dropout masks,
@@ -337,6 +362,21 @@ def test_epoch_kernel_rejects_unaligned_batch():
     x, y = _data(200)
     with pytest.raises(ValueError, match="divisible by 8"):
         epoch_fused_sgd(params, x, y, 1, 0.01, 100)
+
+
+def test_epoch_kernel_batch_cap_applies_to_all_input_dtypes():
+    """The one-VMEM-block batch cap binds uint8 epochs too (the normalize
+    materializes the block as f32 in VMEM, so the activation budget is the
+    same as the f32 path's — a larger uint8-only cap would need hardware
+    validation first)."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        EPOCH_KERNEL_MAX_BATCH, epoch_fused_sgd)
+    params = init_mlp(jax.random.key(0))
+    b = EPOCH_KERNEL_MAX_BATCH + 8
+    for uint8 in (False, True):
+        x, y = _epoch_data(1, b, seed=0, uint8=uint8)
+        with pytest.raises(ValueError, match=str(EPOCH_KERNEL_MAX_BATCH)):
+            epoch_fused_sgd(params, x, y, 1, 0.01, b)
 
 
 def test_epoch_kernel_dp_named_errors():
