@@ -485,7 +485,10 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
             n = n_devices
             left = jax.lax.rem(me + (n - 1), n)
             right = jax.lax.rem(me + 1, n)
-            did = pltpu.DeviceIdType.LOGICAL
+            # MESH device ids: coordinates along the shard_map mesh axis —
+            # correct even when the mesh's device array was topology-
+            # reordered (raw LOGICAL ids would bypass that mapping).
+            did = pltpu.DeviceIdType.MESH
 
             @pl.when(pid == 0)
             def _entry_barrier():

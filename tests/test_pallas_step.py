@@ -431,6 +431,25 @@ def test_epoch_kernel_ring_slot_schedule_algebra(n):
         assert len(writes[d]) == len(set(writes[d])) == n - 1  # 1 write/slot
 
 
+def test_epoch_kernel_dp_8dev_program_traces():
+    """The 8-replica DP epoch program (in-kernel ring, remote DMAs,
+    semaphore scratch) must TRACE cleanly — shapes, shard_map specs, scratch
+    structure — even though executing the ring needs real multi-chip
+    hardware. Catches structural regressions the 1-device tests can't."""
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
+    mesh = data_parallel_mesh()           # 8 virtual CPU devices (conftest)
+    run = make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch",
+                         snapshots=True)
+    params = init_mlp(jax.random.key(0))
+    key = jax.random.key(1)
+    x = jax.ShapeDtypeStruct((1024, 784), jnp.uint8)
+    y = jax.ShapeDtypeStruct((1024,), jnp.int32)
+    idxs = jax.ShapeDtypeStruct((2, 1, 1024), jnp.int32)  # 128 rows/replica
+    out = jax.eval_shape(run, params, key, x, y, idxs)
+    assert out[2].shape == (2, 1)                    # (epochs, steps) losses
+    assert out[3][0]["fc1"]["w"].shape == (2, 784, 128)   # params snapshots
+
+
 def test_epoch_kernel_dp_single_device_mesh_matches_serial_interpret():
     """kernel='pallas_epoch' through make_dp_run_fn on a 1-device mesh (the
     ring degenerates away) must reproduce the serial run_epochal bit-for-bit
