@@ -31,6 +31,20 @@ Design notes (see /opt/skills/guides/pallas_guide.md):
     into both without an in-kernel collective.
   * All matmuls accumulate in float32 on the MXU via preferred_element_type
     (bfloat16 inputs welcome; master weights stay f32 in the wrapper).
+
+Beyond the per-step kernel, this module provides (round 2-3):
+  * `epoch_fused_sgd` / `_make_epoch_kernel` — the WHOLE-EPOCH kernel:
+    weights VMEM-resident across every SGD step of an epoch, raw-uint8
+    batch blocks normalized on the VPU at load, in-kernel core-PRNG
+    dropout; the single-chip headline path (docs/PERF.md).
+  * bf16-matmul mode for BOTH kernels (bf16 MXU operands, f32
+    accumulation/master weights), keyed off the batch dtype; oracle:
+    `step_reference_bf16`.
+  * the EXPERIMENTAL DP epoch mode: per-step DDP mean gradients via an
+    in-kernel ICI ring allreduce (remote DMAs + semaphores inside the
+    grid) — see `_make_epoch_kernel`'s dp notes.
+  * CPU-CI oracles: `epoch_sgd_reference` (pure-JAX epoch recurrence) and
+    the masked, interpretable kernel variant (`masks=` + `interpret=`).
 """
 
 from __future__ import annotations

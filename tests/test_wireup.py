@@ -134,3 +134,19 @@ def test_missing_env_named_errors(monkeypatch):
     monkeypatch.setenv("PMI_RANK", "0")
     with pytest.raises(RuntimeError, match="PMI_SIZE"):
         _derive("mpich")
+
+
+def test_backend_wait_env_parsing(monkeypatch, capsys):
+    """PDMT_BACKEND_WAIT: tolerant parse shared by bench.py and the CLI —
+    malformed/non-finite/negative fall back to the default with a stderr
+    note, never a float() traceback."""
+    from pytorch_ddp_mnist_tpu.parallel.wireup import backend_wait_env
+    monkeypatch.delenv("PDMT_BACKEND_WAIT", raising=False)
+    assert backend_wait_env(300.0) == 300.0
+    monkeypatch.setenv("PDMT_BACKEND_WAIT", "45")
+    assert backend_wait_env(300.0) == 45.0
+    for bad in ("5m", "", "nan", "-3", "inf"):
+        monkeypatch.setenv("PDMT_BACKEND_WAIT", bad)
+        assert backend_wait_env(7.0) == 7.0, bad
+    err = capsys.readouterr().err
+    assert "PDMT_BACKEND_WAIT" in err
