@@ -202,32 +202,108 @@ class BackendUnavailableError(RuntimeError):
     """The accelerator backend stayed unavailable for the whole retry budget."""
 
 
-def backend_wait_env(default: float) -> float:
-    """PDMT_BACKEND_WAIT (seconds) from the environment, tolerantly parsed:
-    unset/empty, malformed, non-finite, or negative values fall back to
-    `default` (with a stderr note for the malformed cases) instead of
-    crashing the entry point with a float() traceback. Shared by bench.py
-    and the trainer CLI so the variable means one thing."""
+class BackendWedgedError(BackendUnavailableError):
+    """The backend is reachable again, but THIS process's jax client is not:
+    an earlier jax.devices() query hung inside backend init and still holds
+    xla_bridge's init lock, so every in-process backend query would block
+    forever. Only a fresh interpreter can use the recovered backend — the
+    caller should re-exec (bench.py does, once) or ask the user to rerun."""
+
+
+def env_seconds(name: str, default: float) -> float:
+    """A seconds value from the environment, tolerantly parsed: unset/empty,
+    malformed, non-finite, or negative values fall back to `default` (with a
+    stderr note for the malformed cases) instead of crashing the entry point
+    with a float() traceback."""
     import math
     import sys
 
-    raw = os.environ.get("PDMT_BACKEND_WAIT")
+    raw = os.environ.get(name)
     if raw is None or raw.strip() == "":
         return default
     try:
         val = float(raw)
     except ValueError:
-        print(f"PDMT_BACKEND_WAIT={raw!r} is not a number; using "
+        print(f"{name}={raw!r} is not a number; using "
               f"{default:.0f}s", file=sys.stderr)
         return default
     if not math.isfinite(val) or val < 0:
-        print(f"PDMT_BACKEND_WAIT={raw!r} is not a non-negative finite "
+        print(f"{name}={raw!r} is not a non-negative finite "
               f"number of seconds; using {default:.0f}s", file=sys.stderr)
         return default
     return val
 
 
-def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0):
+def backend_wait_env(default: float) -> float:
+    """PDMT_BACKEND_WAIT (seconds), tolerantly parsed — shared by bench.py
+    and the trainer CLI so the variable means one thing."""
+    return env_seconds("PDMT_BACKEND_WAIT", default)
+
+
+def _probe_devices_bounded(timeout_s: float):
+    """Query jax.devices() on a daemon thread so a silently HANGING probe
+    cannot stall the caller forever.
+
+    The tunneled backend has two distinct outage modes: the query *raises*
+    (``RuntimeError: ... UNAVAILABLE`` — retryable in place), or the query
+    *hangs* — the connection is accepted and never answered, so there is no
+    exception to retry on (observed round 3). Returns one of
+    ``('ok', devices)``, ``('error', retryable_exc)``, ``('fatal', exc)``
+    (non-RuntimeError, e.g. a broken jax install — retrying cannot clear
+    it), or ``('hang', wait_fn)``.
+
+    A 'hang' may be a true hang or merely a slow init still in flight; its
+    payload is a ``wait_fn(extra_timeout_s)`` that re-joins the SAME probe
+    thread and returns a fresh (status, payload), so the caller can give a
+    slow init more time. A probe that never finishes leaves the thread
+    blocked inside backend init, which holds xla_bridge's init lock — every
+    later in-process query will block on that lock even after the tunnel
+    recovers, so the caller must then treat the whole process as wedged
+    (see BackendWedgedError).
+    """
+    import threading
+
+    out = {}
+
+    def probe():
+        try:
+            import jax
+            out["devices"] = jax.devices()
+        except Exception as e:  # classified retryable/fatal in wait()
+            out["error"] = e
+
+    t = threading.Thread(target=probe, name="pdmt-backend-probe", daemon=True)
+    t.start()
+
+    def wait(extra_timeout_s: float):
+        t.join(extra_timeout_s)
+        if t.is_alive():
+            return "hang", wait
+        if "error" in out:
+            e = out["error"]
+            return ("error" if isinstance(e, RuntimeError) else "fatal"), e
+        return "ok", out["devices"]
+
+    return wait(timeout_s)
+
+
+def _subprocess_backend_healthy(timeout_s: float) -> bool:
+    """Probe backend health from a FRESH interpreter — immune to this
+    process's wedged bridge lock. rc=0 within the timeout means the tunnel
+    answers queries again."""
+    import subprocess
+    import sys
+
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True).returncode == 0
+    except Exception:  # TimeoutExpired, spawn failure: not healthy
+        return False
+
+
+def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
+                     hang_timeout_s: float = None):
     """Poll jax.devices() until the backend initializes; bounded retry.
 
     A tunneled/remote TPU backend can be transiently UNAVAILABLE (the tunnel
@@ -235,36 +311,94 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0):
     next poll would have survived. xla_bridge caches a failed init, so each
     retry clears the backend cache before re-probing. Returns the live device
     list; raises BackendUnavailableError once max_wait_s is exhausted.
+    Non-RuntimeError probe failures (a broken jax install, a config
+    TypeError) are NOT retried — they re-raise immediately, as before.
+
+    Probes are hang-bounded (``hang_timeout_s``, default 75 s, overridable
+    via ``PDMT_HANG_TIMEOUT`` for backends whose legitimate cold init is
+    slower): if a query neither returns nor raises (the round-3 outage
+    mode), backend health is polled OUT of process for the rest of the
+    budget while the original probe is re-checked each cycle — a merely
+    SLOW init that lands late is still returned. Once the backend answers
+    out-of-process, the in-flight probe gets one more ``hang_timeout_s`` to
+    land; if it stays stuck, its thread holds xla_bridge's init lock forever
+    and this process can never use the recovered backend — that state raises
+    BackendWedgedError so the caller can restart/re-exec (bench.py does so
+    automatically) instead of blocking forever.
 
     The healthy path costs nothing extra: the first probe is immediate and
     its result is returned directly.
     """
+    import sys
     import time
 
-    import jax
-
+    if hang_timeout_s is None:
+        hang_timeout_s = env_seconds("PDMT_HANG_TIMEOUT", 75.0)
     deadline = time.monotonic() + max_wait_s
     attempt = 0
+    waiter = None  # wait_fn of an abandoned (possibly just slow) probe
     while True:
-        try:
-            return jax.devices()
-        except RuntimeError as e:
+        remaining = deadline - time.monotonic()
+        if waiter is None:
+            status, payload = _probe_devices_bounded(
+                min(hang_timeout_s, max(remaining, 1.0)))
+        else:
+            status, payload = waiter(0.0)  # re-check the in-flight probe
+        if status == "ok":
+            return payload
+        if status == "fatal":
+            raise payload
+        if status == "error":
+            waiter = None
             attempt += 1
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise BackendUnavailableError(
                     f"backend unavailable after {attempt} attempts over "
-                    f"{max_wait_s:.0f}s: {e}") from e
-            import sys
+                    f"{max_wait_s:.0f}s: {payload}") from payload
             print(f"wireup: backend unavailable (attempt {attempt}), "
-                  f"retrying for another {remaining:.0f}s: {e}",
-                  file=sys.stderr, flush=True)  # keep stdout machine-readable
+                  f"retrying for another {remaining:.0f}s: {payload}",
+                  file=sys.stderr, flush=True)  # stdout stays machine-readable
             time.sleep(min(poll_s, max(remaining, 0.1)))
             try:
                 from jax._src import xla_bridge
                 xla_bridge._clear_backends()
             except Exception:
                 pass  # older/newer jax: fall through and re-probe anyway
+            continue
+
+        # status == "hang": the probe neither returned nor raised. Watch for
+        # tunnel recovery from fresh subprocesses (immune to this process's
+        # held init lock) while re-checking the in-flight probe above.
+        if waiter is None:
+            waiter = payload
+            attempt += 1
+            print(f"wireup: backend probe hung for {hang_timeout_s:.0f}s "
+                  f"(no error to retry on); polling health out-of-process",
+                  file=sys.stderr, flush=True)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise BackendUnavailableError(
+                f"backend probe hung (> {hang_timeout_s:.0f}s without "
+                f"returning or raising) and out-of-process probes stayed "
+                f"unhealthy for the rest of the {max_wait_s:.0f}s budget")
+        if _subprocess_backend_healthy(min(hang_timeout_s, remaining)):
+            # Backend answers from a fresh process. Give the in-flight init
+            # one more bounded join — a slow-but-healthy init lands here.
+            status, payload = waiter(
+                min(hang_timeout_s, max(deadline - time.monotonic(), 1.0)))
+            if status == "ok":
+                return payload
+            if status in ("error", "fatal"):
+                waiter = None  # init failed late; lock released — re-probe
+                continue
+            raise BackendWedgedError(
+                "backend is healthy again but this process's jax client is "
+                "wedged: an earlier jax.devices() probe hung inside backend "
+                "init and still holds the init lock, so every in-process "
+                "query would block forever. Restart the process (bench.py "
+                "re-execs itself once automatically).")
+        time.sleep(min(poll_s, max(deadline - time.monotonic(), 0.1)))
 
 
 def initialize_runtime(method: str = "auto") -> Runtime:
