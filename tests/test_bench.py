@@ -274,6 +274,41 @@ def test_bench_reexecs_once_on_wedged_backend(monkeypatch, capsys):
         os.environ.pop("PDMT_NO_REEXEC", None)
 
 
+def test_bench_matrix_retries_failed_rows(monkeypatch, tmp_path):
+    """A variant that fails mid-sweep (tunnel outage) is re-measured by the
+    retry pass instead of shipping a null row in the artifact."""
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "scripts" \
+        / "bench_matrix.py"
+    spec = importlib.util.spec_from_file_location("bench_matrix", path)
+    bm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bm)
+
+    flaky = tuple(bm.VARIANTS[2][1])
+    calls = []
+
+    def fake_run(extra, epochs):
+        calls.append(tuple(extra))
+        if tuple(extra) == flaky and calls.count(flaky) == 1:
+            return None, ["backend_unavailable: tunnel outage"]
+        return {"value": 1e6, "unit": "images/sec/chip",
+                "vs_baseline": 1.0}, None
+
+    monkeypatch.setattr(bm, "run_variant", fake_run)
+    monkeypatch.setattr(bm, "_backend_info",
+                        lambda: {"backend": "cpu", "device_kind": "test",
+                                 "jax_version": "0"})
+    out = tmp_path / "matrix.json"
+    rc = bm.main(["--quick", "--out", str(out), "--retries", "2"])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert len(art["variants"]) == len(bm.VARIANTS)
+    assert all(r["value"] is not None for r in art["variants"])
+    assert calls.count(flaky) == 2  # failed once, retried once, then clean
+
+
 def test_bench_emits_json_error_line_when_backend_unavailable():
     """A dead backend must produce ONE machine-readable JSON line (rc=1),
     never a bare traceback — the BENCH_r02 failure mode (VERDICT r2 #1)."""
