@@ -137,6 +137,10 @@ def main(argv=None) -> None:
                    help="PER-CHIP batch (the reference flagship is 128; "
                         "larger values measure throughput scaling — the "
                         "gridded Pallas kernel handles any size)")
+    p.add_argument("--superstep", type=int, default=1, choices=(1, 2, 4, 8),
+                   help="whole-epoch kernel only: K SGD sub-steps per grid "
+                        "iteration (identical math; amortizes per-iteration "
+                        "cost). Rejected by name on per-step kernels")
     p.add_argument("--unroll", type=int, default=1,
                    help="unroll factor for the per-step scan; measured "
                         "SLOWER than 1 at 2/4/8 (docs/PERF.md) — kept for "
@@ -239,6 +243,10 @@ def main(argv=None) -> None:
     if a.kernel in ("pallas_rng", "pallas_epoch") and not on_tpu:
         p.error(f"--kernel {a.kernel} needs a real TPU (the core PRNG has "
                 "no interpreter lowering)")
+    if a.superstep != 1 and a.kernel != "pallas_epoch":
+        p.error(f"--superstep {a.superstep} is a whole-epoch-kernel knob; "
+                f"the resolved kernel is {a.kernel!r} (use --kernel "
+                f"pallas_epoch, or drop --superstep)")
     interpret = a.kernel == "pallas" and not on_tpu
     if a.kernel == "pallas_epoch" and n_chips == 1:
         # Whole-epoch kernel on the 1-chip mesh: the serial program IS the
@@ -247,7 +255,7 @@ def main(argv=None) -> None:
         # named rejection fires instead of silently measuring unroll=1.
         from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
         run_fn = make_run_fn(lr=0.01, dtype=a.dtype, kernel=a.kernel,
-                             unroll=a.unroll)
+                             unroll=a.unroll, superstep=a.superstep)
     else:
         if a.kernel == "pallas_epoch":
             print("[experimental] pallas_epoch on a multi-chip mesh: "
@@ -257,7 +265,7 @@ def main(argv=None) -> None:
                   file=sys.stderr, flush=True)
         run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype,
                                 kernel=a.kernel, interpret=interpret,
-                                unroll=a.unroll)
+                                unroll=a.unroll, superstep=a.superstep)
     params_host = jax.tree_util.tree_map(np.asarray, init_mlp(jax.random.key(0)))
     key_host = np.asarray(jax.random.key_data(
         jax.random.key(1, impl=a.impl)))

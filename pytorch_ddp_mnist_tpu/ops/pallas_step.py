@@ -343,7 +343,9 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
 
 def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
                        uint8_in: bool = False, axis_name: str | None = None,
-                       n_devices: int = 1, compute_bf16: bool = False):
+                       n_devices: int = 1, compute_bf16: bool = False,
+                       steps_per_iter: int = 1,
+                       nsteps_total: int | None = None):
     """Whole-EPOCH kernel: grid = (nsteps,), one SGD step per grid iteration,
     weights VMEM-RESIDENT for the entire epoch.
 
@@ -385,8 +387,20 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
     The f32 kernel is MXU-bound at this batch size (docs/PERF.md roofline);
     bf16 operands run the systolic array at ~4x the f32 rate. Same recipe as
     the XLA path's --dtype bfloat16 (bf16 fwd/bwd, f32 master weights),
-    except elementwise ops here keep f32 — a strictly tighter numerics."""
+    except elementwise ops here keep f32 — a strictly tighter numerics.
+
+    `steps_per_iter=K` (K in {1,2,4,8}; single-replica only): K sequential
+    SGD sub-steps per grid iteration, streaming a (K*block, ...) input block
+    — amortizes the fixed per-grid-iteration cost (pipeline bookkeeping,
+    loss-tile revisit merge) over K steps. The math is IDENTICAL to K=1:
+    sub-step k trains on rows [k*block,(k+1)*block) of the iteration's
+    block, seeds its dropout stream with the same (seed, global_step) words,
+    and updates the resident weights in place before sub-step k+1 reads
+    them. `nsteps_total` (required when the step count does not divide by K;
+    the wrapper zero-pads the tail) marks trailing padded sub-steps: their
+    loss rows are zeroed and their SGD update is skipped via lr=0."""
     dp = n_devices > 1
+    K = steps_per_iter
     mm_dt = jnp.bfloat16 if compute_bf16 else jnp.float32
 
     def kernel(*refs):
@@ -409,167 +423,191 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
             ow3[:] = w3_ref[:]
 
         me = jax.lax.axis_index(axis_name) if dp else None
-        if in_kernel_rng:
-            # Multi-word seed: the hardware hashes (epoch_seed[, replica],
-            # step) into the stream state, so per-step streams are mixed
-            # non-linearly — no contiguous seed-range reuse across epochs (a
-            # seed+pid sum makes nearby epochs' step ranges overlap at
-            # percent-level probability over long runs). The replica word
-            # gives each DP rank an independent dropout stream (SURVEY.md §7
-            # parity item 4).
-            if dp:
-                pltpu.prng_seed(m_ref[0], me, pid)
-            else:
-                pltpu.prng_seed(m_ref[0], pid)
-            bits = pltpu.bitcast(
-                pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
-            m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
-                          f32(1.0 / (1.0 - DROPOUT_RATE)), f32(0.0))
-        else:
-            m = m_ref[:]
-
-        x = x_ref[:]
-        if uint8_in:
-            # normalize_images' op chain, per block, on the VPU. Mosaic has
-            # no direct u8->f32 convert; widen through int32 (exact for
-            # 0..255, so the math is identical to the host/XLA normalize).
-            x = x.astype(jnp.int32).astype(f32)
-            x = x / f32(255.0)
-            x = x - f32(MNIST_MEAN)
-            x = x / f32(MNIST_STD)
-        # ---- forward (weights read from the resident, updated refs;
-        # matmul operands cast to mm_dt — a no-op cast for f32 compute) ----
-        xm = x.astype(mm_dt)
-        w1m, w2m, w3m = (ow1[:].astype(mm_dt), ow2[:].astype(mm_dt),
-                         ow3[:].astype(mm_dt))
-        z1 = jax.lax.dot_general(xm, w1m, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=f32) + ob1[:]
-        h1 = jnp.maximum(z1, 0.0)
-        d1 = h1 * m
-        d1m = d1.astype(mm_dt)
-        z2 = jax.lax.dot_general(d1m, w2m, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=f32) + ob2[:]
-        h2 = jnp.maximum(z2, 0.0)
-        h2m = h2.astype(mm_dt)
-        logits = jax.lax.dot_general(h2m, w3m, (((1,), (0,)), ((), ())),
-                                     preferred_element_type=f32)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (block, PADDED_CLASSES), 1)
-        logits = jnp.where(cols < NUM_CLASSES, logits, _NEG_INF)
-
-        mx = jnp.max(logits, axis=1, keepdims=True)
-        ex = jnp.exp(logits - mx)
-        se = jnp.sum(ex, axis=1, keepdims=True)
-        onehot = (cols == y_ref[:]).astype(f32)
-        logit_y = jnp.sum(jnp.where(onehot > 0, logits, 0.0), axis=1,
-                          keepdims=True)
-        # Per-step loss into an (8,128)-tiled VMEM output: grid step i owns
-        # row i%8 of block i//8 (Mosaic needs ≥(8,128) blocks; a (1,1) SMEM
+        # Per-step loss into an (8,128)-tiled VMEM output: global step g owns
+        # row g%8 of block g//8 (Mosaic needs ≥(8,128) blocks; a (1,1) SMEM
         # slot per step would be an illegal block shape for a (S,1) array).
-        # The block is revisited for 8 consecutive sequential steps; on first
-        # visit (i%8==0) the whole block is initialized, afterwards merged.
-        step_loss = jnp.sum((mx + jnp.log(se)) - logit_y) / block
+        # The block is revisited for 8/K consecutive sequential iterations;
+        # on first visit (base%8==0) the whole block is initialized,
+        # afterwards merged. The K sub-steps' rows merge in-register and
+        # store once at iteration end.
+        base = pid * K                      # first global step this iteration
+        off = jax.lax.rem(base, 8)
         lrow = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
-        sel = lrow == (pid % 8)
-        prev = jnp.where(pid % 8 == 0, jnp.zeros((8, 128), f32),
-                         loss_ref[:])
-        loss_ref[:] = jnp.where(sel, step_loss, prev)
+        tile = jnp.where(off == 0, jnp.zeros((8, 128), f32), loss_ref[:])
 
-        # ---- backward + in-kernel SGD (every row valid: the sampler
-        # wrap-pads the epoch to nsteps*block rows exactly) ----
-        dlogits = (ex / se - onehot) * (1.0 / block)
-        dlm = dlogits.astype(mm_dt)
-        gw3 = jax.lax.dot_general(h2m, dlm, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=f32)
-        dh2 = jax.lax.dot_general(dlm, w3m, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=f32)
-        dz2 = dh2 * (z2 > 0.0).astype(f32)
-        dz2m = dz2.astype(mm_dt)
-        gw2 = jax.lax.dot_general(d1m, dz2m, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=f32)
-        gb2 = jnp.sum(dz2, axis=0, keepdims=True)
-        dd1 = jax.lax.dot_general(dz2m, w2m, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=f32)
-        dz1 = (dd1 * m) * (z1 > 0.0).astype(f32)
-        gw1 = jax.lax.dot_general(xm, dz1.astype(mm_dt),
-                                  (((0,), (0,)), ((), ())),
-                                  preferred_element_type=f32)
-        gb1 = jnp.sum(dz1, axis=0, keepdims=True)
+        for k in range(K):
+            gs = base + k                   # this sub-step's global step
+            if in_kernel_rng:
+                # Multi-word seed: the hardware hashes (epoch_seed[,
+                # replica], step) into the stream state, so per-step streams
+                # are mixed non-linearly — no contiguous seed-range reuse
+                # across epochs (a seed+step sum makes nearby epochs' step
+                # ranges overlap at percent-level probability over long
+                # runs). The replica word gives each DP rank an independent
+                # dropout stream (SURVEY.md §7 parity item 4). The words are
+                # the same (seed, global step) at every steps_per_iter, so K
+                # does not change the masks.
+                if dp:
+                    pltpu.prng_seed(m_ref[0], me, gs)
+                else:
+                    pltpu.prng_seed(m_ref[0], gs)
+                bits = pltpu.bitcast(
+                    pltpu.prng_random_bits((block, HIDDEN1)), jnp.uint32)
+                m = jnp.where(bits < jnp.uint32(_KEEP_THRESH),
+                              f32(1.0 / (1.0 - DROPOUT_RATE)), f32(0.0))
+            else:
+                m = m_ref[pl.ds(k * block, block), :]
 
-        if dp:
-            n = n_devices
-            left = jax.lax.rem(me + (n - 1), n)
-            right = jax.lax.rem(me + 1, n)
-            # MESH device ids: coordinates along the shard_map mesh axis —
-            # correct even when the mesh's device array was topology-
-            # reordered (raw LOGICAL ids would bypass that mapping).
-            did = pltpu.DeviceIdType.MESH
+            x = x_ref[pl.ds(k * block, block), :]
+            if uint8_in:
+                # normalize_images' op chain, per block, on the VPU. Mosaic
+                # has no direct u8->f32 convert; widen through int32 (exact
+                # for 0..255, so the math is identical to the host/XLA
+                # normalize).
+                x = x.astype(jnp.int32).astype(f32)
+                x = x / f32(255.0)
+                x = x - f32(MNIST_MEAN)
+                x = x / f32(MNIST_STD)
+            # ---- forward (weights read from the resident, updated refs;
+            # matmul operands cast to mm_dt — a no-op cast for f32 compute;
+            # sub-step k reads the weights sub-step k-1 wrote) ----
+            xm = x.astype(mm_dt)
+            w1m, w2m, w3m = (ow1[:].astype(mm_dt), ow2[:].astype(mm_dt),
+                             ow3[:].astype(mm_dt))
+            z1 = jax.lax.dot_general(xm, w1m, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=f32) + ob1[:]
+            h1 = jnp.maximum(z1, 0.0)
+            d1 = h1 * m
+            d1m = d1.astype(mm_dt)
+            z2 = jax.lax.dot_general(d1m, w2m, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=f32) + ob2[:]
+            h2 = jnp.maximum(z2, 0.0)
+            h2m = h2.astype(mm_dt)
+            logits = jax.lax.dot_general(h2m, w3m, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=f32)
+            cols = jax.lax.broadcasted_iota(jnp.int32,
+                                            (block, PADDED_CLASSES), 1)
+            logits = jnp.where(cols < NUM_CLASSES, logits, _NEG_INF)
 
-            @pl.when(pid == 0)
-            def _entry_barrier():
-                # Gate the FIRST remote signal of this kernel invocation on
-                # both neighbors having entered the kernel: the per-step
-                # handshake below signals scratch REGULAR semaphores, which
-                # is only safe once the neighbor's kernel (and its scratch
-                # allocation) is live. The global barrier semaphore (bound
-                # to collective_id) exists exactly for this cross-entry
-                # rendezvous.
-                bsem = pltpu.get_barrier_semaphore()
-                pltpu.semaphore_signal(bsem, inc=1, device_id=(left,),
+            mx = jnp.max(logits, axis=1, keepdims=True)
+            ex = jnp.exp(logits - mx)
+            se = jnp.sum(ex, axis=1, keepdims=True)
+            onehot = (cols == y_ref[pl.ds(k * block, block), :]).astype(f32)
+            logit_y = jnp.sum(jnp.where(onehot > 0, logits, 0.0), axis=1,
+                              keepdims=True)
+            step_loss = jnp.sum((mx + jnp.log(se)) - logit_y) / block
+            if nsteps_total is not None:
+                # zero-padded tail sub-step: keep the loss row zero and skip
+                # the SGD update (lr=0 — the padded rows are zeros, finite,
+                # so the masked grads are finite too)
+                valid = gs < nsteps_total
+                step_loss = jnp.where(valid, step_loss, f32(0.0))
+                lr_k = jnp.where(valid, f32(lr), f32(0.0))
+            else:
+                lr_k = lr
+            tile = jnp.where(lrow == off + k, step_loss, tile)
+
+            # ---- backward + in-kernel SGD. Every row of a VALID sub-step
+            # is real data (the sampler wrap-pads each step to `block` rows
+            # exactly); a padded TAIL sub-step (K>1, ragged step count) has
+            # arbitrary rows and is neutralized above: loss row zeroed,
+            # update skipped via lr_k=0 (pad rows are finite, so 0*g=0) ----
+            dlogits = (ex / se - onehot) * (1.0 / block)
+            dlm = dlogits.astype(mm_dt)
+            gw3 = jax.lax.dot_general(h2m, dlm, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=f32)
+            dh2 = jax.lax.dot_general(dlm, w3m, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=f32)
+            dz2 = dh2 * (z2 > 0.0).astype(f32)
+            dz2m = dz2.astype(mm_dt)
+            gw2 = jax.lax.dot_general(d1m, dz2m, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=f32)
+            gb2 = jnp.sum(dz2, axis=0, keepdims=True)
+            dd1 = jax.lax.dot_general(dz2m, w2m, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=f32)
+            dz1 = (dd1 * m) * (z1 > 0.0).astype(f32)
+            gw1 = jax.lax.dot_general(xm, dz1.astype(mm_dt),
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=f32)
+            gb1 = jnp.sum(dz1, axis=0, keepdims=True)
+
+            if dp:
+                n = n_devices
+                left = jax.lax.rem(me + (n - 1), n)
+                right = jax.lax.rem(me + 1, n)
+                # MESH device ids: coordinates along the shard_map mesh axis —
+                # correct even when the mesh's device array was topology-
+                # reordered (raw LOGICAL ids would bypass that mapping).
+                did = pltpu.DeviceIdType.MESH
+
+                @pl.when(pid == 0)
+                def _entry_barrier():
+                    # Gate the FIRST remote signal of this kernel invocation on
+                    # both neighbors having entered the kernel: the per-step
+                    # handshake below signals scratch REGULAR semaphores, which
+                    # is only safe once the neighbor's kernel (and its scratch
+                    # allocation) is live. The global barrier semaphore (bound
+                    # to collective_id) exists exactly for this cross-entry
+                    # rendezvous.
+                    bsem = pltpu.get_barrier_semaphore()
+                    pltpu.semaphore_signal(bsem, inc=1, device_id=(left,),
+                                           device_id_type=did)
+                    pltpu.semaphore_signal(bsem, inc=1, device_id=(right,),
+                                           device_id_type=did)
+                    pltpu.semaphore_wait(bsem, 2)
+
+                # Pack this replica's grads into its origin-indexed comm slot.
+                comm[me, pl.ds(0, IN_DIM), :] = gw1
+                comm[me, pl.ds(IN_DIM, 1), :] = gb1
+                comm[me, pl.ds(IN_DIM + 1, HIDDEN2), :] = gw2
+                comm[me, pl.ds(IN_DIM + 1 + HIDDEN2, 1), :] = gb2
+                comm[me, pl.ds(IN_DIM + 2 + HIDDEN2, PADDED_CLASSES), :] = gw3
+                # Per-step neighbor handshake: my hop-0 send overwrites a slot on
+                # `right` that its PREVIOUS step read during the fixed-order sum,
+                # so I must not send until both neighbors have finished their
+                # previous step. Dedicated per-neighbor semaphores (I signal
+                # right's lsem as its left neighbor, and vice versa) — a shared
+                # counter could conflate one neighbor running two steps ahead.
+                pltpu.semaphore_signal(lsem, inc=1, device_id=(right,),
                                        device_id_type=did)
-                pltpu.semaphore_signal(bsem, inc=1, device_id=(right,),
+                pltpu.semaphore_signal(rsem, inc=1, device_id=(left,),
                                        device_id_type=did)
-                pltpu.semaphore_wait(bsem, 2)
+                pltpu.semaphore_wait(lsem, 1)
+                pltpu.semaphore_wait(rsem, 1)
+                # Ring all-gather: hop h forwards the slot received at hop h-1
+                # (hop 0: my own) to the right; slots keep their ORIGIN index on
+                # every device. Per-hop DMA semaphores so an out-of-order arrival
+                # of hop h+1's signal can never satisfy hop h's wait.
+                for h in range(n - 1):
+                    send_slot = jax.lax.rem(me - h + n * 2, n)
+                    rdma = pltpu.make_async_remote_copy(
+                        src_ref=comm.at[send_slot],
+                        dst_ref=comm.at[send_slot],
+                        send_sem=send_sems.at[h],
+                        recv_sem=recv_sems.at[h],
+                        device_id=(right,), device_id_type=did)
+                    rdma.start()
+                    rdma.wait()   # my send done AND my hop-h chunk arrived
+                # Fixed-order sum over origin slots: every replica reduces in the
+                # identical order -> bitwise-identical mean grads on all chips ->
+                # the resident weights stay in lockstep with no broadcast.
+                tot = comm[0]
+                for d in range(1, n):
+                    tot = tot + comm[d]
+                g = tot * f32(1.0 / n)
+                gw1 = g[0:IN_DIM]
+                gb1 = g[IN_DIM:IN_DIM + 1]
+                gw2 = g[IN_DIM + 1:IN_DIM + 1 + HIDDEN2]
+                gb2 = g[IN_DIM + 1 + HIDDEN2:IN_DIM + 2 + HIDDEN2]
+                gw3 = g[IN_DIM + 2 + HIDDEN2:]
 
-            # Pack this replica's grads into its origin-indexed comm slot.
-            comm[me, pl.ds(0, IN_DIM), :] = gw1
-            comm[me, pl.ds(IN_DIM, 1), :] = gb1
-            comm[me, pl.ds(IN_DIM + 1, HIDDEN2), :] = gw2
-            comm[me, pl.ds(IN_DIM + 1 + HIDDEN2, 1), :] = gb2
-            comm[me, pl.ds(IN_DIM + 2 + HIDDEN2, PADDED_CLASSES), :] = gw3
-            # Per-step neighbor handshake: my hop-0 send overwrites a slot on
-            # `right` that its PREVIOUS step read during the fixed-order sum,
-            # so I must not send until both neighbors have finished their
-            # previous step. Dedicated per-neighbor semaphores (I signal
-            # right's lsem as its left neighbor, and vice versa) — a shared
-            # counter could conflate one neighbor running two steps ahead.
-            pltpu.semaphore_signal(lsem, inc=1, device_id=(right,),
-                                   device_id_type=did)
-            pltpu.semaphore_signal(rsem, inc=1, device_id=(left,),
-                                   device_id_type=did)
-            pltpu.semaphore_wait(lsem, 1)
-            pltpu.semaphore_wait(rsem, 1)
-            # Ring all-gather: hop h forwards the slot received at hop h-1
-            # (hop 0: my own) to the right; slots keep their ORIGIN index on
-            # every device. Per-hop DMA semaphores so an out-of-order arrival
-            # of hop h+1's signal can never satisfy hop h's wait.
-            for h in range(n - 1):
-                send_slot = jax.lax.rem(me - h + n * 2, n)
-                rdma = pltpu.make_async_remote_copy(
-                    src_ref=comm.at[send_slot],
-                    dst_ref=comm.at[send_slot],
-                    send_sem=send_sems.at[h],
-                    recv_sem=recv_sems.at[h],
-                    device_id=(right,), device_id_type=did)
-                rdma.start()
-                rdma.wait()   # my send done AND my hop-h chunk arrived
-            # Fixed-order sum over origin slots: every replica reduces in the
-            # identical order -> bitwise-identical mean grads on all chips ->
-            # the resident weights stay in lockstep with no broadcast.
-            tot = comm[0]
-            for d in range(1, n):
-                tot = tot + comm[d]
-            g = tot * f32(1.0 / n)
-            gw1 = g[0:IN_DIM]
-            gb1 = g[IN_DIM:IN_DIM + 1]
-            gw2 = g[IN_DIM + 1:IN_DIM + 1 + HIDDEN2]
-            gb2 = g[IN_DIM + 1 + HIDDEN2:IN_DIM + 2 + HIDDEN2]
-            gw3 = g[IN_DIM + 2 + HIDDEN2:]
+            ow1[:] -= lr_k * gw1
+            ob1[:] -= lr_k * gb1
+            ow2[:] -= lr_k * gw2
+            ob2[:] -= lr_k * gb2
+            ow3[:] -= lr_k * gw3
 
-        ow1[:] -= lr * gw1
-        ob1[:] -= lr * gb1
-        ow2[:] -= lr * gw2
-        ob2[:] -= lr * gb2
-        ow3[:] -= lr * gw3
+        loss_ref[:] = tile
 
     return kernel
 
@@ -577,7 +615,8 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
 def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
                     masks=None, interpret: bool = False,
                     axis_name: str | None = None, axis_size: int = 1,
-                    compute_bf16: bool = False):
+                    compute_bf16: bool = False, steps_per_iter: int = 1,
+                    valid_steps: int | None = None):
     """One ENTIRE epoch as a single kernel (`--kernel pallas_epoch`):
     (params, xp (S*B, 784) pre-gathered epoch rows, yp (S*B,) int32,
     seed () int32, lr, batch=B) -> (params', losses (S,)).
@@ -609,7 +648,18 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     for the DDP-reported loss); the returned params are bitwise-identical on
     every replica. EXPERIMENTAL: CI-covered via the n=1 degenerate + named
     errors; the ring itself needs real multi-chip hardware to execute, which
-    this session does not have."""
+    this session does not have.
+
+    `steps_per_iter=K` (K in {1,2,4,8}; single-replica only): K sequential
+    SGD steps per grid iteration streaming one (K*B, ...) input block —
+    same math, bit-for-bit (see _make_epoch_kernel); amortizes the fixed
+    per-iteration cost. A step count not divisible by K is zero-padded to a
+    whole iteration and the padded tail sub-steps are masked out (loss row
+    0, lr 0). Hot-path callers should pad CHEAPLY at the index level
+    instead (repeat gather indices to a multiple of K steps — the scan body
+    does) and pass `valid_steps` = the true step count: the wrapper then
+    skips its whole-array zero-concat fallback, masks the tail the same
+    way, and returns exactly `valid_steps` losses."""
     rows, dim = xp.shape
     assert dim == IN_DIM
     f32 = jnp.float32
@@ -648,6 +698,44 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             f"{axis_size} replicas > {EPOCH_KERNEL_MAX_DEVICES} exceeds the "
             f"budget. Use the per-step kernel (--kernel pallas) on larger "
             f"meshes")
+    K = steps_per_iter
+    if K not in (1, 2, 4, 8):
+        raise ValueError(
+            f"steps_per_iter must be 1, 2, 4 or 8 (the K sub-step loss rows "
+            f"of a grid iteration must stay inside one 8-row loss tile); "
+            f"got {K}")
+    if dp and K != 1:
+        raise ValueError(
+            "steps_per_iter > 1 is single-replica only: the DP ring "
+            "allreduce handshake is per grid iteration, not per sub-step. "
+            "Use steps_per_iter=1 on DP meshes")
+    if K * block > EPOCH_KERNEL_MAX_BATCH:
+        raise ValueError(
+            f"steps_per_iter={K} streams a ({K}*{block}, 784) input block "
+            f"per grid iteration; {K * block} rows > "
+            f"{EPOCH_KERNEL_MAX_BATCH} exceeds the VMEM stream budget")
+    if valid_steps is None:
+        valid_steps = nsteps
+    elif not 0 < valid_steps <= nsteps:
+        raise ValueError(
+            f"valid_steps={valid_steps} must be in [1, {nsteps}] (the "
+            f"number of steps present in xp)")
+    grid_n = -(-nsteps // K)
+    padded_steps = grid_n * K
+    pad_steps = padded_steps - nsteps
+    if pad_steps:
+        # Fallback for direct ragged callers: zero-pad the tail to a whole
+        # grid iteration; the kernel masks the padded sub-steps out (loss
+        # row 0, lr 0 — zeros are finite inputs). This concatenates the
+        # whole epoch arrays — hot paths pre-pad at the index level and
+        # pass valid_steps instead (see docstring).
+        zrows = pad_steps * block
+        xp = jnp.concatenate(
+            [xp, jnp.zeros((zrows, IN_DIM), xp.dtype)], axis=0)
+        yp = jnp.concatenate([yp, jnp.zeros((zrows,), yp.dtype)], axis=0)
+        if masks is not None:
+            masks = jnp.concatenate(
+                [masks, jnp.zeros((zrows, HIDDEN1), masks.dtype)], axis=0)
     uint8_in = xp.dtype == jnp.uint8
     vmem = partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     resident = lambda shape: vmem(shape, lambda i: (0, 0))  # noqa: E731
@@ -656,9 +744,9 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         third_spec = pl.BlockSpec((1,), lambda i: (0,),
                                   memory_space=pltpu.SMEM)  # seed
     else:
-        assert masks.shape == (rows, HIDDEN1), masks.shape
+        assert masks.shape == (xp.shape[0], HIDDEN1), masks.shape
         third = masks.astype(f32)
-        third_spec = vmem((block, HIDDEN1), lambda i: (i, 0))  # mask block
+        third_spec = vmem((K * block, HIDDEN1), lambda i: (i, 0))  # masks
     w_shapes = (
         jax.ShapeDtypeStruct((IN_DIM, HIDDEN1), f32),
         jax.ShapeDtypeStruct((1, HIDDEN1), f32),
@@ -666,7 +754,7 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         jax.ShapeDtypeStruct((1, HIDDEN2), f32),
         jax.ShapeDtypeStruct((HIDDEN2, PADDED_CLASSES), f32),
     )
-    nblocks8 = -(-nsteps // 8)
+    nblocks8 = -(-padded_steps // 8)
     out_shapes = (jax.ShapeDtypeStruct((nblocks8 * 8, 128), f32),) + w_shapes
     if dp:
         scratch_shapes = [
@@ -686,14 +774,18 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     loss, w1, b1, w2, b2, w3 = pl.pallas_call(
         _make_epoch_kernel(block, lr, in_kernel_rng=in_kernel_rng,
                            uint8_in=uint8_in, axis_name=axis_name,
-                           n_devices=axis_size, compute_bf16=compute_bf16),
-        grid=(nsteps,),
+                           n_devices=axis_size, compute_bf16=compute_bf16,
+                           steps_per_iter=K,
+                           nsteps_total=(valid_steps
+                                         if padded_steps != valid_steps
+                                         else None)),
+        grid=(grid_n,),
         compiler_params=compiler_params,
         scratch_shapes=scratch_shapes,
         out_shape=out_shapes,
         in_specs=[
-            vmem((block, IN_DIM), lambda i: (i, 0)),          # x block
-            vmem((block, 1), lambda i: (i, 0)),               # y block
+            vmem((K * block, IN_DIM), lambda i: (i, 0)),      # x block
+            vmem((K * block, 1), lambda i: (i, 0)),           # y block
             third_spec,                                       # seed | masks
             resident((IN_DIM, HIDDEN1)),                      # w1 in
             resident((1, HIDDEN1)),
@@ -702,7 +794,8 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             resident((HIDDEN2, PADDED_CLASSES)),
         ],
         out_specs=(
-            vmem((8, 128), lambda i: (i // 8, 0)),            # per-step loss
+            # iteration i's K loss rows live in tile (i*K)//8 (K divides 8)
+            vmem((8, 128), lambda i: ((i * K) // 8, 0)),      # per-step loss
             resident((IN_DIM, HIDDEN1)),                      # w1 out
             resident((1, HIDDEN1)),
             resident((HIDDEN1, HIDDEN2)),
@@ -725,7 +818,7 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         "fc2": {"w": w2, "b": b2[0]},
         "fc3": {"w": w3[:, :NUM_CLASSES]},
     }
-    return new_params, loss[:nsteps, 0]
+    return new_params, loss[:valid_steps, 0]
 
 
 def epoch_sgd_reference(params, xp, yp, masks, lr: float, batch: int,

@@ -106,6 +106,23 @@ def _check_kernel(kernel: str, dtype: str) -> None:
         raise ValueError(f"unknown dtype {dtype!r}")
 
 
+def _check_superstep(superstep: int, kernel: str) -> None:
+    """superstep composes only with the whole-epoch kernel (K sub-steps per
+    grid iteration); reject it elsewhere by name rather than silently
+    ignoring the flag (the unroll lesson, ADVICE r2)."""
+    if superstep == 1:
+        return
+    if kernel != "pallas_epoch":
+        raise ValueError(
+            f"superstep={superstep} is a whole-epoch-kernel knob (K SGD "
+            f"sub-steps per grid iteration); kernel={kernel!r} has a "
+            f"per-step scan — use unroll there, or kernel='pallas_epoch'")
+    if superstep not in (2, 4, 8):
+        raise ValueError(
+            f"superstep must be 1, 2, 4 or 8 (sub-step loss rows must stay "
+            f"inside one 8-row loss tile); got {superstep}")
+
+
 def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):
     """Per-step fwd+bwd: XLA autodiff or the fused Pallas kernel. 'pallas'
     draws the dropout mask from the same bernoulli stream as 'xla' for the
@@ -153,7 +170,8 @@ def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
 def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
                        pmean_axis: str | None = None,
                        axis_size: int = 1,
-                       compute_bf16: bool = False) -> Callable:
+                       compute_bf16: bool = False,
+                       steps_per_iter: int = 1) -> Callable:
     """The shared per-EPOCH scan body of the kernel='pallas_epoch' programs
     (serial make_run_fn and DP make_dp_run_fn): derive the epoch's dropout
     source from the key chain, gather the epoch rows (uint8 pass-through —
@@ -171,7 +189,18 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
         params, key = carry
         key, sub = jax.random.split(key)
         batch = idx_e.shape[1]               # per-replica rows per step
+        nsteps = idx_e.shape[0]              # real steps this epoch
         rows = idx_e.reshape(-1)
+        # A ragged step count (nsteps % K != 0) is padded HERE, at the
+        # index level — a few extra gathered blocks — so epoch_fused_sgd
+        # never takes its whole-epoch zero-concat fallback on the hot path.
+        # The kernel masks the padded tail sub-steps by global step
+        # (valid_steps), so the pad rows' content is irrelevant (index 0 =
+        # real, finite data).
+        pad_steps = (-nsteps) % steps_per_iter
+        if pad_steps:
+            rows = jnp.concatenate(
+                [rows, jnp.zeros(pad_steps * batch, rows.dtype)])
         if x_all.dtype == jnp.uint8:
             # raw uint8 rows stream straight into the kernel — no f32 epoch
             # image array (~4x the bytes) is ever materialized in HBM.
@@ -180,19 +209,27 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
             xp = _gathered_x(x_all, rows, jnp.float32)
         yp = jnp.take(y_all, rows, axis=0)
         if interpret:
-            subs = jax.random.split(sub, rows.shape[0] // batch)
+            subs = jax.random.split(sub, nsteps)
             masks = jax.vmap(lambda k: dropout_mask(k, batch))(subs)
+            masks = masks.reshape(nsteps * batch, -1)
+            if pad_steps:
+                masks = jnp.concatenate(
+                    [masks,
+                     jnp.zeros((pad_steps * batch, masks.shape[1]),
+                               masks.dtype)])
             params, losses = epoch_fused_sgd(
                 params, xp, yp, None, lr, batch,
-                masks=masks.reshape(rows.shape[0], -1), interpret=True,
-                compute_bf16=compute_bf16)
+                masks=masks, interpret=True,
+                compute_bf16=compute_bf16, steps_per_iter=steps_per_iter,
+                valid_steps=nsteps)
         else:
             seed = jax.lax.bitcast_convert_type(
                 jax.random.key_data(sub).ravel()[0], jnp.int32)
             params, losses = epoch_fused_sgd(
                 params, xp, yp, seed, lr, batch,
                 axis_name=pmean_axis if axis_size > 1 else None,
-                axis_size=axis_size, compute_bf16=compute_bf16)
+                axis_size=axis_size, compute_bf16=compute_bf16,
+                steps_per_iter=steps_per_iter, valid_steps=nsteps)
         if pmean_axis is not None:
             # the DDP-reported loss: mean over replicas of the shard-local
             # per-step means (params are already lockstep-identical)
@@ -205,7 +242,7 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
 
 def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
                 interpret: bool = False, snapshots: bool = False,
-                unroll: int = 1) -> Callable:
+                unroll: int = 1, superstep: int = 1) -> Callable:
     """Serial analog of make_dp_run_fn: the whole E-epoch run as ONE jitted
     nested-scan program, optionally with per-epoch params snapshots.
 
@@ -214,8 +251,13 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
     bodies per loop iteration. Measured on hardware this is a NEGATIVE
     result — 10-27% slower than unroll=1 on both kernels (docs/PERF.md:
     loop bookkeeping is not the bottleneck, and the longer body schedules
-    worse). The knob exists to reproduce that measurement."""
+    worse). The knob exists to reproduce that measurement.
+
+    `superstep` (kernel='pallas_epoch' only; K in {1,2,4,8}): K SGD steps
+    per epoch-kernel grid iteration — identical math, amortized
+    per-iteration cost (ops.pallas_step.epoch_fused_sgd)."""
     _check_kernel(kernel, dtype)
+    _check_superstep(superstep, kernel)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
     def body(carry, batch_idx, x_all, y_all):
@@ -236,7 +278,8 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
         def run_epochal(params, key, x_all, y_all, idxs):
             epoch = _make_epochal_body(x_all, y_all, lr, interpret=interpret,
                                        snapshots=snapshots,
-                                       compute_bf16=dtype == "bfloat16")
+                                       compute_bf16=dtype == "bfloat16",
+                                       steps_per_iter=superstep)
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
             if snapshots:
                 losses, (p_snaps, k_snaps) = out
@@ -308,7 +351,8 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
 
 def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                    kernel: str = "xla", interpret: bool = False,
-                   snapshots: bool = False, unroll: int = 1) -> Callable:
+                   snapshots: bool = False, unroll: int = 1,
+                   superstep: int = 1) -> Callable:
     """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
     (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
     sharded on the batch dim.
@@ -327,6 +371,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     ~0.5 MB/epoch, trivial).
     """
     _check_kernel(kernel, dtype)
+    _check_superstep(superstep, kernel)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     use_pallas = kernel.startswith("pallas")
     n_dev = int(mesh.devices.size)
@@ -354,13 +399,19 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                 f"kernel 'pallas_epoch' rings grads through one VMEM slot "
                 f"per replica; mesh has {n_dev} devices > "
                 f"{EPOCH_KERNEL_MAX_DEVICES}. Use kernel='pallas'")
+        if superstep != 1 and n_dev > 1:
+            raise ValueError(
+                f"superstep={superstep} is single-replica only (the DP "
+                f"ring's per-iteration handshake); use superstep=1 on the "
+                f"{n_dev}-device mesh")
 
         def epoch_shard_fn(params, key, x_all, y_all, idxs):
             epoch = _make_epochal_body(x_all, y_all, lr, interpret=interpret,
                                        snapshots=snapshots,
                                        pmean_axis=DATA_AXIS,
                                        axis_size=n_dev,
-                                       compute_bf16=dtype == "bfloat16")
+                                       compute_bf16=dtype == "bfloat16",
+                                       steps_per_iter=superstep)
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
             if snapshots:
                 losses, (p_snaps, k_snaps) = out

@@ -614,3 +614,104 @@ def test_epoch_wrapper_interpret_snapshots_plumbing():
     _tree_allclose(rp, mp, rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(jax.random.key_data(rkey)),
                                   np.asarray(jax.random.key_data(mkey)))
+
+
+@pytest.mark.parametrize("K", [2, 4, 8])
+def test_epoch_kernel_superstep_bitwise_matches_k1(K):
+    """steps_per_iter=K (K sub-steps per grid iteration) is a pure schedule
+    change: same per-step math in the same order on the same resident
+    weights, so params AND losses must be BITWISE equal to K=1 — including
+    a ragged tail (11 steps: K=2 pads 1 step, K=8 pads 5)."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    nsteps, batch = 11, 16
+    x, y = _epoch_data(nsteps, batch, seed=7, uint8=True)
+    masks = _epoch_masks(jax.random.key(9), nsteps, batch)
+    params = init_mlp(jax.random.key(0))
+    p1, l1 = epoch_fused_sgd(params, x, y, None, 0.01, batch,
+                             masks=masks, interpret=True)
+    pk, lk = epoch_fused_sgd(params, x, y, None, 0.01, batch,
+                             masks=masks, interpret=True, steps_per_iter=K)
+    assert lk.shape == (nsteps,)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(lk))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(pk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_epoch_kernel_superstep_named_errors():
+    """Invalid superstep combinations fail by name at the wrapper and scan
+    layers (never a silent no-op — the unroll lesson, ADVICE r2)."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn, make_dp_run_fn
+    from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
+    nsteps, batch = 4, 16
+    x, y = _epoch_data(nsteps, batch)
+    masks = _epoch_masks(jax.random.key(1), nsteps, batch)
+    params = init_mlp(jax.random.key(0))
+
+    with pytest.raises(ValueError, match="steps_per_iter must be 1, 2, 4"):
+        epoch_fused_sgd(params, x, y, None, 0.01, batch, masks=masks,
+                        interpret=True, steps_per_iter=3)
+    with pytest.raises(ValueError, match="single-replica only"):
+        epoch_fused_sgd(params, x, y, None, 0.01, batch, masks=masks,
+                        axis_name="dp", axis_size=2, steps_per_iter=2)
+    with pytest.raises(ValueError, match="VMEM stream budget"):
+        epoch_fused_sgd(params, jnp.tile(x, (16, 1)), jnp.tile(y, 16),
+                        None, 0.01, 256,
+                        masks=jnp.tile(masks, (16, 1)), interpret=True,
+                        steps_per_iter=8)
+    with pytest.raises(ValueError, match="valid_steps=9 must be in"):
+        epoch_fused_sgd(params, x, y, None, 0.01, batch, masks=masks,
+                        interpret=True, valid_steps=9)
+    with pytest.raises(ValueError, match="whole-epoch-kernel knob"):
+        make_run_fn(lr=0.01, kernel="pallas", superstep=2)
+    with pytest.raises(ValueError, match="superstep must be 1, 2, 4 or 8"):
+        make_run_fn(lr=0.01, kernel="pallas_epoch", superstep=5)
+    mesh = make_mesh([2], ["dp"], jax.devices()[:2])
+    with pytest.raises(ValueError, match="single-replica only"):
+        make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch", superstep=2)
+
+
+def test_run_fn_superstep_matches_default():
+    """The scan-level plumbing (gather, key chain, scan over epochs) is
+    superstep-invariant: a 2-epoch interpreted run at superstep=8 equals
+    superstep=1 bitwise."""
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn, resident_images
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
+
+    split = synthetic_mnist(512, seed=11)
+    x_all = jnp.asarray(resident_images(split.images))  # uint8-resident
+    y_all = jnp.asarray(split.labels.astype(np.int32))
+    idxs = jnp.asarray(np.stack([
+        np.random.default_rng(e).permutation(512)[:11 * 32].reshape(11, 32)
+        for e in range(2)]).astype(np.int32))
+
+    outs = {}
+    for K in (1, 8):
+        run = make_run_fn(lr=0.01, kernel="pallas_epoch", interpret=True,
+                          superstep=K)
+        p, _, losses = run(init_mlp(jax.random.key(0)), jax.random.key(1),
+                           x_all, y_all, idxs)
+        outs[K] = (p, np.asarray(losses))
+    np.testing.assert_array_equal(outs[1][1], outs[8][1])
+    for a, b in zip(jax.tree_util.tree_leaves(outs[1][0]),
+                    jax.tree_util.tree_leaves(outs[8][0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@tpu_only
+def test_epoch_kernel_superstep_matches_k1_on_hardware():
+    """Mosaic path: the in-kernel-PRNG epoch kernel at superstep=8 must be
+    bitwise-equal to superstep=1 (same (seed, global step) words per
+    sub-step), ragged tail included."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    nsteps, batch = 11, 128
+    x, y = _epoch_data(nsteps, batch, seed=13, uint8=True)
+    params = init_mlp(jax.random.key(0))
+    p1, l1 = epoch_fused_sgd(params, x, y, 42, 0.01, batch)
+    p8, l8 = epoch_fused_sgd(params, x, y, 42, 0.01, batch,
+                             steps_per_iter=8)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l8))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p8)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
