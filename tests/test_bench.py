@@ -181,6 +181,25 @@ def test_backend_slow_init_is_not_misclassified_as_hang(monkeypatch):
     assert devs == ["late-device"]
 
 
+def test_hang_timeout_env_override(monkeypatch):
+    """PDMT_HANG_TIMEOUT feeds wait_for_backend's default hang bound (the
+    knob for backends whose legitimate cold init is slower than 75 s)."""
+    import time
+
+    import jax
+    import pytest
+    from pytorch_ddp_mnist_tpu.parallel import wireup
+
+    monkeypatch.setenv("PDMT_HANG_TIMEOUT", "0.05")
+    monkeypatch.setattr(jax, "devices", lambda: time.sleep(5))
+    monkeypatch.setattr(wireup, "_subprocess_backend_healthy",
+                        lambda timeout_s: False)
+    t0 = time.monotonic()
+    with pytest.raises(wireup.BackendUnavailableError, match="hung"):
+        wireup.wait_for_backend(max_wait_s=0.3, poll_s=0.01)  # no explicit
+    assert time.monotonic() - t0 < 5.0  # 75s default would blow this bound
+
+
 def test_backend_hang_then_recovery_raises_wedged(monkeypatch):
     """Hang + tunnel recovery = BackendWedgedError (the in-process client
     can never use the recovered backend: init lock held by the hung probe)."""
