@@ -36,3 +36,25 @@ else:
         clear_backends()
     except Exception:
         pass
+
+
+if os.environ.get("PDMT_TPU_TESTS") == "1":
+    # Hardware-mode watchdog: the tunneled backend can HANG mid-test (a
+    # device sync that never returns — see parallel/wireup.py's hang-mode
+    # notes), and a blocked C call is immune to pytest/SIGALRM. Arm a
+    # faulthandler watchdog per test: if one test exceeds the bound, dump
+    # every thread's traceback and hard-exit, so a wrapping `timeout`/script
+    # sees the failure in minutes instead of losing the whole hardware
+    # window. Bound via PDMT_TPU_TEST_TIMEOUT (seconds, default 600 —
+    # generous for first-compile variance).
+    import faulthandler
+
+    import pytest
+
+    _TEST_TIMEOUT = float(os.environ.get("PDMT_TPU_TEST_TIMEOUT", "600"))
+
+    @pytest.fixture(autouse=True)
+    def _tpu_test_watchdog():
+        faulthandler.dump_traceback_later(_TEST_TIMEOUT, exit=True)
+        yield
+        faulthandler.cancel_dump_traceback_later()
