@@ -88,15 +88,33 @@ _KEEP_THRESH = int(round((1.0 - DROPOUT_RATE) * 2**32))
 EPOCH_KERNEL_MAX_BATCH = 1024
 
 # DP epoch kernel: the gradient comm buffer packs every grad tensor into one
-# (EPOCH_COMM_ROWS, 128) f32 block — gw1 rows [0,784), gb1 [784], gw2
-# [785,913), gb2 [913], gw3 [914,1042).
-EPOCH_COMM_ROWS = IN_DIM + 1 + HIDDEN2 + 1 + PADDED_CLASSES   # 1042
+# (EPOCH_COMM_ROWS, 128) f32 block. (row offset, rows) per tensor, in pack
+# order gw1, gb1, gw2, gb2, gw3 — the ONE place the packed layout lives
+# (pack and unpack in both ring strategies iterate this table).
+_COMM_LAYOUT = (
+    (0, IN_DIM),                       # gw1 rows [0, 784)
+    (IN_DIM, 1),                       # gb1 [784]
+    (IN_DIM + 1, HIDDEN2),             # gw2 [785, 913)
+    (IN_DIM + 1 + HIDDEN2, 1),         # gb2 [913]
+    (IN_DIM + 2 + HIDDEN2, PADDED_CLASSES),   # gw3 [914, 1042)
+)
+EPOCH_COMM_ROWS = _COMM_LAYOUT[-1][0] + _COMM_LAYOUT[-1][1]   # 1042
 # The ring all-gather keeps one comm slot PER DEVICE in VMEM (n x 533 KB) so
 # every replica can sum contributions in the same fixed order (bitwise-
 # identical averaged grads -> weights stay in lockstep without a broadcast).
 # 8 slots ≈ 4.3 MB next to the resident weights and batch blocks; past that
-# the design owes a reduce-scatter ring instead (documented in docs/PERF.md).
+# the DP epoch kernel switches to the reduce-scatter ring below (~2 gradient
+# blocks of VMEM plus an 8-rows-per-device tile-floor term — ~1.1 MB at n=8,
+# ~+8 KB per extra device: one flat grad buffer + n-1 chunk recv slots).
 EPOCH_KERNEL_MAX_DEVICES = 8
+
+
+def _rs_chunk_rows(n: int) -> int:
+    """Reduce-scatter ring chunk height: EPOCH_COMM_ROWS split n ways,
+    rounded up to the f32 sublane tile (8 rows) so every remote DMA and
+    dynamic slice stays tile-aligned. n * chunk >= EPOCH_COMM_ROWS; the
+    alignment tail is zeroed at pack time and discarded at unpack."""
+    return _round_up(-(-EPOCH_COMM_ROWS // n), 8)
 
 
 def _make_fused_kernel(total_batch: int, block: int,
@@ -345,7 +363,8 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
                        uint8_in: bool = False, axis_name: str | None = None,
                        n_devices: int = 1, compute_bf16: bool = False,
                        steps_per_iter: int = 1,
-                       nsteps_total: int | None = None):
+                       nsteps_total: int | None = None,
+                       ring_rs: bool = False):
     """Whole-EPOCH kernel: grid = (nsteps,), one SGD step per grid iteration,
     weights VMEM-RESIDENT for the entire epoch.
 
@@ -381,6 +400,17 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
     slot reuse, then n-1 pipelined hops forward origin-indexed slots around
     the ring (per-hop DMA semaphores — no cross-hop signal conflation).
 
+    `ring_rs=True`: the same per-step allreduce as a reduce-scatter +
+    all-gather ring instead — 2(n-1) hops of one EPOCH_COMM_ROWS/n chunk
+    each, so per-device ICI traffic drops from (n-1) to ~2 full gradient
+    blocks and VMEM stays ~2 gradient blocks plus an 8-rows-per-device
+    tile-floor term (the all-gather ring's n origin
+    slots don't fit past EPOCH_KERNEL_MAX_DEVICES). Each chunk is reduced
+    sequentially along the ring by a single chain (one final owner), then
+    the finished chunks are re-broadcast — every device receives identical
+    bytes, so the resident weights stay in lockstep exactly as in the
+    fixed-order-sum ring.
+
     `compute_bf16=True`: the six matmuls take bfloat16 operands (f32 MXU
     accumulation via preferred_element_type) while everything else — master
     weights, SGD update, softmax/CE, dropout, gradients — stays float32.
@@ -404,7 +434,11 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
     mm_dt = jnp.bfloat16 if compute_bf16 else jnp.float32
 
     def kernel(*refs):
-        if dp:
+        if dp and ring_rs:
+            (x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
+             loss_ref, ow1, ob1, ow2, ob2, ow3,
+             comm, rsbuf, send_sems, recv_sems, lsem, rsem) = refs
+        elif dp:
             (x_ref, y_ref, m_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref,
              loss_ref, ow1, ob1, ow2, ob2, ow3,
              comm, send_sems, recv_sems, lsem, rsem) = refs
@@ -556,50 +590,119 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
                                            device_id_type=did)
                     pltpu.semaphore_wait(bsem, 2)
 
-                # Pack this replica's grads into its origin-indexed comm slot.
-                comm[me, pl.ds(0, IN_DIM), :] = gw1
-                comm[me, pl.ds(IN_DIM, 1), :] = gb1
-                comm[me, pl.ds(IN_DIM + 1, HIDDEN2), :] = gw2
-                comm[me, pl.ds(IN_DIM + 1 + HIDDEN2, 1), :] = gb2
-                comm[me, pl.ds(IN_DIM + 2 + HIDDEN2, PADDED_CLASSES), :] = gw3
-                # Per-step neighbor handshake: my hop-0 send overwrites a slot on
-                # `right` that its PREVIOUS step read during the fixed-order sum,
-                # so I must not send until both neighbors have finished their
-                # previous step. Dedicated per-neighbor semaphores (I signal
-                # right's lsem as its left neighbor, and vice versa) — a shared
-                # counter could conflate one neighbor running two steps ahead.
-                pltpu.semaphore_signal(lsem, inc=1, device_id=(right,),
-                                       device_id_type=did)
-                pltpu.semaphore_signal(rsem, inc=1, device_id=(left,),
-                                       device_id_type=did)
-                pltpu.semaphore_wait(lsem, 1)
-                pltpu.semaphore_wait(rsem, 1)
-                # Ring all-gather: hop h forwards the slot received at hop h-1
-                # (hop 0: my own) to the right; slots keep their ORIGIN index on
-                # every device. Per-hop DMA semaphores so an out-of-order arrival
-                # of hop h+1's signal can never satisfy hop h's wait.
-                for h in range(n - 1):
-                    send_slot = jax.lax.rem(me - h + n * 2, n)
-                    rdma = pltpu.make_async_remote_copy(
-                        src_ref=comm.at[send_slot],
-                        dst_ref=comm.at[send_slot],
-                        send_sem=send_sems.at[h],
-                        recv_sem=recv_sems.at[h],
-                        device_id=(right,), device_id_type=did)
-                    rdma.start()
-                    rdma.wait()   # my send done AND my hop-h chunk arrived
-                # Fixed-order sum over origin slots: every replica reduces in the
-                # identical order -> bitwise-identical mean grads on all chips ->
-                # the resident weights stay in lockstep with no broadcast.
-                tot = comm[0]
-                for d in range(1, n):
-                    tot = tot + comm[d]
-                g = tot * f32(1.0 / n)
-                gw1 = g[0:IN_DIM]
-                gb1 = g[IN_DIM:IN_DIM + 1]
-                gw2 = g[IN_DIM + 1:IN_DIM + 1 + HIDDEN2]
-                gb2 = g[IN_DIM + 1 + HIDDEN2:IN_DIM + 2 + HIDDEN2]
-                gw3 = g[IN_DIM + 2 + HIDDEN2:]
+                def _neighbor_handshake():
+                    # Per-step neighbor handshake: my hop-0 send overwrites
+                    # scratch on `right` that its PREVIOUS step last read, so
+                    # I must not send until both neighbors have finished that
+                    # step. Dedicated per-neighbor semaphores (I signal
+                    # right's lsem as its left neighbor, and vice versa) — a
+                    # shared counter could conflate one neighbor running two
+                    # steps ahead. Shared by both ring strategies.
+                    pltpu.semaphore_signal(lsem, inc=1, device_id=(right,),
+                                           device_id_type=did)
+                    pltpu.semaphore_signal(rsem, inc=1, device_id=(left,),
+                                           device_id_type=did)
+                    pltpu.semaphore_wait(lsem, 1)
+                    pltpu.semaphore_wait(rsem, 1)
+
+                if ring_rs:
+                    # Reduce-scatter + all-gather ring: one flat padded grad
+                    # buffer + n-1 chunk recv slots, vs the all-gather
+                    # ring's n full origin slots — ~2 gradient blocks plus
+                    # an 8-rows-per-device tile-floor term (see
+                    # _rs_chunk_rows), i.e. ~1.1 MB at n=8 growing only
+                    # ~8 KB per extra device. Chunk c is
+                    # reduced SEQUENTIALLY along the ring by a single chain
+                    # ending at device (c-1) mod n, then the finished chunks
+                    # are re-broadcast — every device receives the same final
+                    # bytes for every chunk, so the resident weights stay in
+                    # bitwise lockstep (the per-chunk accumulation order
+                    # differs from the all-gather ring's origin order; each
+                    # is one valid float summation order).
+                    C = _rs_chunk_rows(n)
+                    total = n * C
+                    # Pack this step's grads flat; zero the alignment tail
+                    # (summed garbage would be discarded anyway, but scratch
+                    # VMEM starts undefined and NaNs must never enter sums).
+                    for (off, rows), grad in zip(
+                            _COMM_LAYOUT, (gw1, gb1, gw2, gb2, gw3)):
+                        comm[pl.ds(off, rows), :] = grad
+                    comm[pl.ds(EPOCH_COMM_ROWS, total - EPOCH_COMM_ROWS),
+                         :] = jnp.zeros((total - EPOCH_COMM_ROWS, 128), f32)
+                    _neighbor_handshake()
+                    # Phase 1 — reduce-scatter: hop h sends partial chunk
+                    # (me-h) right, into the hop's DEDICATED recv slot
+                    # (written once per step — reuse fenced by the entry
+                    # handshake), then folds the arriving chunk (me-h-1)
+                    # into the local partial it forwards next hop. After
+                    # n-1 hops this device owns reduced chunk (me+1) mod n.
+                    for h in range(n - 1):
+                        send_c = jax.lax.rem(me - h + 2 * n, n)
+                        rdma = pltpu.make_async_remote_copy(
+                            src_ref=comm.at[pl.ds(send_c * C, C)],
+                            dst_ref=rsbuf.at[h],
+                            send_sem=send_sems.at[h],
+                            recv_sem=recv_sems.at[h],
+                            device_id=(right,), device_id_type=did)
+                        rdma.start()
+                        rdma.wait()   # my send done AND left's chunk landed
+                        add_c = jax.lax.rem(me - h - 1 + 2 * n, n)
+                        comm[pl.ds(add_c * C, C), :] = (
+                            comm[pl.ds(add_c * C, C), :] + rsbuf[h])
+                    # Phase 2 — all-gather of reduced chunks: hop a forwards
+                    # the chunk finished at hop a-1 (hop 0: my own) into the
+                    # SAME chunk position on the right neighbor. Each
+                    # position takes exactly one incoming write per step,
+                    # and chunk c's reduction chain passed through this
+                    # device's phase-1 hop that last read comm[c] — the
+                    # incoming write is transitively ordered after it, so
+                    # the per-hop DMA semaphores are the only fence needed.
+                    for a in range(n - 1):
+                        send_c = jax.lax.rem(me + 1 - a + 2 * n, n)
+                        rdma = pltpu.make_async_remote_copy(
+                            src_ref=comm.at[pl.ds(send_c * C, C)],
+                            dst_ref=comm.at[pl.ds(send_c * C, C)],
+                            send_sem=send_sems.at[n - 1 + a],
+                            recv_sem=recv_sems.at[n - 1 + a],
+                            device_id=(right,), device_id_type=did)
+                        rdma.start()
+                        rdma.wait()
+                    scale = f32(1.0 / n)
+                    gw1, gb1, gw2, gb2, gw3 = (
+                        comm[pl.ds(off, rows), :] * scale
+                        for off, rows in _COMM_LAYOUT)
+                else:
+                    # Pack this replica's grads into its origin-indexed comm
+                    # slot.
+                    for (off, rows), grad in zip(
+                            _COMM_LAYOUT, (gw1, gb1, gw2, gb2, gw3)):
+                        comm[me, pl.ds(off, rows), :] = grad
+                    _neighbor_handshake()
+                    # Ring all-gather: hop h forwards the slot received at
+                    # hop h-1 (hop 0: my own) to the right; slots keep their
+                    # ORIGIN index on every device. Per-hop DMA semaphores so
+                    # an out-of-order arrival of hop h+1's signal can never
+                    # satisfy hop h's wait.
+                    for h in range(n - 1):
+                        send_slot = jax.lax.rem(me - h + n * 2, n)
+                        rdma = pltpu.make_async_remote_copy(
+                            src_ref=comm.at[send_slot],
+                            dst_ref=comm.at[send_slot],
+                            send_sem=send_sems.at[h],
+                            recv_sem=recv_sems.at[h],
+                            device_id=(right,), device_id_type=did)
+                        rdma.start()
+                        rdma.wait()   # send done AND my hop-h chunk arrived
+                    # Fixed-order sum over origin slots: every replica
+                    # reduces in the identical order -> bitwise-identical
+                    # mean grads on all chips -> the resident weights stay
+                    # in lockstep with no broadcast.
+                    tot = comm[0]
+                    for d in range(1, n):
+                        tot = tot + comm[d]
+                    g = tot * f32(1.0 / n)
+                    gw1, gb1, gw2, gb2, gw3 = (
+                        g[off:off + rows] for off, rows in _COMM_LAYOUT)
 
             ow1[:] -= lr_k * gw1
             ob1[:] -= lr_k * gb1
@@ -616,7 +719,7 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
                     masks=None, interpret: bool = False,
                     axis_name: str | None = None, axis_size: int = 1,
                     compute_bf16: bool = False, steps_per_iter: int = 1,
-                    valid_steps: int | None = None):
+                    valid_steps: int | None = None, ring: str = "auto"):
     """One ENTIRE epoch as a single kernel (`--kernel pallas_epoch`):
     (params, xp (S*B, 784) pre-gathered epoch rows, yp (S*B,) int32,
     seed () int32, lr, batch=B) -> (params', losses (S,)).
@@ -649,6 +752,15 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     every replica. EXPERIMENTAL: CI-covered via the n=1 degenerate + named
     errors; the ring itself needs real multi-chip hardware to execute, which
     this session does not have.
+
+    `ring` selects the allreduce strategy: 'allgather' (n full origin slots
+    in VMEM, one fixed-order sum per replica — n <= EPOCH_KERNEL_MAX_DEVICES
+    only), 'reduce_scatter' (2(n-1) chunk hops, VMEM and per-device ICI
+    traffic near-constant in n — any ring size), or 'auto' (allgather up to
+    the
+    slot budget, reduce_scatter beyond it). Both keep the resident weights
+    in bitwise lockstep across replicas; their float summation orders
+    differ, so cross-strategy results may differ by rounding.
 
     `steps_per_iter=K` (K in {1,2,4,8}; single-replica only): K sequential
     SGD steps per grid iteration streaming one (K*B, ...) input block —
@@ -691,13 +803,19 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             "the DP epoch kernel's ICI ring allreduce (remote DMAs + "
             "cross-chip semaphores) has no interpreter lowering; interpret "
             "the n=1 degenerate or use kernel='pallas' for interpreted DP")
-    if axis_size > EPOCH_KERNEL_MAX_DEVICES:
+    if ring not in ("auto", "allgather", "reduce_scatter"):
+        raise ValueError(f"ring must be 'auto', 'allgather' or "
+                         f"'reduce_scatter'; got {ring!r}")
+    if dp and ring == "auto":
+        ring = ("allgather" if axis_size <= EPOCH_KERNEL_MAX_DEVICES
+                else "reduce_scatter")
+    if dp and ring == "allgather" and axis_size > EPOCH_KERNEL_MAX_DEVICES:
         raise ValueError(
-            f"pallas_epoch DP keeps one {EPOCH_COMM_ROWS}x128 f32 comm slot "
-            f"per replica in VMEM for the fixed-order ring sum; "
+            f"ring='allgather' keeps one {EPOCH_COMM_ROWS}x128 f32 comm "
+            f"slot per replica in VMEM for the fixed-order ring sum; "
             f"{axis_size} replicas > {EPOCH_KERNEL_MAX_DEVICES} exceeds the "
-            f"budget. Use the per-step kernel (--kernel pallas) on larger "
-            f"meshes")
+            f"budget. Use ring='reduce_scatter' (constant VMEM; the 'auto' "
+            f"default) on larger meshes")
     K = steps_per_iter
     if K not in (1, 2, 4, 8):
         raise ValueError(
@@ -756,7 +874,20 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     )
     nblocks8 = -(-padded_steps // 8)
     out_shapes = (jax.ShapeDtypeStruct((nblocks8 * 8, 128), f32),) + w_shapes
-    if dp:
+    if dp and ring == "reduce_scatter":
+        C = _rs_chunk_rows(axis_size)
+        scratch_shapes = [
+            pltpu.VMEM((axis_size * C, 128), f32),       # flat padded grads
+            pltpu.VMEM((axis_size - 1, C, 128), f32),    # per-hop recv slots
+            pltpu.SemaphoreType.DMA((2 * (axis_size - 1),)),  # send: RS+AG
+            pltpu.SemaphoreType.DMA((2 * (axis_size - 1),)),  # recv: RS+AG
+            pltpu.SemaphoreType.REGULAR,                 # left ready
+            pltpu.SemaphoreType.REGULAR,                 # right ready
+        ]
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            collective_id=7, has_side_effects=True)
+    elif dp:
         scratch_shapes = [
             pltpu.VMEM((axis_size, EPOCH_COMM_ROWS, 128), f32),  # ring slots
             pltpu.SemaphoreType.DMA((axis_size - 1,)),           # send, /hop
@@ -778,7 +909,8 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
                            steps_per_iter=K,
                            nsteps_total=(valid_steps
                                          if padded_steps != valid_steps
-                                         else None)),
+                                         else None),
+                           ring_rs=dp and ring == "reduce_scatter"),
         grid=(grid_n,),
         compiler_params=compiler_params,
         scratch_shapes=scratch_shapes,

@@ -393,12 +393,9 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                 "kernel 'pallas_epoch' on a multi-device mesh uses ICI "
                 "remote DMAs with no interpreter lowering; interpret the "
                 "1-device mesh or use kernel='pallas' for interpreted DP")
-        from ..ops.pallas_step import EPOCH_KERNEL_MAX_DEVICES
-        if n_dev > EPOCH_KERNEL_MAX_DEVICES:
-            raise ValueError(
-                f"kernel 'pallas_epoch' rings grads through one VMEM slot "
-                f"per replica; mesh has {n_dev} devices > "
-                f"{EPOCH_KERNEL_MAX_DEVICES}. Use kernel='pallas'")
+        # No mesh-size cap: epoch_fused_sgd's ring='auto' picks the
+        # all-gather ring up to EPOCH_KERNEL_MAX_DEVICES replicas and the
+        # near-constant-VMEM reduce-scatter ring beyond it.
         if superstep != 1 and n_dev > 1:
             raise ValueError(
                 f"superstep={superstep} is single-replica only (the DP "

@@ -5,6 +5,8 @@ so every comparison here is exact-math parity with the jit'd reference
 implementation — the same verification the TPU compile gets, minus Mosaic.
 """
 
+import os
+
 import pytest
 import numpy as np
 import jax
@@ -394,9 +396,16 @@ def test_epoch_kernel_dp_named_errors():
         make_run_fn(lr=0.01, kernel="pallas_epoch", unroll=4)
     params = init_mlp(jax.random.key(0))
     x, y = _data(16)
-    with pytest.raises(ValueError, match=str(EPOCH_KERNEL_MAX_DEVICES)):
+    # past the all-gather slot budget, ring='auto' switches to the
+    # reduce-scatter ring instead of raising; forcing 'allgather' there is
+    # the named error
+    with pytest.raises(ValueError, match="reduce_scatter"):
         epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_name="dp",
-                        axis_size=EPOCH_KERNEL_MAX_DEVICES + 1)
+                        axis_size=EPOCH_KERNEL_MAX_DEVICES + 1,
+                        ring="allgather")
+    with pytest.raises(ValueError, match="ring"):
+        epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_name="dp",
+                        axis_size=2, ring="tree")
     with pytest.raises(ValueError, match="axis_name"):
         epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_size=2)
 
@@ -429,6 +438,114 @@ def test_epoch_kernel_ring_slot_schedule_algebra(n):
     for d in range(n):
         assert held[d] == set(range(n))        # all-gather complete
         assert len(writes[d]) == len(set(writes[d])) == n - 1  # 1 write/slot
+
+
+@pytest.mark.parametrize("n", [2, 3, 9, 16])
+def test_epoch_kernel_rs_ring_schedule_algebra(n):
+    """Pure simulation of the reduce-scatter + all-gather ring's schedule —
+    the exact index formulas of _make_epoch_kernel's ring_rs branch (RS hop
+    h: send partial chunk (me-h) right, fold arriving chunk (me-h-1); AG hop
+    a: forward reduced chunk (me+1-a) right, into the same position). Pinned
+    here because the multi-chip ring cannot execute in a 1-chip session:
+    every fold matches what the left neighbor just sent, each per-hop recv
+    slot and each AG position is written exactly once per step, a device
+    only ever forwards a reduced chunk it already holds, and the final
+    buffer is byte-identical on every device (the lockstep-weights
+    invariant) and equals the mean."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        EPOCH_COMM_ROWS, _rs_chunk_rows)
+    C = _rs_chunk_rows(n)
+    assert C % 8 == 0 and n * C >= EPOCH_COMM_ROWS
+    rng = np.random.default_rng(n)
+    grads = rng.normal(size=(n, n * C)).astype(np.float32)
+    grads[:, EPOCH_COMM_ROWS:] = 0.0           # pack zeroes the tail
+    acc = grads.copy()                          # per-device comm buffer
+    # phase 1 — reduce-scatter (hop-synchronous sim; snapshot the sends so
+    # simulation order can't leak a neighbor's same-hop fold)
+    for h in range(n - 1):
+        sent = {}
+        for me in range(n):
+            send_c = (me - h) % n
+            if h > 0:   # forwards exactly the chunk folded the hop before
+                assert send_c == (me - (h - 1) - 1) % n
+            sent[(me + 1) % n] = acc[me, send_c * C:(send_c + 1) * C].copy()
+        for me in range(n):
+            add_c = (me - h - 1) % n
+            # the arriving chunk IS the one my left neighbor just sent
+            assert add_c == ((me - 1) % n - h) % n
+            # kernel folds local + incoming, in that order
+            acc[me, add_c * C:(add_c + 1) * C] = (
+                acc[me, add_c * C:(add_c + 1) * C] + sent[me])
+    # each device owns the fully reduced chunk (me+1) mod n: bitwise equal
+    # to the single sequential chain starting at the chunk's origin device
+    for me in range(n):
+        c = (me + 1) % n
+        chain = grads[c, c * C:(c + 1) * C]
+        for k in range(1, n):
+            chain = grads[(c + k) % n, c * C:(c + 1) * C] + chain
+        np.testing.assert_array_equal(acc[me, c * C:(c + 1) * C], chain)
+    # phase 2 — all-gather of reduced chunks
+    final = {me: {(me + 1) % n} for me in range(n)}
+    for a in range(n - 1):
+        sent = {}
+        for me in range(n):
+            send_c = (me + 1 - a) % n
+            assert send_c in final[me], "forwarded a non-final chunk"
+            sent[(me + 1) % n] = (
+                send_c, acc[me, send_c * C:(send_c + 1) * C].copy())
+        for me in range(n):
+            c, val = sent[me]
+            assert c == (me - a) % n
+            assert c not in final[me], "AG position written twice"
+            final[me].add(c)
+            acc[me, c * C:(c + 1) * C] = val
+    for me in range(n):
+        assert final[me] == set(range(n))       # every chunk delivered
+        np.testing.assert_array_equal(acc[me], acc[0])   # lockstep bytes
+    np.testing.assert_allclose(acc[0][:EPOCH_COMM_ROWS] / n,
+                               grads.mean(0)[:EPOCH_COMM_ROWS],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_epoch_kernel_dp_16dev_rs_program_traces():
+    """Past EPOCH_KERNEL_MAX_DEVICES the DP epoch program resolves to the
+    reduce-scatter ring (ring='auto') and must still trace cleanly — shapes,
+    shard_map specs, the chunked-ring scratch structure. 16 virtual devices
+    need their own XLA client, so the trace runs in a subprocess."""
+    import subprocess
+    import sys
+    script = (
+        "import jax, jax.numpy as jnp\n"
+        # honor JAX_PLATFORMS=cpu BEFORE the first backend query: the
+        # session's pre-registered tunneled-TPU backend can hang a bare
+        # jax.devices() when the tunnel is down (wireup.py hang-mode notes)
+        "from pytorch_ddp_mnist_tpu.parallel.wireup import "
+        "_honor_platform_env\n"
+        "_honor_platform_env()\n"
+        "from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh\n"
+        "from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn\n"
+        "from pytorch_ddp_mnist_tpu.models import init_mlp\n"
+        "n = 16\n"
+        "mesh = make_mesh([n], ['dp'], jax.devices()[:n])\n"
+        "run = make_dp_run_fn(mesh, lr=0.01, kernel='pallas_epoch')\n"
+        "params = init_mlp(jax.random.key(0))\n"
+        "b = 16 * n\n"
+        "out = jax.eval_shape(run, params, jax.random.key(1),\n"
+        "    jax.ShapeDtypeStruct((2 * b, 784), jnp.uint8),\n"
+        "    jax.ShapeDtypeStruct((2 * b,), jnp.int32),\n"
+        "    jax.ShapeDtypeStruct((1, 2, b), jnp.int32))\n"
+        "assert out[2].shape == (1, 2), out[2].shape\n"
+        "print('TRACED-OK')\n")
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=16",
+               JAX_PLATFORMS="cpu")
+    env.pop("PDMT_TPU_TESTS", None)
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=300,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "TRACED-OK" in out.stdout
 
 
 def test_epoch_kernel_dp_8dev_program_traces():
