@@ -68,6 +68,18 @@ def make_train_step(lr: float) -> Callable:
     return step
 
 
+def _eval_math(params, x, y):
+    """Per-sample test-set forward: (params, x (n,784), y (n,)) ->
+    (per_sample_loss, correct), both (n,) float32. Dropout off, exactly the
+    reference eval pass (ddp_tutorial_multi_gpu.py:101-114)."""
+    logits = mlp_apply(params, x, train=False)
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    per_sample = -jnp.take_along_axis(
+        logz, y[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+    return per_sample, correct
+
+
 def make_eval_step() -> Callable:
     """Jitted whole-test-set eval: (params, x, y) -> (per_sample_loss,
     correct), both (n,) float32.
@@ -80,14 +92,24 @@ def make_eval_step() -> Callable:
     Per-sample values come back so the caller can aggregate in any batch
     segmentation it wants.
     """
+    return jax.jit(_eval_math)
+
+
+def make_snapshot_eval_step() -> Callable:
+    """Jitted eval over STACKED per-epoch params snapshots: (p_snaps with an
+    (E, ...) leading axis on every leaf, x, y) -> (per_sample (E, n),
+    correct (E, n)).
+
+    The fused trainer (`fit_cached(fused=True)`) replays per-epoch val lines
+    from snapshots AFTER the one-program training run; evaluating them one
+    jit call at a time would reintroduce E dispatch round-trips — a full
+    tunnel RTT each on a remote TPU, easily dwarfing the fused run itself.
+    vmap over the epoch axis makes the whole replay ONE program and ONE
+    fetch (E x 10k x 784 stays a trivially small batched matmul chain).
+    """
     @jax.jit
-    def step(params, x, y):
-        logits = mlp_apply(params, x, train=False)
-        logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        per_sample = -jnp.take_along_axis(
-            logz, y[:, None].astype(jnp.int32), axis=-1)[:, 0]
-        correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
-        return per_sample, correct
+    def step(p_snaps, x, y):
+        return jax.vmap(lambda p: _eval_math(p, x, y))(p_snaps)
 
     return step
 
@@ -103,19 +125,27 @@ def evaluate(eval_step, params, x_test, y_test, batch_size: int):
     The reference shuffles its test loader, so the ref-unit's exact value is
     RNG-dependent there; deterministic sequential order is used here.
     """
-    n = x_test.shape[0]
     # jnp.asarray is a no-op for device-resident arrays; fit() hoists the
     # test set to device ONCE so repeated evaluate() calls do no H2D.
     per_sample, correct = eval_step(
         params, jnp.asarray(x_test), jnp.asarray(y_test))
-    per_sample = np.asarray(per_sample, np.float64)   # one host fetch
-    correct = np.asarray(correct)
+    return val_summary(per_sample, correct, batch_size)   # fetch + aggregate
+
+
+def val_summary(per_sample: np.ndarray, correct: np.ndarray,
+                batch_size: int):
+    """Host-side aggregation of fetched per-sample eval values into
+    evaluate()'s (val_loss_ref_unit, mean_loss, acc) triple — shared by the
+    per-epoch path and the fused snapshot-eval replay so the printed units
+    can never drift between them."""
+    n = per_sample.shape[0]
+    per_sample = np.asarray(per_sample, np.float64)
     val_loss_ref_unit = 0.0
     for start in range(0, n, batch_size):
         b = min(batch_size, n - start)
         val_loss_ref_unit += per_sample[start:start + b].mean() / b
     return (float(val_loss_ref_unit), float(per_sample.mean()),
-            float(correct.mean()))
+            float(np.asarray(correct).mean()))
 
 
 def epoch_summary(epoch: int, losses: np.ndarray, batch_size: int,

@@ -43,7 +43,8 @@ from ..ops.loss import cross_entropy
 from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
-from .loop import TrainState, epoch_summary, evaluate, make_eval_step
+from .loop import (TrainState, epoch_summary, evaluate, make_eval_step,
+                   make_snapshot_eval_step, val_summary)
 
 
 def _gathered_x(x_all, batch_idx, compute_dt):
@@ -509,7 +510,6 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             lr, dtype=dtype, kernel=kernel, interpret=interpret)
         idx_sharding = None
 
-    eval_step = make_eval_step()
     # Test set to device once, not per epoch (mirrors loop.fit's hoist).
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
@@ -538,9 +538,15 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             params, key, x_all, y_all, idxs)
         losses = np.asarray(losses)                      # sync: run finished
         per_epoch_dt = (time.perf_counter() - t0) / epochs
+        # Replay ALL epochs' val lines from one vmapped eval program + one
+        # fetch — per-epoch evaluate() calls here would cost E dispatch
+        # round-trips (a full tunnel RTT each on a remote TPU).
+        ps_all, corr_all = make_snapshot_eval_step()(
+            p_snaps, x_test_dev, y_test_dev)
+        ps_all, corr_all = np.asarray(ps_all), np.asarray(corr_all)
         for epoch in range(epochs):
             p_e = jax.tree_util.tree_map(lambda a, _e=epoch: a[_e], p_snaps)
-            val = evaluate(eval_step, p_e, x_test_dev, y_test_dev, batch_size)
+            val = val_summary(ps_all[epoch], corr_all[epoch], batch_size)
             log(epoch_summary(epoch, losses[epoch], batch_size, val,
                               per_epoch_dt))
             if epoch_hook is not None:
@@ -550,6 +556,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 epoch_hook(epoch, TrainState(p_e, k_snaps[epoch]))
         return TrainState(params, key)
 
+    eval_step = make_eval_step()
     for epoch in range(epochs):
         t0 = time.perf_counter()
         sampler.set_epoch(epoch)

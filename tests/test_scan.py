@@ -21,6 +21,25 @@ def _data(n_train=512, n_test=128):
             normalize_images(test.images), test.labels.astype(np.int32))
 
 
+def test_snapshot_eval_matches_per_epoch_eval():
+    """make_snapshot_eval_step — ONE vmapped program replaying every
+    epoch's eval (the fused trainer's path, killing E dispatch round-trips)
+    — must reproduce make_eval_step + evaluate's per-epoch triples."""
+    from pytorch_ddp_mnist_tpu.train.loop import (
+        evaluate, make_eval_step, make_snapshot_eval_step, val_summary)
+    _, _, xt, yt = _data()
+    snaps = [init_mlp(jax.random.key(s)) for s in range(3)]
+    p_snaps = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *snaps)
+    ps_all, corr_all = make_snapshot_eval_step()(
+        p_snaps, jnp.asarray(xt), jnp.asarray(yt))
+    ps_all, corr_all = np.asarray(ps_all), np.asarray(corr_all)
+    es = make_eval_step()
+    for e, p in enumerate(snaps):
+        ref = evaluate(es, p, xt, yt, batch_size=48)   # ragged last batch
+        got = val_summary(ps_all[e], corr_all[e], batch_size=48)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
 def test_epoch_batch_indices_match_loader():
     x, y, *_ = _data()
     s = ShardedSampler(512, num_replicas=2, rank=1)
