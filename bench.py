@@ -141,6 +141,12 @@ def main(argv=None) -> None:
                    help="whole-epoch kernel only: K SGD sub-steps per grid "
                         "iteration (identical math; amortizes per-iteration "
                         "cost). Rejected by name on per-step kernels")
+    p.add_argument("--ring", choices=("auto", "allgather", "reduce_scatter"),
+                   default="auto",
+                   help="DP epoch kernel only: in-kernel allreduce strategy "
+                        "(auto: all-gather ring to 8 replicas, "
+                        "reduce-scatter ring beyond). Rejected by name "
+                        "elsewhere")
     p.add_argument("--unroll", type=int, default=1,
                    help="unroll factor for the per-step scan; measured "
                         "SLOWER than 1 at 2/4/8 (docs/PERF.md) — kept for "
@@ -247,6 +253,11 @@ def main(argv=None) -> None:
         p.error(f"--superstep {a.superstep} is a whole-epoch-kernel knob; "
                 f"the resolved kernel is {a.kernel!r} (use --kernel "
                 f"pallas_epoch, or drop --superstep)")
+    if a.ring != "auto" and (a.kernel != "pallas_epoch" or n_chips == 1):
+        p.error(f"--ring {a.ring} selects the DP epoch kernel's in-kernel "
+                f"allreduce strategy; it needs --kernel pallas_epoch on a "
+                f"multi-chip mesh (resolved kernel {a.kernel!r}, "
+                f"{n_chips} chip(s))")
     interpret = a.kernel == "pallas" and not on_tpu
     if a.kernel == "pallas_epoch" and n_chips == 1:
         # Whole-epoch kernel on the 1-chip mesh: the serial program IS the
@@ -265,7 +276,8 @@ def main(argv=None) -> None:
                   file=sys.stderr, flush=True)
         run_fn = make_dp_run_fn(mesh, lr=0.01, dtype=a.dtype,
                                 kernel=a.kernel, interpret=interpret,
-                                unroll=a.unroll, superstep=a.superstep)
+                                unroll=a.unroll, superstep=a.superstep,
+                                ring=a.ring)
     params_host = jax.tree_util.tree_map(np.asarray, init_mlp(jax.random.key(0)))
     key_host = np.asarray(jax.random.key_data(
         jax.random.key(1, impl=a.impl)))

@@ -124,6 +124,24 @@ def _check_superstep(superstep: int, kernel: str) -> None:
             f"inside one 8-row loss tile); got {superstep}")
 
 
+def _check_ring(ring: str, kernel: str, n_dev: int) -> None:
+    """`ring` selects the DP epoch kernel's in-kernel allreduce strategy;
+    reject it by name anywhere it would be a silent no-op (the unroll
+    lesson, ADVICE r2) — a caller forcing 'reduce_scatter' on a kernel or
+    mesh that never reaches the ring would otherwise silently measure the
+    wrong program. epoch_fused_sgd re-validates on the path that uses it."""
+    if ring not in ("auto", "allgather", "reduce_scatter"):
+        raise ValueError(f"ring must be 'auto', 'allgather' or "
+                         f"'reduce_scatter'; got {ring!r}")
+    if ring == "auto":
+        return
+    if kernel != "pallas_epoch" or n_dev == 1:
+        raise ValueError(
+            f"ring={ring!r} selects the DP epoch kernel's in-kernel "
+            f"allreduce strategy; it needs kernel='pallas_epoch' on a "
+            f"multi-device mesh (got kernel={kernel!r}, {n_dev} device(s))")
+
+
 def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):
     """Per-step fwd+bwd: XLA autodiff or the fused Pallas kernel. 'pallas'
     draws the dropout mask from the same bernoulli stream as 'xla' for the
@@ -172,7 +190,8 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
                        pmean_axis: str | None = None,
                        axis_size: int = 1,
                        compute_bf16: bool = False,
-                       steps_per_iter: int = 1) -> Callable:
+                       steps_per_iter: int = 1,
+                       ring: str = "auto") -> Callable:
     """The shared per-EPOCH scan body of the kernel='pallas_epoch' programs
     (serial make_run_fn and DP make_dp_run_fn): derive the epoch's dropout
     source from the key chain, gather the epoch rows (uint8 pass-through —
@@ -230,7 +249,8 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
                 params, xp, yp, seed, lr, batch,
                 axis_name=pmean_axis if axis_size > 1 else None,
                 axis_size=axis_size, compute_bf16=compute_bf16,
-                steps_per_iter=steps_per_iter, valid_steps=nsteps)
+                steps_per_iter=steps_per_iter, valid_steps=nsteps,
+                ring=ring)
         if pmean_axis is not None:
             # the DDP-reported loss: mean over replicas of the shard-local
             # per-step means (params are already lockstep-identical)
@@ -353,7 +373,7 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
 def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                    kernel: str = "xla", interpret: bool = False,
                    snapshots: bool = False, unroll: int = 1,
-                   superstep: int = 1) -> Callable:
+                   superstep: int = 1, ring: str = "auto") -> Callable:
     """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
     (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
     sharded on the batch dim.
@@ -370,12 +390,17 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     reference's per-epoch val_loss (and hand epoch hooks a faithful
     TrainState) without breaking the fused program (118k params ->
     ~0.5 MB/epoch, trivial).
+
+    `ring` (kernel='pallas_epoch', multi-device only) picks the in-kernel
+    allreduce strategy — 'allgather' / 'reduce_scatter' / 'auto' (slot-
+    budget switch); see ops.pallas_step.epoch_fused_sgd.
     """
     _check_kernel(kernel, dtype)
     _check_superstep(superstep, kernel)
+    n_dev = int(mesh.devices.size)
+    _check_ring(ring, kernel, n_dev)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     use_pallas = kernel.startswith("pallas")
-    n_dev = int(mesh.devices.size)
 
     if kernel == "pallas_epoch":
         # The DDP epoch kernel: whole epoch per replica as one kernel,
@@ -409,7 +434,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                                        pmean_axis=DATA_AXIS,
                                        axis_size=n_dev,
                                        compute_bf16=dtype == "bfloat16",
-                                       steps_per_iter=superstep)
+                                       steps_per_iter=superstep, ring=ring)
             (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
             if snapshots:
                 losses, (p_snaps, k_snaps) = out

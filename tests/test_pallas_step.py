@@ -408,6 +408,16 @@ def test_epoch_kernel_dp_named_errors():
                         axis_size=2, ring="tree")
     with pytest.raises(ValueError, match="axis_name"):
         epoch_fused_sgd(params, x, y, 1, 0.01, 16, axis_size=2)
+    # the API-level guard: forcing a ring strategy anywhere it would be a
+    # silent no-op (wrong kernel, or a 1-device mesh whose ring degenerates
+    # away) is rejected by name, not ignored
+    with pytest.raises(ValueError, match="pallas_epoch"):
+        make_dp_run_fn(mesh, lr=0.01, kernel="xla", ring="reduce_scatter")
+    from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
+    mesh1 = make_mesh([1], ["dp"], jax.devices()[:1])
+    with pytest.raises(ValueError, match="multi-device"):
+        make_dp_run_fn(mesh1, lr=0.01, kernel="pallas_epoch",
+                       ring="allgather")
 
 
 @pytest.mark.parametrize("n", [2, 3, 4, 8])
@@ -565,6 +575,12 @@ def test_epoch_kernel_dp_8dev_program_traces():
     out = jax.eval_shape(run, params, key, x, y, idxs)
     assert out[2].shape == (2, 1)                    # (epochs, steps) losses
     assert out[3][0]["fc1"]["w"].shape == (2, 784, 128)   # params snapshots
+    # Forcing the reduce-scatter strategy on the same 8-device mesh (auto
+    # would pick allgather here) must trace the RS scratch structure too.
+    run_rs = make_dp_run_fn(mesh, lr=0.01, kernel="pallas_epoch",
+                            ring="reduce_scatter")
+    out = jax.eval_shape(run_rs, params, key, x, y, idxs)
+    assert out[2].shape == (2, 1)
 
 
 def test_epoch_kernel_dp_single_device_mesh_matches_serial_interpret():
