@@ -806,6 +806,12 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     if ring not in ("auto", "allgather", "reduce_scatter"):
         raise ValueError(f"ring must be 'auto', 'allgather' or "
                          f"'reduce_scatter'; got {ring!r}")
+    if not dp and ring != "auto":
+        raise ValueError(
+            f"ring={ring!r} selects the DP ring allreduce strategy, but "
+            f"axis_size={axis_size} runs the serial kernel (no ring) — a "
+            f"forced strategy here would silently measure the wrong "
+            f"program; drop ring or pass axis_size/axis_name")
     if dp and ring == "auto":
         ring = ("allgather" if axis_size <= EPOCH_KERNEL_MAX_DEVICES
                 else "reduce_scatter")

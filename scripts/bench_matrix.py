@@ -89,9 +89,9 @@ def _backend_info() -> dict:
     write after an otherwise complete sweep."""
     try:
         from pytorch_ddp_mnist_tpu.parallel.wireup import (
-            _honor_platform_env, _probe_devices_bounded)
+            _honor_platform_env, _probe_devices_bounded, env_seconds)
         _honor_platform_env()
-        probe_timeout = 30.0
+        probe_timeout = env_seconds("PDMT_HANG_TIMEOUT", 30.0)
         status, payload = _probe_devices_bounded(probe_timeout)
         if status != "ok":
             # 'hang' carries a wait_fn closure, not a message — keep the
