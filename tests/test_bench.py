@@ -37,6 +37,64 @@ def test_stream_mode_contract():
     assert rec["value"] > 0
 
 
+def test_eval_mode_contract():
+    """--mode eval: inference throughput of the reference eval pass, fused
+    repetitions in one program. JSON contract only; the anti-hoisting
+    dependence chain is sanity-checked by timing in
+    test_eval_bench_scan_does_not_collapse."""
+    rec = _run(["--mode", "eval", "--epochs", "2"])
+    assert rec["metric"] == "mnist_eval_images_per_sec_per_chip"
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+
+
+def test_mode_knob_compat_rejected_by_name():
+    """Variant knobs the selected mode never reads are rejected, not
+    silently accepted as a mislabeled measurement."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "eval", "--superstep", "4"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--superstep" in out.stderr
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "train", "--epochs", "1",
+         "--num_workers", "2"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--num_workers" in out.stderr
+
+
+def test_eval_bench_scan_does_not_collapse():
+    """The eval program's repetitions carry a bias dependence on the
+    previous pass precisely so XLA cannot hoist the loop-invariant forward
+    and evaluate it once. If that regressed (e.g. the perturbation constant
+    folded away), R repetitions would cost the same as 1 and the reported
+    throughput would be off by R. Pin it: 16 reps must cost clearly more
+    than 1 (>=3x; a collapsed scan measures ~1x)."""
+    import time
+
+    import jax
+    import numpy as np
+    from bench import make_eval_program as make
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+
+    split = synthetic_mnist(10000, seed=1)
+    x = jax.device_put(normalize_images(split.images))
+    y = jax.device_put(split.labels.astype(np.int32))
+    params = jax.device_put(init_mlp(jax.random.key(0)))
+
+    def best_of(prog, n=3):
+        prog(params, x, y)[0].block_until_ready()       # compile + warm
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            prog(params, x, y)[0].block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t16 = best_of(make(1)), best_of(make(16))
+    assert t16 >= 3 * t1, (t1, t16)
+
+
 def test_kernel_auto_composes_with_bfloat16():
     """`--kernel auto` (the default) must resolve to a kernel that accepts
     the requested dtype — bf16 + auto previously could pick the f32-only
