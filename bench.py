@@ -96,18 +96,29 @@ def _stream_bench(a) -> None:
 def make_eval_program(reps: int):
     """Jitted program of `reps` reference eval passes (full test set,
     dropout off — ddp_tutorial_multi_gpu.py:101-114) under one lax.scan.
+
+    `x` may be raw uint8 pixels: each pass then replays the reference
+    loader's ToTensor+Normalize on device (the reference normalizes at eval
+    time too), and the pass's HBM input stream is 4x smaller — the same
+    uint8-residency design as the training path; XLA fuses the normalize
+    into the first matmul's operand read.
+
     Each repetition's bias carries a +1e-30 perturbation from the previous
     pass's mean loss: numerically lost in f32 rounding (b1 is ~1e-2 scale),
     but it makes every pass data-depend on the one before, so XLA cannot
     hoist the loop-invariant forward out of the scan and evaluate it once
     (pinned by tests/test_bench.py::test_eval_bench_scan_does_not_collapse).
     """
+    import jax.numpy as jnp
+
     from pytorch_ddp_mnist_tpu.train.loop import _eval_math
+    from pytorch_ddp_mnist_tpu.train.scan import device_normalize
 
     @jax.jit
     def prog(params, x, y):
         def body(p, _):
-            per_sample, correct = _eval_math(p, x, y)
+            xf = device_normalize(x) if x.dtype == jnp.uint8 else x
+            per_sample, correct = _eval_math(p, xf, y)
             m = per_sample.mean()
             p = dict(p, fc1=dict(p["fc1"], b=p["fc1"]["b"] + 1e-30 * m))
             return p, (m, correct.mean())
@@ -121,12 +132,15 @@ def _eval_bench(a) -> None:
     """Inference throughput (`--mode eval`): `--epochs` fused repetitions of
     make_eval_program's pass per timing window, best of 5 — the measurement
     is the forward itself rather than per-pass dispatch RTT."""
-    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
     from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.train.scan import resident_images
     from pytorch_ddp_mnist_tpu.utils import Timer
 
     split = synthetic_mnist(10000, seed=1)
-    x = jax.device_put(normalize_images(split.images))
+    # uint8-resident test set, normalized in-program per pass (the
+    # reference's loader normalizes at eval time too)
+    x = jax.device_put(resident_images(split.images))
     y = jax.device_put(split.labels.astype(np.int32))
     params = jax.device_put(init_mlp(jax.random.key(0)))
     prog = make_eval_program(a.epochs)  # same knob: fused reps per window

@@ -62,11 +62,18 @@ def _gathered_x(x_all, batch_idx, compute_dt):
     """
     x = jnp.take(x_all, batch_idx, axis=0)
     if x.dtype == jnp.uint8:
-        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
-        x = x / jnp.float32(255.0)
-        x = x - jnp.float32(MNIST_MEAN)
-        x = x / jnp.float32(MNIST_STD)
+        x = device_normalize(x.reshape(x.shape[0], -1))
     return x.astype(compute_dt)
+
+
+def device_normalize(x):
+    """normalize_images' exact op chain on device, in f32 and in this op
+    order (the bit-identity argument vs the host path depends on it) — the
+    ONE jnp copy of the chain, shared by the scan gather and the eval
+    bench. The Pallas epoch kernel keeps its own Mosaic variant (int32
+    widening; ops/pallas_step.py) and pins it to this math by test."""
+    x = x.astype(jnp.float32) / jnp.float32(255.0)
+    return (x - jnp.float32(MNIST_MEAN)) / jnp.float32(MNIST_STD)
 
 
 def resident_images(images: np.ndarray) -> np.ndarray:

@@ -62,6 +62,34 @@ def test_mode_knob_compat_rejected_by_name():
     assert out.returncode != 0 and "--num_workers" in out.stderr
 
 
+def test_eval_program_uint8_matches_f32():
+    """The eval program's in-pass device normalize of raw uint8 pixels must
+    reproduce the host-normalized f32 pass (same op chain, float-rounding
+    equality)."""
+    import jax
+    import numpy as np
+    from bench import make_eval_program
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.train.scan import resident_images
+
+    split = synthetic_mnist(512, seed=1)
+    y = split.labels.astype(np.int32)
+    params = init_mlp(jax.random.key(0))
+    prog = make_eval_program(2)
+    l_u8, a_u8 = prog(params, jax.numpy.asarray(
+        resident_images(split.images)), y)
+    l_f32, a_f32 = prog(params, jax.numpy.asarray(
+        normalize_images(split.images)), y)
+    np.testing.assert_allclose(np.asarray(l_u8), np.asarray(l_f32),
+                               rtol=1e-5)
+    # fusion can differ between the two compiled programs (the uint8 one
+    # folds the normalize into the matmul read), so allow a near-tie
+    # argmax flip or two out of 512 rather than exact equality
+    np.testing.assert_allclose(np.asarray(a_u8), np.asarray(a_f32),
+                               atol=2 / 512)
+
+
 def test_eval_bench_scan_does_not_collapse():
     """The eval program's repetitions carry a bias dependence on the
     previous pass precisely so XLA cannot hoist the loop-invariant forward
