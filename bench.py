@@ -29,6 +29,12 @@ import numpy as np
 import jax
 
 NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
+# Eval mode's nominal is a DISTINCT constant (same magnitude, different
+# meaning): its vs_baseline normalizes an inference-pass rate, so eval rows
+# are not comparable to train rows even though both fields share a name.
+# Keeping the constants separate means retuning one can't silently reshape
+# the other's ratio (ADVICE r3).
+NOMINAL_BASELINE_EVAL_IMGS_PER_SEC = 1_000_000.0
 # Window length: each timing window carries a fixed ~30 ms of program
 # dispatch + sync RTT over the TPU tunnel (measured: 50/100/200/400-epoch
 # windows report 15.5/16.7/17.3/18.1M img/s — a 1/x approach to the ~18.5M
@@ -161,7 +167,7 @@ def _eval_bench(a) -> None:
         "metric": "mnist_eval_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / NOMINAL_BASELINE_IMGS_PER_SEC, 4),
+        "vs_baseline": round(per_chip / NOMINAL_BASELINE_EVAL_IMGS_PER_SEC, 4),
     }))
 
 
@@ -235,10 +241,13 @@ def main(argv=None) -> None:
                    help="stream mode: readahead threads")
     from pytorch_ddp_mnist_tpu.parallel.wireup import backend_wait_env
     p.add_argument("--backend_wait", type=float,
-                   default=backend_wait_env(300.0),
+                   default=backend_wait_env(3600.0),
                    help="seconds to keep polling for the accelerator backend "
                         "before giving up (the tunneled TPU is known to drop "
-                        "and recover; 0 = single immediate probe; "
+                        "for HOURS and recover — round-3's bench gave up at "
+                        "300s mid-outage; on a healthy backend the first "
+                        "probe answers immediately so a long budget costs "
+                        "nothing. 0 = single immediate probe; "
                         "PDMT_BACKEND_WAIT sets the default)")
     a = p.parse_args(argv)
     if a.epochs < 1:
@@ -248,15 +257,13 @@ def main(argv=None) -> None:
     # Mode/knob compatibility, rejected by name — a variant flag that the
     # selected mode never reads would otherwise silently label a
     # measurement with a configuration it didn't run (the unroll lesson).
+    # Defaults come from the parser itself, not literals, so a future
+    # default change can't desynchronize this check (ADVICE r3).
     if a.mode != "train":
-        for flag, val, default in (
-                ("--kernel", a.kernel, "auto"),
-                ("--dtype", a.dtype, "float32"),
-                ("--impl", a.impl, "rbg"),
-                ("--superstep", a.superstep, 1),
-                ("--unroll", a.unroll, 1),
-                ("--ring", a.ring, "auto"),
-                ("--batch_size", a.batch_size, 128)):
+        for dest in ("kernel", "dtype", "impl", "superstep", "unroll",
+                     "ring", "batch_size"):
+            flag, val, default = f"--{dest}", getattr(a, dest), \
+                p.get_default(dest)
             if val != default:
                 p.error(f"{flag} {val} is a train-mode variant knob; "
                         f"--mode {a.mode} never reads it")
@@ -279,6 +286,26 @@ def main(argv=None) -> None:
     # died on a single un-retried probe); poll before the first real backend
     # query so a transient outage inside the window doesn't kill the bench.
     # Final failure = ONE named JSON line (machine-readable), not a traceback.
+    # The default budget (1 h) deliberately exceeds any plausible caller
+    # timeout: if the caller times out first and SIGTERMs us mid-poll, the
+    # handler below still emits the honest error line — the artifact records
+    # "polled Ns through an outage" instead of nothing at all.
+    import signal
+    import time as _time
+    _wait_t0 = _time.monotonic()
+
+    def _term_while_waiting(signum, frame):
+        _emit_backend_error(RuntimeError(
+            f"caller sent SIGTERM after {_time.monotonic() - _wait_t0:.0f}s "
+            f"of backend polling (budget {a.backend_wait:.0f}s); backend "
+            f"never came up"))
+        sys.stdout.flush()
+        sys.exit(1)
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _term_while_waiting)
+    except ValueError:       # non-main thread (programmatic caller): skip
+        prev_term = None
     try:
         wait_for_backend(max_wait_s=a.backend_wait)
     except BackendWedgedError as e:
@@ -302,6 +329,9 @@ def main(argv=None) -> None:
     except BackendUnavailableError as e:
         _emit_backend_error(e)
         sys.exit(1)
+    finally:
+        if prev_term is not None:   # backend up: a later SIGTERM is not a
+            signal.signal(signal.SIGTERM, prev_term)  # backend-wait failure
 
     if a.mode == "eval":
         return _eval_bench(a)

@@ -68,7 +68,14 @@ V5E_PEAK_BF16 = 197e12
 
 
 def run_variant(argv, epochs: int):
-    cmd = [sys.executable, "bench.py", "--epochs", str(epochs)] + argv
+    # --backend_wait must stay well under this function's 1200s row timeout:
+    # subprocess.run SIGKILLs on expiry, which would skip bench.py's honest
+    # error JSON entirely (its SIGTERM handler never fires on SIGKILL) and
+    # burn the whole row budget polling. 300s of polling + the row's own
+    # work fits; a longer outage fails the row fast and the retry pass
+    # re-measures it.
+    cmd = [sys.executable, "bench.py", "--epochs", str(epochs),
+           "--backend_wait", "300"] + argv
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=1200)
     except subprocess.TimeoutExpired:

@@ -9,6 +9,10 @@
 #   3. inference throughput (--mode eval)
 #   4. the Mosaic hardware test suite  (PDMT_TPU_TESTS=1)
 #
+# Every phase's exit status is tracked: the script exits nonzero with a
+# per-phase summary if ANY phase failed, so a caller keying on the exit
+# code can never mistake a dead-tunnel pass for a complete one (ADVICE r3).
+#
 # Usage:  scripts/measure_hw.sh [matrix_out.json]
 #   PDMT_WINDOW_WAIT  seconds to keep polling for the backend before giving
 #                     up (default 1800; each probe is a fresh 45 s-bounded
@@ -29,20 +33,34 @@ until timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; do
 done
 echo "measure_hw: backend up at $(date -u +%H:%M:%S)" >&2
 
+declare -A status
+
 echo "== phase 1: variant matrix -> $OUT" >&2
 python scripts/bench_matrix.py --epochs 400 --retries 2 --out "$OUT"
+status[matrix]=$?
 
 echo "== phase 2: superstep / bf16 sweep" >&2
+status[sweep]=0
 for ARGS in "--superstep 2" "--superstep 4" "--superstep 8" \
             "--dtype bfloat16 --superstep 2" \
             "--dtype bfloat16 --superstep 8"; do
   echo "pallas_epoch $ARGS:" >&2
-  timeout 600 python bench.py --kernel pallas_epoch $ARGS
+  timeout 600 python bench.py --backend_wait 120 --kernel pallas_epoch $ARGS \
+    || status[sweep]=$?
 done
 
 echo "== phase 3: inference throughput" >&2
-timeout 600 python bench.py --mode eval
+timeout 600 python bench.py --backend_wait 120 --mode eval
+status[eval]=$?
 
 echo "== phase 4: Mosaic hardware suite" >&2
 PDMT_TPU_TESTS=1 timeout 3600 python -u -m pytest tests/test_pallas_step.py -q
-echo "measure_hw: done at $(date -u +%H:%M:%S)" >&2
+status[mosaic]=$?
+
+fail=0
+for phase in matrix sweep eval mosaic; do
+  echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
+  ((status[$phase] != 0)) && fail=1
+done
+echo "measure_hw: done at $(date -u +%H:%M:%S) (fail=$fail)" >&2
+exit $fail
