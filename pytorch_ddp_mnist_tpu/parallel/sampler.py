@@ -14,10 +14,15 @@ class reproduces (SURVEY.md §7 parity item 3):
   3. ROUND-ROBIN split: rank r takes indices[r::world_size];
   4. reshuffle each epoch by calling set_epoch(e) before iterating.
 
-The permutation source is numpy's PCG64 (np.random.default_rng(seed + epoch))
-rather than torch's MT19937 randperm — deliberately: the framework carries no
-torch dependency. The *sharding math* (padding, interleave, epoch keying) is
-bitwise-faithful; tests/test_sampler.py cross-checks it against
+The DEFAULT permutation source is numpy's PCG64
+(np.random.default_rng(seed + epoch)) rather than torch's MT19937 randperm —
+deliberately: the framework carries no torch dependency, and any uniform
+permutation preserves the training distribution. `permutation="torch"` opts
+into BITWISE parity instead: parallel/torch_rng.py re-implements torch's CPU
+generator + randperm draw order exactly, so an epoch's shard contents then
+match a reference run at the same seed index-for-index. The *sharding math*
+(padding, interleave, epoch keying) is bitwise-faithful in both modes;
+tests/test_sampler.py cross-checks everything against
 torch.utils.data.DistributedSampler when torch is importable.
 
 Non-shuffling mode mirrors DistributedSampler(shuffle=False): identity order,
@@ -33,14 +38,20 @@ import numpy as np
 
 class ShardedSampler:
     def __init__(self, num_samples: int, *, num_replicas: int = 1, rank: int = 0,
-                 shuffle: bool = True, seed: int = 42):
+                 shuffle: bool = True, seed: int = 42,
+                 permutation: str = "pcg64"):
         if not (0 <= rank < num_replicas):
             raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        if permutation not in ("pcg64", "torch"):
+            raise ValueError(f"permutation must be 'pcg64' (default) or "
+                             f"'torch' (bitwise MT19937 randperm parity); "
+                             f"got {permutation!r}")
         self.num_samples = int(num_samples)
         self.num_replicas = int(num_replicas)
         self.rank = int(rank)
         self.shuffle = shuffle
         self.seed = int(seed)
+        self.permutation = permutation
         self.epoch = 0
         # Per-rank sample count after padding (DistributedSampler.num_samples).
         self.samples_per_replica = math.ceil(self.num_samples / self.num_replicas)
@@ -52,7 +63,10 @@ class ShardedSampler:
 
     def global_permutation(self) -> np.ndarray:
         """The padded global order all ranks agree on this epoch."""
-        if self.shuffle:
+        if self.shuffle and self.permutation == "torch":
+            from .torch_rng import torch_randperm
+            idx = torch_randperm(self.num_samples, self.seed + self.epoch)
+        elif self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             idx = rng.permutation(self.num_samples)
         else:

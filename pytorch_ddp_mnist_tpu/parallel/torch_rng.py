@@ -1,0 +1,114 @@
+"""Bit-exact reimplementation of torch's CPU ``randperm`` stream.
+
+The reference shards its epoch by ``torch.randperm(n, generator=g)`` with a
+``torch.Generator`` seeded ``seed + epoch`` inside ``DistributedSampler``
+(ddp_tutorial_multi_gpu.py:26-30 via sampler.set_epoch at :81).  A torch
+``Generator`` on CPU is the classic Mersenne Twister (``at::mt19937``:
+init_genrand seeding, 624-word state, standard tempering), and CPU
+``randperm`` is a Fisher-Yates pass drawing one 32-bit word per position::
+
+    r = [0, 1, ..., n-1]
+    for i in 0..n-2:  z = mt() % (n - i);  swap(r[i], r[i+z])
+
+Reimplementing exactly that here (no torch dependency) gives
+``ShardedSampler(permutation="torch")`` BITWISE shard composition parity
+with the reference — the last parity asterisk from SURVEY.md §7 item 3.
+``tests/test_sampler.py`` cross-checks every path against real torch
+(including full 60000-row MNIST epochs), so any torch-side algorithm drift
+would surface there, not silently here.
+
+Implementation notes: the twist is vectorized per 624-word block.  The
+in-place reference recurrence makes entries 227..623 depend on entries
+updated EARLIER IN THE SAME TWIST (new[i] = new[i-227] ^ f(old[i],
+old[i+1]) for i >= 227, and the final word reads new[0]); a naive
+whole-block roll uses stale words there and diverges after the first 227
+draws — the bug class this module's segment-split exists to avoid.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_N, _M = 624, 397
+_UPPER = np.uint32(0x80000000)        # most significant w-r bits
+_LOWER = np.uint32(0x7FFFFFFF)        # least significant r bits
+_MATRIX_A = np.uint32(0x9908B0DF)
+
+
+class TorchMT19937:
+    """``at::mt19937`` with init_genrand seeding: the engine behind a CPU
+    ``torch.Generator().manual_seed(seed)``. Yields the same uint32 stream."""
+
+    def __init__(self, seed: int):
+        st = np.empty(_N, np.uint32)
+        s = int(seed) & 0xFFFFFFFF
+        st[0] = s
+        for j in range(1, _N):
+            s = (1812433253 * (s ^ (s >> 30)) + j) & 0xFFFFFFFF
+            st[j] = s
+        self._state = st
+        self._pos = _N                 # force a twist before the first draw
+
+    def _twist(self) -> None:
+        s = self._state
+        new = np.empty(_N, np.uint32)
+        y = (s & _UPPER) | (np.concatenate([s[1:], s[:1]]) & _LOWER)
+        f = (y >> np.uint32(1)) ^ np.where(
+            (y & np.uint32(1)).astype(bool), _MATRIX_A, np.uint32(0))
+        # i in [0, N-M): sources old state only
+        new[:_N - _M] = s[_M:] ^ f[:_N - _M]
+        # i in [N-M, N-1): new[i] = new[i-(N-M)] ^ f[i] — each 227-word
+        # stripe depends on the stripe just written, so update stripe-wise
+        i = _N - _M
+        while i < _N - 1:
+            j = min(i + (_N - _M), _N - 1)
+            new[i:j] = new[i - (_N - _M):j - (_N - _M)] ^ f[i:j]
+            i = j
+        # i = N-1: y reads the NEW word 0 (the in-place recurrence)
+        y_last = (s[_N - 1] & _UPPER) | (new[0] & _LOWER)
+        f_last = (y_last >> np.uint32(1)) ^ (
+            _MATRIX_A if (int(y_last) & 1) else np.uint32(0))
+        new[_N - 1] = new[_M - 1] ^ f_last
+        self._state = new
+        self._pos = 0
+
+    def draws(self, k: int) -> np.ndarray:
+        """The next ``k`` tempered uint32 outputs, vectorized per block."""
+        out = np.empty(k, np.uint32)
+        filled = 0
+        while filled < k:
+            if self._pos >= _N:
+                self._twist()
+            take = min(k - filled, _N - self._pos)
+            y = self._state[self._pos:self._pos + take].copy()
+            y ^= y >> np.uint32(11)
+            y ^= (y << np.uint32(7)) & np.uint32(0x9D2C5680)
+            y ^= (y << np.uint32(15)) & np.uint32(0xEFC60000)
+            y ^= y >> np.uint32(18)
+            out[filled:filled + take] = y
+            self._pos += take
+            filled += take
+        return out
+
+    def __call__(self) -> int:
+        return int(self.draws(1)[0])
+
+
+def torch_randperm(n: int, seed: int) -> np.ndarray:
+    """``torch.randperm(n, generator=manual_seed(seed))`` on CPU, bitwise.
+
+    One generator word per position, modulo-folded into the shrinking tail
+    (torch's exact draw order — the modulo bias and all). The swap loop is
+    host Python (~30 ms at n=60000): it runs once per epoch on the host,
+    never on device, so clarity beats vectorization tricks here.
+    """
+    n = int(n)
+    r = np.arange(n, dtype=np.int64)
+    if n < 2:
+        return r
+    z = TorchMT19937(seed).draws(n - 1)
+    for i in range(n - 1):
+        j = i + int(z[i]) % (n - i)
+        if j != i:
+            r[i], r[j] = r[j], r[i]
+    return r

@@ -76,3 +76,52 @@ def test_pad_exceeding_dataset_cycles():
     perm = s.global_permutation()
     assert perm.size == 8
     np.testing.assert_array_equal(perm, [0, 1, 2, 0, 1, 2, 0, 1])
+
+
+@pytest.mark.parametrize("n,world,epoch", [
+    (100, 4, 0), (1000, 8, 17), (13, 4, 2), (7, 8, 1), (60_000, 4, 3)])
+def test_torch_permutation_bitwise_matches_torch_shuffled(n, world, epoch):
+    """permutation='torch' reproduces DistributedSampler(shuffle=True)
+    INDEX-FOR-INDEX: the MT19937 randperm stream itself (torch_rng.py), the
+    padding, and the interleave — the full shard composition of
+    ddp_tutorial_multi_gpu.py:26-30 at the same seed. 60_000 covers real
+    MNIST epochs (and >624-word generator blocks, where a wrong twist
+    recurrence would first diverge)."""
+    for rank in range(world):
+        ours = ShardedSampler(n, num_replicas=world, rank=rank, seed=42,
+                              permutation="torch")
+        ours.set_epoch(epoch)
+        theirs = DistributedSampler(_FakeDataset(n), num_replicas=world,
+                                    rank=rank, shuffle=True, seed=42)
+        theirs.set_epoch(epoch)
+        np.testing.assert_array_equal(
+            ours.indices(), np.fromiter(iter(theirs), int))
+
+
+def test_torch_mt19937_engine_matches_torch_randperm_stream():
+    """The engine itself (not just the composed sampler): randperm at sizes
+    straddling the 624-word twist block, multiple seeds."""
+    from pytorch_ddp_mnist_tpu.parallel.torch_rng import torch_randperm
+
+    for n in (0, 1, 2, 623, 624, 625, 2000):
+        for seed in (0, 42, 1 << 31):
+            g = torch.Generator()
+            g.manual_seed(seed)
+            np.testing.assert_array_equal(
+                torch_randperm(n, seed),
+                torch.randperm(n, generator=g).numpy())
+
+
+def test_permutation_kwarg_validated():
+    with pytest.raises(ValueError, match="permutation"):
+        ShardedSampler(10, permutation="mt19937")
+
+
+def test_torch_permutation_default_unchanged():
+    """The default stays PCG64 (documented fast path, no behavior change
+    for existing callers); 'torch' is the opt-in."""
+    a = ShardedSampler(100, seed=42)
+    b = ShardedSampler(100, seed=42, permutation="torch")
+    a.set_epoch(0), b.set_epoch(0)
+    assert a.permutation == "pcg64"
+    assert not np.array_equal(a.indices(), b.indices())
