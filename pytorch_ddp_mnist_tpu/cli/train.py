@@ -25,6 +25,79 @@ from ..train import (TrainState, fit, save_checkpoint, load_checkpoint)
 from ..train.config import configure
 
 
+def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
+    """Run the fit closure, retraining through backend outages when
+    --outage_retries > 0 (the tunneled-TPU failure mode this framework's
+    bench machinery already handles at startup — this extends it MID-run).
+
+    On a device/backend RuntimeError escaping the fit: wait for the backend
+    (hang-bounded probes, parallel/wireup.py), then
+
+    - recovered in-process: rebuild device state from the host stash (last
+      completed epoch's params + key) and continue at the next GLOBAL epoch
+      — with start_epoch keeping the sampler's reshuffle sequence and the
+      key chain intact, the resumed trajectory is bitwise the unbroken one;
+    - client WEDGED (a hung init holds xla_bridge's lock — no in-process
+      query can ever succeed): persist the stash to the checkpoint plus an
+      RNG sidecar and re-exec with --resume/--start_epoch and the remaining
+      retry budget (CLI path only, once — the PDMT_NO_REEXEC marker, same
+      contract as bench.py);
+    - backend stays down past the wait budget (PDMT_BACKEND_WAIT, default
+      1 h): SystemExit with the named error.
+
+    With retries == 0 (the default) this is exactly one un-wrapped call —
+    interactive errors stay immediate.
+    """
+    import os
+
+    from ..parallel.wireup import (BackendUnavailableError,
+                                   BackendWedgedError, backend_wait_env,
+                                   wait_for_backend)
+
+    retries = tcfg["outage_retries"]
+    start = tcfg["start_epoch"]
+    attempt = 0
+    while True:
+        try:
+            with trace(tcfg["profile"]):
+                return run_fit(state, start)
+        except RuntimeError as e:
+            if attempt >= retries:
+                raise
+            attempt += 1
+            print(f"[outage] training interrupted mid-run: {e}; waiting for "
+                  f"the backend (retry {attempt}/{retries})",
+                  file=sys.stderr, flush=True)
+            try:
+                wait_for_backend(max_wait_s=backend_wait_env(3600.0))
+            except BackendWedgedError:
+                if argv is not None or os.environ.get("PDMT_NO_REEXEC") == "1":
+                    raise
+                ckpt = tcfg["checkpoint"] or "outage_resume.msgpack"
+                save_checkpoint(ckpt, stash["params"])
+                np.savez(ckpt + ".rng.npz", key=stash["key"],
+                         impl=tcfg["impl"])
+                os.environ["PDMT_NO_REEXEC"] = "1"
+                print(f"[outage] backend recovered but this process's jax "
+                      f"client is wedged; re-exec'ing with --resume {ckpt} "
+                      f"--start_epoch {stash['epoch'] + 1}",
+                      file=sys.stderr, flush=True)
+                os.execv(sys.executable, [
+                    sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train",
+                    *sys.argv[1:], "--resume", ckpt,
+                    "--start_epoch", str(stash["epoch"] + 1),
+                    "--outage_retries", str(retries - attempt)])
+            except BackendUnavailableError as be:
+                raise SystemExit(
+                    f"[outage] backend did not recover within the wait "
+                    f"budget after a mid-run interruption: {be}") from e
+            start = stash["epoch"] + 1
+            state = TrainState(
+                jax.tree_util.tree_map(jax.device_put, stash["params"]),
+                jax.random.wrap_key_data(jax.numpy.asarray(stash["key"]),
+                                         impl=tcfg["impl"]))
+
+
 def main(argv=None) -> int:
     from ..parallel.wireup import _honor_platform_env
     _honor_platform_env()  # JAX_PLATFORMS from the launcher wins (e.g. cpu)
@@ -79,6 +152,22 @@ def main(argv=None) -> int:
                 f"got {tcfg['batch_size']} — use --kernel pallas instead")
     if tcfg["fused"] and not tcfg["cached"]:
         raise SystemExit("--fused fuses the epoch scan; add --cached")
+    if not 0 <= tcfg["start_epoch"] <= tcfg["n_epochs"]:
+        raise SystemExit(f"--start_epoch {tcfg['start_epoch']} outside "
+                         f"[0, {tcfg['n_epochs']}] (n_epochs is the TOTAL "
+                         f"run length; start_epoch resumes inside it)")
+    if tcfg["outage_retries"] < 0:
+        raise SystemExit("--outage_retries must be >= 0")
+    if tcfg["outage_retries"] and tcfg["parallel"]:
+        raise SystemExit(
+            "--outage_retries is serial-only: a multi-process run that "
+            "loses its backend mid-collective cannot re-rendezvous in "
+            "place — relaunch with --resume instead")
+    if tcfg["outage_retries"] and tcfg["fused"]:
+        raise SystemExit(
+            "--outage_retries needs per-epoch state to resume from; "
+            "--fused runs all epochs as one device program with no "
+            "mid-run state (use plain --cached)")
 
     # .pt/.pth checkpoint paths need torch — fail BEFORE training, not after
     # a completed run's first save (which would lose the trained params).
@@ -226,6 +315,20 @@ def main(argv=None) -> int:
     if tcfg["resume"]:
         state = TrainState(load_checkpoint(tcfg["resume"], state.params),
                            state.key)
+        # RNG sidecar (written by the outage-resume re-exec): restores the
+        # epoch-k key so the resumed dropout stream continues the unbroken
+        # run's chain bitwise, not restarting from --seed.
+        import os
+        rng_sidecar = tcfg["resume"] + ".rng.npz"
+        if os.path.exists(rng_sidecar):
+            z = np.load(rng_sidecar)
+            state = TrainState(state.params, jax.random.wrap_key_data(
+                jax.numpy.asarray(z["key"]), impl=str(z["impl"])))
+            # One-shot: the sidecar's key matches THIS checkpoint snapshot
+            # only. The resumed run overwrites the checkpoint every epoch;
+            # a stale sidecar would silently pair a later resume's fresh
+            # params with this old key — consume it now.
+            os.remove(rng_sidecar)
     if mesh is not None:
         state = TrainState(replicate_state(mesh, state.params),
                            replicate_state(mesh, state.key))
@@ -241,9 +344,30 @@ def main(argv=None) -> int:
     # from k-1 via --resume. Exception: --fused replays hooks after the
     # whole-run program finishes, so mid-run preemption leaves no
     # intermediate checkpoint (documented on the flag).
-    hook = None
+    user_hook = None
     if process_index == 0 and tcfg["checkpoint"]:
-        hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
+        user_hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
+    hook = user_hook
+
+    # Mid-run outage resilience (--outage_retries, serial only): the hook
+    # additionally keeps HOST-side copies of the latest completed epoch's
+    # params AND key, so a dead backend cannot take the run's progress with
+    # it — _train_with_outage_retry resumes from this stash at the next
+    # global epoch. Seeded below with the starting state (epoch
+    # start_epoch-1) so an outage before the first epoch completes can
+    # still rebuild.
+    stash = {}
+    if tcfg["outage_retries"]:
+        def hook(e, st):
+            stash["epoch"] = e
+            stash["params"] = jax.tree_util.tree_map(np.asarray, st.params)
+            stash["key"] = np.asarray(jax.random.key_data(st.key))
+            if user_hook is not None:
+                user_hook(e, st)
+
+        stash["epoch"] = tcfg["start_epoch"] - 1
+        stash["params"] = jax.tree_util.tree_map(np.asarray, state.params)
+        stash["key"] = np.asarray(jax.random.key_data(state.key))
 
     from ..utils.logging import rank_zero_log
     from ..utils.profiling import trace
@@ -271,23 +395,26 @@ def main(argv=None) -> int:
         # (train/scan.py resident_images — 4x less HBM than resident f32).
         sampler = ShardedSampler(n_train, num_replicas=1, rank=0,
                                  shuffle=True, seed=42)
-        with trace(tcfg["profile"]):
-            state = fit_cached(state, images, y_train, sampler, x_test,
-                               test_labels, epochs=tcfg["n_epochs"],
-                               batch_size=global_batch, lr=tcfg["lr"],
-                               mesh=mesh, dtype=tcfg["dtype"],
-                               kernel=tcfg["kernel"],
-                               interpret=use_pallas and _pallas_interpret(),
-                               fused=tcfg["fused"],
-                               log=log, epoch_hook=hook)
+
+        def run_fit(st, start):
+            return fit_cached(st, images, y_train, sampler, x_test,
+                              test_labels, epochs=tcfg["n_epochs"],
+                              batch_size=global_batch, lr=tcfg["lr"],
+                              mesh=mesh, dtype=tcfg["dtype"],
+                              kernel=tcfg["kernel"],
+                              interpret=use_pallas and _pallas_interpret(),
+                              fused=tcfg["fused"],
+                              log=log, epoch_hook=hook, start_epoch=start)
     else:
-        with trace(tcfg["profile"]):
-            state = fit(state, loader, x_test, test_labels,
-                        epochs=tcfg["n_epochs"],
-                        batch_size=global_batch,
-                        **({"lr": tcfg["lr"]} if train_step is None else {}),
-                        log=log, train_step=train_step, put=put,
-                        epoch_hook=hook)
+        def run_fit(st, start):
+            return fit(st, loader, x_test, test_labels,
+                       epochs=tcfg["n_epochs"],
+                       batch_size=global_batch,
+                       **({"lr": tcfg["lr"]} if train_step is None else {}),
+                       log=log, train_step=train_step, put=put,
+                       epoch_hook=hook, start_epoch=start)
+    state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
+                                     argv)
 
     if process_index == 0 and tcfg["checkpoint"]:
         save_checkpoint(tcfg["checkpoint"], state.params)

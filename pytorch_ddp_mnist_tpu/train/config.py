@@ -63,6 +63,23 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--resume", type=str, default=None,
                    help="checkpoint to load before training (added capability;"
                         " the reference has no load path)")
+    t.add_argument("--start_epoch", type=int, default=0,
+                   help="resume the run at this GLOBAL epoch index: epochs "
+                        "[start_epoch, n_epochs) run with their "
+                        "uninterrupted sampler reshuffles and numbering. "
+                        "Pair with --resume (epoch start_epoch-1's "
+                        "checkpoint) to continue an interrupted run; the "
+                        "outage-resume re-exec sets it automatically")
+    t.add_argument("--outage_retries", type=int, default=0,
+                   help="opt-in mid-run backend-outage resilience (serial, "
+                        "non-fused runs): on a device/backend RuntimeError "
+                        "mid-training, wait for the backend "
+                        "(PDMT_BACKEND_WAIT, default 1h) and resume from "
+                        "the last completed epoch's in-memory state, up to "
+                        "N times; if the in-process client is wedged "
+                        "(hang-mode outage), persist progress and re-exec "
+                        "with --resume/--start_epoch. 0 (default) = fail "
+                        "fast")
     t.add_argument("--dtype", choices=("float32", "bfloat16"), default="float32",
                    help="compute dtype for the train step")
     t.add_argument("--impl", choices=("threefry2x32", "rbg"),
@@ -135,6 +152,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "seed": a.seed, "parallel": a.parallel,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
+            "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,
             "dtype": a.dtype, "impl": a.impl,
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,

@@ -217,7 +217,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         epochs: int, batch_size: int, lr: float | None = None,
         log: Callable[[str], None] = print,
         train_step: Callable | None = None, sharding=None, put=None,
-        epoch_hook: Callable | None = None) -> TrainState:
+        epoch_hook: Callable | None = None, start_epoch: int = 0) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -226,9 +226,18 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     placement. The printed epoch line replicates the reference format and
     units (ddp_tutorial_multi_gpu.py:116), extended with accuracy and timing.
     `epoch_hook(epoch, state)` supports mid-training checkpointing.
+
+    `start_epoch` resumes the run at a GLOBAL epoch index: epochs
+    [start_epoch, epochs) run with their uninterrupted sampler reshuffles
+    and epoch numbering, so a run resumed from epoch-k state retraces
+    exactly what the unbroken run would have done from there (the
+    outage-resume path of cli.train; state must carry epoch k-1's params
+    AND key for bitwise fidelity).
     """
     if (train_step is None) == (lr is None):
         raise ValueError("pass exactly one of lr= or train_step=")
+    if not 0 <= start_epoch <= epochs:
+        raise ValueError(f"start_epoch={start_epoch} outside [0, {epochs}]")
     step = train_step if train_step is not None else make_train_step(lr)
     eval_step = make_eval_step()
     # Hoist the test set to device ONCE — the reference re-materializes its
@@ -237,7 +246,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     # MNIST per epoch for no reason.
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         io_timer = CumulativeTimer("loader-wait")
         train_loader.sampler.set_epoch(epoch)

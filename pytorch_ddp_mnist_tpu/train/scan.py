@@ -509,7 +509,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                kernel: str = "xla", interpret: bool = False,
                fused: bool = False,
                log: Callable[[str], None] = print,
-               epoch_hook: Callable | None = None) -> TrainState:
+               epoch_hook: Callable | None = None,
+               start_epoch: int = 0) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
 
     `batch_size` is the GLOBAL batch (sampler shards rows per process; with a
@@ -521,8 +522,17 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     val_loss/accuracy lines and epoch hooks still happen — just after the
     device is done rather than interleaved. Throughput in the epoch line is
     then the run average (one wall measurement / E).
+
+    `start_epoch` resumes at a GLOBAL epoch index: epochs
+    [start_epoch, epochs) run with their uninterrupted sampler reshuffles
+    (set_epoch uses global numbers) and epoch-line numbering — the
+    outage-resume path (cli.train --start_epoch); with epoch k-1's params
+    and key in `state`, the resumed trajectory is bitwise the unbroken one.
     """
     import time
+
+    if not 0 <= start_epoch <= epochs:
+        raise ValueError(f"start_epoch={start_epoch} outside [0, {epochs}]")
 
     if mesh is not None:
         # replicate_state / make_array_from_callback build GLOBAL arrays, so
@@ -547,12 +557,13 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     params, key = state.params, state.key
 
     if fused:
-        if epochs == 0:  # match the per-epoch loop's no-op
+        if epochs <= start_epoch:  # match the per-epoch loop's no-op
             return TrainState(params, key)
         # ONE program for the whole run (zero host round-trips inside),
         # then replay the per-epoch reporting from the snapshots.
+        run_epochs = list(range(start_epoch, epochs))
         idxs = []
-        for epoch in range(epochs):
+        for epoch in run_epochs:
             sampler.set_epoch(epoch)
             idxs.append(epoch_batch_indices(sampler, batch_size))
         idxs = np.stack(idxs)
@@ -569,27 +580,27 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         params, key, losses, (p_snaps, k_snaps) = run(
             params, key, x_all, y_all, idxs)
         losses = np.asarray(losses)                      # sync: run finished
-        per_epoch_dt = (time.perf_counter() - t0) / epochs
+        per_epoch_dt = (time.perf_counter() - t0) / len(run_epochs)
         # Replay ALL epochs' val lines from one vmapped eval program + one
         # fetch — per-epoch evaluate() calls here would cost E dispatch
         # round-trips (a full tunnel RTT each on a remote TPU).
         ps_all, corr_all = make_snapshot_eval_step()(
             p_snaps, x_test_dev, y_test_dev)
         ps_all, corr_all = np.asarray(ps_all), np.asarray(corr_all)
-        for epoch in range(epochs):
-            p_e = jax.tree_util.tree_map(lambda a, _e=epoch: a[_e], p_snaps)
-            val = val_summary(ps_all[epoch], corr_all[epoch], batch_size)
-            log(epoch_summary(epoch, losses[epoch], batch_size, val,
+        for i, epoch in enumerate(run_epochs):
+            p_e = jax.tree_util.tree_map(lambda a, _i=i: a[_i], p_snaps)
+            val = val_summary(ps_all[i], corr_all[i], batch_size)
+            log(epoch_summary(epoch, losses[i], batch_size, val,
                               per_epoch_dt))
             if epoch_hook is not None:
                 # faithful TrainState: this epoch's params AND RNG key, so a
                 # hook that checkpoints state resumes the same trajectory as
                 # a non-fused run would.
-                epoch_hook(epoch, TrainState(p_e, k_snaps[epoch]))
+                epoch_hook(epoch, TrainState(p_e, k_snaps[i]))
         return TrainState(params, key)
 
     eval_step = make_eval_step()
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         sampler.set_epoch(epoch)
         idx = epoch_batch_indices(sampler, batch_size)
