@@ -80,6 +80,67 @@ def _round_up(n: int, m: int) -> int:
 # in-kernel Bernoulli of the pallas_rng variant.
 _KEEP_THRESH = int(round((1.0 - DROPOUT_RATE) * 2**32))
 
+# Threefry-2x32 rotation schedule (Random123 / jax._src.prng): 5 groups of
+# 4 ARX rounds, alternating these two rotation lists, with a key injection
+# after each group.
+_TF_ROT_A = (13, 15, 26, 6)
+_TF_ROT_B = (17, 29, 16, 24)
+
+
+def threefry2x32(k0, k1, x0, x1):
+    """jax's threefry2x32 block cipher as plain jnp uint32 ops.
+
+    Bit-for-bit the stream behind every jax.random threefry draw (pinned by
+    tests against jax.random.bits). Written in portable ops (add/xor/shift
+    on uint32) so the SAME code runs under jit, the Pallas interpreter, and
+    Mosaic — which is what makes the epoch kernel's in-kernel
+    reference-RNG dropout CI-coverable on CPU, unlike the core-PRNG path.
+    """
+    u32 = jnp.uint32
+
+    def rotl(x, d):
+        return (x << u32(d)) | (x >> u32(32 - d))
+
+    ks0, ks1 = k0, k1
+    ks2 = k0 ^ k1 ^ u32(0x1BD11BDA)
+    x0 = x0 + ks0
+    x1 = x1 + ks1
+    for i, (rots, (i0, i1)) in enumerate((
+            (_TF_ROT_A, (ks1, ks2)), (_TF_ROT_B, (ks2, ks0)),
+            (_TF_ROT_A, (ks0, ks1)), (_TF_ROT_B, (ks1, ks2)),
+            (_TF_ROT_A, (ks2, ks0)))):
+        for r in rots:
+            x0 = x0 + x1
+            x1 = rotl(x1, r)
+            x1 = x1 ^ x0
+        x0 = x0 + i0
+        x1 = x1 + i1 + u32(i + 1)
+    return x0, x1
+
+
+def _threefry_mask_block(k0, k1, rows):
+    """(rows, HIDDEN1) pre-scaled dropout mask == dropout_mask(key, rows)
+    BIT-FOR-BIT, computed from the key's two uint32 words.
+
+    Replays jax's exact draw: partitionable threefry random_bits (counts =
+    the 64-bit element index split hi/lo — hi is 0 at these sizes — and
+    bits = out0 ^ out1), uniform's mantissa fill ((bits>>9)|0x3f800000,
+    bitcast, -1, max 0), bernoulli's `u < keep` compare, then the 1/keep
+    inverted-dropout scale (models/mlp.py:85-88). Pure jnp, so it runs in
+    the Mosaic kernel AND the interpreter AND plain jit identically."""
+    assert HIDDEN1 == 128  # idx = (row << 7) | col below
+    u32, f32 = jnp.uint32, jnp.float32
+    r = jax.lax.broadcasted_iota(u32, (rows, HIDDEN1), 0)
+    c = jax.lax.broadcasted_iota(u32, (rows, HIDDEN1), 1)
+    idx = (r << u32(7)) | c                      # row-major element index
+    o0, o1 = threefry2x32(k0, k1, jnp.zeros_like(idx), idx)
+    bits = o0 ^ o1
+    u = jax.lax.bitcast_convert_type(
+        (bits >> u32(9)) | u32(0x3F800000), f32) - f32(1.0)
+    u = jnp.maximum(f32(0.0), u)
+    keep = f32(1.0 - DROPOUT_RATE)
+    return jnp.where(u < keep, f32(1.0) / keep, f32(0.0))
+
 # Largest per-step batch the whole-epoch kernel takes: its x input streams
 # as ONE (B, 784) f32 block (double-buffered ~3.2 MB x2 at B=1024) next to
 # two resident weight copies (~1.1 MB) and (B, 128) activations — ~10 MB at
@@ -359,7 +420,7 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
     return loss[0, 0], grads
 
 
-def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
+def _make_epoch_kernel(block: int, lr: float, *, rng: str = "core",
                        uint8_in: bool = False, axis_name: str | None = None,
                        n_devices: int = 1, compute_bf16: bool = False,
                        steps_per_iter: int = 1,
@@ -374,14 +435,24 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
     iterations (copied into the pinned output refs at iteration 0, updated in
     place by the in-kernel SGD), and are flushed once at epoch end. The
     epoch's batches stream through the pipelined x/y input blocks; dropout is
-    drawn in-kernel per step by default (core PRNG, hardware-hashed
-    (seed, step) stream, same Bernoulli keep distribution as every other
-    engine).
+    drawn in-kernel per step.
 
-    `in_kernel_rng=False`: the third input is a streamed (block, HIDDEN1)
-    pre-scaled mask block instead of the SMEM seed — no Mosaic-only PRNG ops,
-    so the kernel runs under the Pallas interpreter (CPU CI coverage of the
-    whole wrapper; the seeds->mask mapping is abstracted to the caller).
+    `rng` selects the dropout source (and the meaning of the third input):
+
+    - "core" (default): the TPU core PRNG, hardware-hashed (seed, step)
+      stream — same Bernoulli keep distribution as every other engine, its
+      own stream. Third input = the SMEM epoch seed. Mosaic-only.
+    - "threefry": jax's threefry2x32 evaluated IN-kernel on the VPU
+      (threefry2x32/_threefry_mask_block above) — the masks are bit-for-bit
+      models/mlp.py's bernoulli draw for the same per-step keys, i.e. the
+      REFERENCE RNG semantics at epoch-kernel speed (the dropout of
+      /root/reference/ddp_tutorial_cpu.py:47, stream and all). Third input
+      = (K, 2) int32 per-step key words in SMEM. Pure jnp ops, so this
+      mode ALSO runs under the interpreter (CPU CI covers it end-to-end,
+      unlike "core").
+    - "masks": the third input is a streamed (K*block, HIDDEN1) pre-scaled
+      mask block — the seeds->mask mapping abstracted to the caller
+      (interpreter CI path of the wrapper plumbing).
 
     `uint8_in=True`: x blocks arrive as RAW uint8 pixels and the kernel
     normalizes on the VPU (/255 -> -mean -> /std, the normalize_images
@@ -471,7 +542,17 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
 
         for k in range(K):
             gs = base + k                   # this sub-step's global step
-            if in_kernel_rng:
+            if rng == "threefry":
+                # Reference-RNG dropout: this sub-step's key words (already
+                # replica-distinct for DP — the wrapper folds the axis index
+                # into the epoch key before splitting) drive the exact
+                # models/mlp.py bernoulli draw on the VPU. A padded tail
+                # sub-step gets zero key words — harmless, its update is
+                # lr=0-masked below.
+                m = _threefry_mask_block(m_ref[k, 0].astype(jnp.uint32),
+                                         m_ref[k, 1].astype(jnp.uint32),
+                                         block)
+            elif rng == "core":
                 # Multi-word seed: the hardware hashes (epoch_seed[,
                 # replica], step) into the stream state, so per-step streams
                 # are mixed non-linearly — no contiguous seed-range reuse
@@ -717,6 +798,7 @@ def _make_epoch_kernel(block: int, lr: float, *, in_kernel_rng: bool = True,
 
 def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
                     masks=None, interpret: bool = False,
+                    rng_impl: str = "core",
                     axis_name: str | None = None, axis_size: int = 1,
                     compute_bf16: bool = False, steps_per_iter: int = 1,
                     valid_steps: int | None = None, ring: str = "auto"):
@@ -742,6 +824,15 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     validation, weight residency) without a TPU; `epoch_sgd_reference` is
     the matching pure-JAX oracle. The default (masks=None) draws in-kernel
     from the core PRNG and is Mosaic-only.
+
+    `rng_impl='threefry'` (masks=None): dropout is drawn IN-kernel by jax's
+    threefry2x32 on the VPU — `seed` is then an (S, 2) int32 array of
+    per-step key words, and the masks are bit-for-bit
+    `dropout_mask(step_key)`, i.e. the REFERENCE RNG semantics
+    (models/mlp.py's bernoulli stream) at epoch-kernel speed instead of the
+    mask-streaming per-step kernels. Pure jnp ops: this mode composes with
+    `interpret=True`, so CI covers the whole path on CPU (the core-PRNG
+    mode cannot).
 
     `axis_size > 1` (with `axis_name`; must be called inside shard_map over
     that axis): the DDP epoch kernel — batch/xp/yp/masks are this REPLICA's
@@ -790,10 +881,28 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             f"Use the gridded per-step kernel (--kernel pallas) instead")
     nsteps = rows // block
     assert nsteps * block == rows, (rows, block)
-    in_kernel_rng = masks is None
-    if in_kernel_rng and interpret:
-        raise ValueError("the in-kernel-PRNG epoch kernel has no interpreter "
-                         "lowering; pass explicit `masks` to interpret")
+    if rng_impl not in ("core", "threefry"):
+        raise ValueError(f"rng_impl must be 'core' (TPU hardware PRNG) or "
+                         f"'threefry' (in-kernel reference RNG); got "
+                         f"{rng_impl!r}")
+    if masks is not None and rng_impl != "core":
+        raise ValueError("pass either masks= (pre-drawn) or "
+                         "rng_impl='threefry' (in-kernel draw), not both")
+    rng = "masks" if masks is not None else rng_impl
+    if rng == "core" and interpret:
+        raise ValueError("the core-PRNG epoch kernel has no interpreter "
+                         "lowering; pass explicit `masks` or "
+                         "rng_impl='threefry' to interpret")
+    if rng == "threefry":
+        seed = jnp.asarray(seed)
+        if seed.ndim != 2 or seed.shape[1] != 2 or seed.dtype not in (
+                jnp.int32, jnp.uint32):
+            raise ValueError(
+                f"rng_impl='threefry' takes per-step key words: seed must "
+                f"be an (nsteps, 2) int32/uint32 array of "
+                f"jax.random.key_data rows; got "
+                f"{seed.shape if hasattr(seed, 'shape') else seed!r} "
+                f"{seed.dtype if hasattr(seed, 'dtype') else ''}")
     dp = axis_size > 1
     if dp and axis_name is None:
         raise ValueError("epoch_fused_sgd: axis_size > 1 needs axis_name "
@@ -860,10 +969,23 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         if masks is not None:
             masks = jnp.concatenate(
                 [masks, jnp.zeros((zrows, HIDDEN1), masks.dtype)], axis=0)
+        if rng == "threefry":
+            # zero key words for the padded tail sub-steps — their masks
+            # are drawn but the update is lr=0-masked in the kernel
+            seed = jnp.concatenate(
+                [seed, jnp.zeros((pad_steps, 2), seed.dtype)], axis=0)
     uint8_in = xp.dtype == jnp.uint8
     vmem = partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     resident = lambda shape: vmem(shape, lambda i: (0, 0))  # noqa: E731
-    if in_kernel_rng:
+    if rng == "threefry":
+        if seed.shape[0] != padded_steps:
+            raise ValueError(
+                f"rng_impl='threefry' needs one key-word row per step: seed "
+                f"has {seed.shape[0]} rows for {nsteps} steps")
+        third = seed.astype(jnp.int32)
+        third_spec = pl.BlockSpec((K, 2), lambda i: (i, 0),
+                                  memory_space=pltpu.SMEM)  # per-step keys
+    elif rng == "core":
         third = jnp.asarray(seed, jnp.int32).reshape((1,))
         third_spec = pl.BlockSpec((1,), lambda i: (0,),
                                   memory_space=pltpu.SMEM)  # seed
@@ -909,7 +1031,7 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("arbitrary",))  # steps are sequential
     loss, w1, b1, w2, b2, w3 = pl.pallas_call(
-        _make_epoch_kernel(block, lr, in_kernel_rng=in_kernel_rng,
+        _make_epoch_kernel(block, lr, rng=rng,
                            uint8_in=uint8_in, axis_name=axis_name,
                            n_devices=axis_size, compute_bf16=compute_bf16,
                            steps_per_iter=K,

@@ -84,10 +84,15 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                    help="compute dtype for the train step")
     t.add_argument("--impl", choices=("threefry2x32", "rbg"),
                    default="threefry2x32",
-                   help="PRNG engine for the train key (dropout stream); "
-                        "rbg uses the TPU hardware generator — same "
-                        "Bernoulli keep distribution, different stream, "
-                        "measured 1.7x whole-step throughput (docs/PERF.md)")
+                   help="PRNG engine for the train key (dropout stream). "
+                        "threefry2x32 (default) is the reference RNG "
+                        "stream — with --kernel pallas_epoch it is drawn "
+                        "IN-kernel by the VPU cipher (bitwise "
+                        "models/mlp.py masks at epoch-kernel speed); rbg "
+                        "uses the TPU hardware generator — same Bernoulli "
+                        "keep distribution, its own stream, measured 1.7x "
+                        "whole-step throughput on the per-step kernels "
+                        "(docs/PERF.md)")
     t.add_argument("--kernel",
                    choices=("auto", "xla", "pallas", "pallas_rng",
                             "pallas_epoch"),

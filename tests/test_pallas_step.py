@@ -1040,3 +1040,151 @@ def test_dp_epoch_kernel_math_numeric_oracle(ring, n):
                                    rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(dp_losses),
                                np.asarray(losses_ref), rtol=1e-5, atol=1e-6)
+
+
+def test_threefry_cipher_and_mask_bitwise_vs_jax():
+    """The in-kernel threefry primitives ARE jax's stream: cipher outputs
+    xor-combined must equal jax.random.bits, and the mask block must equal
+    dropout_mask (models/mlp.py's bernoulli draw) bit-for-bit."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        _threefry_mask_block, dropout_mask, threefry2x32)
+
+    for seed in (0, 7, (1 << 31) + 3):
+        key = jax.random.key(seed)          # jax default impl = threefry
+        k0, k1 = (jnp.uint32(w) for w in np.asarray(
+            jax.random.key_data(key), np.uint32))
+        idx = jnp.arange(4096, dtype=jnp.uint32)
+        o0, o1 = threefry2x32(k0, k1, jnp.zeros_like(idx), idx)
+        np.testing.assert_array_equal(
+            np.asarray(o0 ^ o1),
+            np.asarray(jax.random.bits(key, (4096,), "uint32")))
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(_threefry_mask_block,
+                               static_argnums=2)(k0, k1, 256)),
+            np.asarray(dropout_mask(key, 256)))
+
+
+def test_epoch_kernel_threefry_interpret_matches_masked_bitwise():
+    """rng_impl='threefry' must reproduce the masks=vmap(dropout_mask) path
+    BIT-FOR-BIT for the same per-step keys — interpreted on CPU, so the
+    whole reference-RNG kernel path is CI-covered without hardware (the
+    core-PRNG mode never could be). Also pins K-invariance: superstep 2
+    (including the ragged zero-key tail pad at S=5) changes nothing."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        dropout_mask, epoch_fused_sgd)
+
+    S, B = 5, 32
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(S * B, seed=3)
+    subs = jax.random.split(jax.random.key(42), S)
+    keys = jax.random.key_data(subs).astype(jnp.int32)
+    masks = jax.vmap(lambda k: dropout_mask(k, B))(subs).reshape(S * B, -1)
+
+    p_tf, l_tf = epoch_fused_sgd(params, x, y, keys, 0.05, B,
+                                 rng_impl="threefry", interpret=True)
+    p_mk, l_mk = epoch_fused_sgd(params, x, y, None, 0.05, B,
+                                 masks=masks, interpret=True)
+    np.testing.assert_array_equal(np.asarray(l_tf), np.asarray(l_mk))
+    for a, b in zip(jax.tree_util.tree_leaves(p_tf),
+                    jax.tree_util.tree_leaves(p_mk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    p_k2, l_k2 = epoch_fused_sgd(params, x, y, keys, 0.05, B,
+                                 rng_impl="threefry", interpret=True,
+                                 steps_per_iter=2)
+    np.testing.assert_array_equal(np.asarray(l_k2), np.asarray(l_tf))
+    for a, b in zip(jax.tree_util.tree_leaves(p_k2),
+                    jax.tree_util.tree_leaves(p_tf)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_run_epochal_threefry_key_chain_matches_interpret_path():
+    """The scan layer routes a 2-word (threefry) train key to the in-kernel
+    reference-RNG draw using the SAME per-step key chain as the interpreted
+    masks path: replaying the chain by hand through the interpreted
+    threefry kernel reproduces make_run_fn(interpret=True) bit-for-bit."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+
+    S, B = 3, 16
+    x_all, y_all = _data(S * B, seed=11)
+    idxs = jnp.arange(S * B, dtype=jnp.int32).reshape(1, S, B)
+    run = make_run_fn(0.05, kernel="pallas_epoch", interpret=True)
+    p_a, _, l_a = run(init_mlp(jax.random.key(0)), jax.random.key(9),
+                      x_all, y_all, idxs)
+
+    _, sub = jax.random.split(jax.random.key(9))   # the body's epoch split
+    subs = jax.random.split(sub, S)
+    keys = jax.random.key_data(subs).astype(jnp.int32)
+    p_b, l_b = epoch_fused_sgd(init_mlp(jax.random.key(0)),
+                               x_all, y_all, keys, 0.05, B,
+                               rng_impl="threefry", interpret=True)
+    np.testing.assert_array_equal(np.asarray(l_a[0]), np.asarray(l_b))
+    for a, b in zip(jax.tree_util.tree_leaves(p_a),
+                    jax.tree_util.tree_leaves(p_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_epoch_kernel_threefry_validation():
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        dropout_mask, epoch_fused_sgd)
+
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(32)
+    keys = jnp.zeros((2, 2), jnp.int32)
+    with pytest.raises(ValueError, match="rng_impl"):
+        epoch_fused_sgd(params, x, y, keys, 0.01, 16, rng_impl="rbg")
+    with pytest.raises(ValueError, match="not both"):
+        epoch_fused_sgd(params, x, y, keys, 0.01, 16, rng_impl="threefry",
+                        masks=dropout_mask(jax.random.key(0), 32))
+    with pytest.raises(ValueError, match="key words"):
+        epoch_fused_sgd(params, x, y, jnp.zeros((2,), jnp.int32), 0.01, 16,
+                        rng_impl="threefry", interpret=True)
+    with pytest.raises(ValueError, match="one key-word row per step"):
+        epoch_fused_sgd(params, x, y, jnp.zeros((3, 2), jnp.int32), 0.01,
+                        16, rng_impl="threefry", interpret=True)
+    # the core-PRNG interpreter rejection now names the interpretable
+    # alternative
+    with pytest.raises(ValueError, match="threefry"):
+        epoch_fused_sgd(params, x, y, 5, 0.01, 16, interpret=True)
+
+
+@tpu_only
+def test_epoch_kernel_threefry_matches_masked_kernel_on_hardware():
+    """Mosaic lowering of the in-kernel threefry draw: identical kernel,
+    identical mask VALUES (the cipher is bit-exact and masks are only ever
+    1/keep or 0), so the Mosaic threefry run must equal the Mosaic
+    masked-kernel run BITWISE — and transitively the reference RNG."""
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+        dropout_mask, epoch_fused_sgd)
+
+    S, B = 4, 128
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(S * B, seed=6)
+    subs = jax.random.split(jax.random.key(77), S)
+    keys = jax.random.key_data(subs).astype(jnp.int32)
+    masks = jax.vmap(lambda k: dropout_mask(k, B))(subs).reshape(S * B, -1)
+    p_tf, l_tf = epoch_fused_sgd(params, x, y, keys, 0.01, B,
+                                 rng_impl="threefry")
+    p_mk, l_mk = epoch_fused_sgd(params, x, y, None, 0.01, B, masks=masks)
+    np.testing.assert_array_equal(np.asarray(l_tf), np.asarray(l_mk))
+    for a, b in zip(jax.tree_util.tree_leaves(p_tf),
+                    jax.tree_util.tree_leaves(p_mk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@tpu_only
+def test_scan_threefry_key_trains_on_hardware():
+    """The flagship reference-RNG configuration end-to-end on the chip:
+    make_run_fn(kernel='pallas_epoch') with a threefry train key (the CLI
+    default --impl) routes to the in-kernel threefry draw and trains."""
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+
+    x_all, y_all = _data(1024, seed=5)
+    idxs = jnp.asarray(np.arange(1024, dtype=np.int32)
+                       .reshape(1, 8, 128).repeat(4, 0))
+    run = make_run_fn(lr=0.1, kernel="pallas_epoch")
+    _, _, losses = run(init_mlp(jax.random.key(0)), jax.random.key(1),
+                       x_all, y_all, idxs)
+    losses = np.asarray(losses).ravel()
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.7
