@@ -47,6 +47,41 @@ from pytorch_ddp_mnist_tpu.ops.pallas_step import (  # noqa: E402
     EPOCH_KERNEL_MAX_BATCH)
 
 
+CALIBRATION_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "bench_calibration.json")
+
+
+def resolve_bench_dtype(dtype: str, kernel: str,
+                        calibration_path: str = None,
+                        n_chips: int = 1) -> str:
+    """bench's `--dtype auto`: float32 unless a committed hardware
+    calibration promotes the SINGLE-chip epoch kernel to bf16 matmuls.
+
+    The calibration file (bench_calibration.json) is written ONLY by
+    scripts/promote_epoch_dtype.py after its two-part gate passes on real
+    hardware — the bf16 epoch row must beat the f32 row in the SAME matrix
+    sweep AND a 10-epoch training run must reach accuracy parity (the same
+    gate that promoted rbg in round 2). `auto` therefore means "the fastest
+    hardware-verified semantics-equivalent dtype", never an unmeasured
+    leap: an absent/invalid/non-object file resolves to float32, and a
+    multi-chip mesh is NEVER promoted (the gate's evidence — matrix rows
+    and accuracy runs — is single-chip only; the DP ring path stays at the
+    explicit-flag-only stage until it has its own hardware evidence)."""
+    if dtype != "auto":
+        return dtype
+    if kernel == "pallas_epoch" and n_chips == 1:
+        try:
+            with open(calibration_path or CALIBRATION_PATH) as f:
+                cal = json.load(f)
+            if (isinstance(cal, dict)
+                    and cal.get("epoch_kernel_dtype") in ("float32",
+                                                          "bfloat16")):
+                return cal["epoch_kernel_dtype"]
+        except (OSError, ValueError):
+            pass
+    return "float32"
+
+
 def resolve_bench_kernel(kernel: str, dtype: str, on_tpu: bool,
                          n_chips: int, batch: int = 128,
                          unroll: int = 1) -> str:
@@ -203,13 +238,21 @@ def main(argv=None) -> None:
                         "the fused per-step Pallas kernel (real per-step "
                         "allreduce), off-TPU XLA autodiff. pallas_rng draws "
                         "dropout inside the per-step kernel (real TPU only)")
-    p.add_argument("--dtype", choices=("float32", "bfloat16"),
-                   default="float32")
+    p.add_argument("--dtype", choices=("auto", "float32", "bfloat16"),
+                   default="auto",
+                   help="auto (default): float32, unless the committed "
+                        "hardware calibration (bench_calibration.json, "
+                        "written by scripts/promote_epoch_dtype.py's "
+                        "win+accuracy-parity gate) promotes the single-chip "
+                        "epoch kernel to bf16 matmuls")
     p.add_argument("--impl", choices=("threefry2x32", "rbg"), default="rbg",
                    help="PRNG engine carried by the train key (dropout "
                         "stream); rbg (default) uses the TPU hardware "
                         "generator — measured 1.7x the whole-step rate vs "
-                        "threefry key-derivation (docs/PERF.md)")
+                        "threefry key-derivation on the per-step kernels. "
+                        "With --kernel pallas_epoch, threefry2x32 draws the "
+                        "REFERENCE RNG stream in-kernel (VPU cipher, "
+                        "bitwise models/mlp.py masks; docs/PERF.md round 4)")
     p.add_argument("--epochs", type=int, default=FUSED_EPOCHS)
     p.add_argument("--batch_size", type=int, default=128,
                    help="PER-CHIP batch (the reference flagship is 128; "
@@ -371,8 +414,13 @@ def main(argv=None) -> None:
     # runs everywhere (same fallback as the trainer CLI).
     from pytorch_ddp_mnist_tpu.parallel.wireup import on_tpu_backend
     on_tpu = on_tpu_backend()
-    a.kernel = resolve_bench_kernel(a.kernel, a.dtype, on_tpu, n_chips,
-                                    batch=a.batch_size, unroll=a.unroll)
+    # dtype 'auto' is float32 for the purposes of kernel resolution (only
+    # the resolved-pallas_epoch case can promote it, below) — breaking the
+    # kernel<->dtype resolution cycle deterministically.
+    a.kernel = resolve_bench_kernel(
+        a.kernel, "float32" if a.dtype == "auto" else a.dtype, on_tpu,
+        n_chips, batch=a.batch_size, unroll=a.unroll)
+    a.dtype = resolve_bench_dtype(a.dtype, a.kernel, n_chips=n_chips)
     if a.kernel in ("pallas_rng", "pallas_epoch") and not on_tpu:
         p.error(f"--kernel {a.kernel} needs a real TPU (the core PRNG has "
                 "no interpreter lowering)")

@@ -28,26 +28,37 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 # on any backend regardless of bench.py's auto-resolution.
 VARIANTS = [
     ("f32 / XLA / threefry (reference semantics)",
-     ["--kernel", "xla", "--impl", "threefry2x32"]),
+     ["--kernel", "xla", "--dtype", "float32", "--impl", "threefry2x32"]),
     ("f32 / Pallas / threefry",
-     ["--kernel", "pallas", "--impl", "threefry2x32"]),
+     ["--kernel", "pallas", "--dtype", "float32", "--impl", "threefry2x32"]),
     ("bf16 / XLA / threefry",
      ["--kernel", "xla", "--dtype", "bfloat16", "--impl", "threefry2x32"]),
-    ("f32 / XLA / rbg", ["--kernel", "xla", "--impl", "rbg"]),
+    ("f32 / XLA / rbg",
+     ["--kernel", "xla", "--dtype", "float32", "--impl", "rbg"]),
     ("bf16 / XLA / rbg",
      ["--kernel", "xla", "--dtype", "bfloat16", "--impl", "rbg"]),
     ("f32 / Pallas / rbg (bench default on TPU)",
-     ["--kernel", "pallas", "--impl", "rbg"]),
+     ["--kernel", "pallas", "--dtype", "float32", "--impl", "rbg"]),
     # TPU-only (core-PRNG dropout inside the kernel); FAILS on CPU hosts by
     # design — measured ~3% below the per-step default (docs/PERF.md).
-    ("f32 / Pallas / in-kernel PRNG", ["--kernel", "pallas_rng"]),
+    ("f32 / Pallas / in-kernel PRNG",
+     ["--kernel", "pallas_rng", "--dtype", "float32"]),
     # TPU-only: the whole-epoch kernel — the headline variant (weights
     # VMEM-resident across all steps, uint8 input streaming; docs/PERF.md).
     # On a 1-chip mesh this is the headline single-chip program; on
     # multi-chip meshes it takes the EXPERIMENTAL in-kernel-ring DDP path
-    # and bench.py prints a warning to stderr.
+    # and bench.py prints a warning to stderr. --dtype is explicit (like
+    # every flag here): bench's `--dtype auto` default reads the committed
+    # bf16 calibration for pallas_epoch, which would silently turn the f32
+    # rows into bf16 runs — and the promotion gate's f32 baseline with it.
     ("f32 / whole-epoch kernel, uint8 streaming (single-chip headline)",
-     ["--kernel", "pallas_epoch"]),
+     ["--kernel", "pallas_epoch", "--dtype", "float32"]),
+    # In-kernel threefry (VPU cipher): the REFERENCE RNG stream (bitwise
+    # models/mlp.py dropout) at epoch-kernel speed — measures the cost of
+    # reference RNG semantics vs the core-PRNG row above.
+    ("f32 / whole-epoch kernel / in-kernel threefry (reference RNG)",
+     ["--kernel", "pallas_epoch", "--dtype", "float32",
+      "--impl", "threefry2x32"]),
     # bf16 matmul operands inside the epoch kernel (f32 master weights +
     # accumulation): the f32 epoch kernel is MXU-bound, so this targets the
     # dominant term directly.
@@ -57,7 +68,8 @@ VARIANTS = [
     # math; amortizes the fixed per-iteration cost). Composed with bf16
     # matmuls this is the candidate fastest configuration.
     ("f32 / whole-epoch kernel / superstep 8",
-     ["--kernel", "pallas_epoch", "--superstep", "8"]),
+     ["--kernel", "pallas_epoch", "--dtype", "float32",
+      "--superstep", "8"]),
     ("bf16-matmul / whole-epoch kernel / superstep 8",
      ["--kernel", "pallas_epoch", "--dtype", "bfloat16", "--superstep", "8"]),
 ]

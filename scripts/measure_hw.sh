@@ -39,6 +39,14 @@ echo "== phase 1: variant matrix -> $OUT" >&2
 python scripts/bench_matrix.py --epochs 400 --retries 2 --out "$OUT"
 status[matrix]=$?
 
+# Informational (not a pass/fail phase): the bf16 promotion gate — writes
+# bench_calibration.json only if bf16 beats f32 in THIS matrix and the
+# 10-epoch accuracy-parity run passes; rc=1 just means "not promoted".
+echo "== phase 1b: bf16 promotion gate" >&2
+timeout 900 python scripts/promote_epoch_dtype.py --matrix "$OUT" \
+  && echo "measure_hw: bf16 PROMOTED (bench_calibration.json)" >&2 \
+  || echo "measure_hw: bf16 not promoted (gate or matrix incomplete)" >&2
+
 echo "== phase 2: superstep / bf16 sweep" >&2
 status[sweep]=0
 for ARGS in "--superstep 2" "--superstep 4" "--superstep 8" \

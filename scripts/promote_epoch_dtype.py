@@ -1,0 +1,153 @@
+"""Promote the single-chip epoch kernel's matmul dtype to bfloat16 — IFF
+the hardware evidence clears the same two-part gate that promoted rbg in
+round 2 (docs/PERF.md):
+
+  1. WIN: the bf16 epoch-kernel row must beat the f32 epoch-kernel row in
+     the SAME variant-matrix sweep (one window, one chip — no cross-session
+     number mixing);
+  2. SEMANTICS: a 10-epoch training run at each dtype must reach test
+     accuracy within --acc_tol (default 1 point) — bf16 matmuls change
+     rounding, never the training outcome, or they don't ship as a default.
+
+On success writes bench_calibration.json, which `bench.py --dtype auto`
+(the flagless default) reads to resolve the epoch kernel's dtype — so the
+driver's flagless run only ever changes behavior through a
+hardware-verified, committed artifact. Run on real TPU hardware (the
+measurement window queue, scripts/measure_hw.sh, runs it after the matrix).
+
+Usage: python scripts/promote_epoch_dtype.py --matrix bench_matrix_r04.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# EXACT headline labels (tests pin them against bench_matrix.VARIANTS): a
+# prefix match would also catch the in-kernel-threefry or superstep rows
+# and make the gate baseline depend on artifact ordering.
+F32_LABEL = "f32 / whole-epoch kernel, uint8 streaming (single-chip headline)"
+BF16_LABEL = "bf16-matmul / whole-epoch kernel, uint8 streaming"
+
+
+def check_win(rows):
+    """Stage 1 of the gate, matrix-only: (won?, reason, f32_value,
+    bf16_value). Runs BEFORE the accuracy measurements so a losing bf16 row
+    (the common case) costs zero extra hardware-window time."""
+    by_label = {r["label"]: r for r in rows}
+    f32, bf16 = by_label.get(F32_LABEL), by_label.get(BF16_LABEL)
+    if f32 is None or bf16 is None:
+        return False, "matrix is missing an epoch-kernel row", None, None
+    if f32["value"] is None or bf16["value"] is None:
+        return False, "an epoch-kernel row has no measured value", None, None
+    if bf16["value"] <= f32["value"]:
+        return False, (f"bf16 does not win: {bf16['value']:,.0f} <= "
+                       f"{f32['value']:,.0f} img/s/chip"), None, None
+    return True, (f"bf16 wins {bf16['value']:,.0f} vs {f32['value']:,.0f} "
+                  f"img/s/chip"), f32["value"], bf16["value"]
+
+
+def decide(rows, acc_f32: float, acc_bf16: float, acc_tol: float):
+    """The full gate: (promote?, reason). Separated from I/O so CI can pin
+    every branch."""
+    won, reason, _, _ = check_win(rows)
+    if not won:
+        return False, reason
+    if abs(acc_f32 - acc_bf16) > acc_tol:
+        return False, (f"accuracy parity failed: f32 {acc_f32:.4f} vs bf16 "
+                       f"{acc_bf16:.4f} (tol {acc_tol})")
+    return True, (f"{reason} with accuracy parity "
+                  f"({acc_f32:.4f}/{acc_bf16:.4f})")
+
+
+def measure_accuracy(dtype: str, epochs: int) -> float:
+    """Final test accuracy of an `epochs`-epoch single-chip epoch-kernel
+    training run (synthetic MNIST, the bench workload's data) at `dtype`."""
+    import numpy as np
+    import jax
+
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train.loop import evaluate, make_eval_step
+    from pytorch_ddp_mnist_tpu.train.scan import (epoch_batch_indices,
+                                                  make_run_fn,
+                                                  resident_images)
+
+    train = synthetic_mnist(60000, seed=0)
+    test = synthetic_mnist(10000, seed=1)
+    x_all = jax.device_put(resident_images(train.images))
+    y_all = jax.device_put(train.labels.astype(np.int32))
+    sampler = ShardedSampler(60000, num_replicas=1, rank=0, seed=42)
+    idxs = []
+    for e in range(epochs):
+        sampler.set_epoch(e)
+        idxs.append(epoch_batch_indices(sampler, 128))
+    run = make_run_fn(0.01, dtype=dtype, kernel="pallas_epoch")
+    params, _, losses = run(init_mlp(jax.random.key(0)), jax.random.key(1),
+                            x_all, y_all, jax.device_put(np.stack(idxs)))
+    assert np.isfinite(np.asarray(losses)).all()
+    from pytorch_ddp_mnist_tpu.data import normalize_images
+    val = evaluate(make_eval_step(), params,
+                   jax.numpy.asarray(normalize_images(test.images)),
+                   jax.numpy.asarray(test.labels.astype(np.int32)), 128)
+    return float(val.accuracy)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--matrix", required=True,
+                   help="variant-matrix artifact (bench_matrix.py --out)")
+    p.add_argument("--epochs", type=int, default=10,
+                   help="epochs per accuracy-parity run")
+    p.add_argument("--acc_tol", type=float, default=0.01)
+    p.add_argument("--out", default=str(
+        pathlib.Path(__file__).resolve().parent.parent
+        / "bench_calibration.json"))
+    a = p.parse_args(argv)
+
+    with open(a.matrix) as f:
+        artifact = json.load(f)
+
+    # Stage 1 (free): the matrix WIN condition — no hardware time is spent
+    # on accuracy runs unless bf16 actually won the sweep.
+    won, reason, _, _ = check_win(artifact["variants"])
+    if not won:
+        print(f"promote_epoch_dtype: {reason}", file=sys.stderr)
+        return 1
+
+    from pytorch_ddp_mnist_tpu.parallel.wireup import on_tpu_backend
+    if not on_tpu_backend():
+        print("promote_epoch_dtype: not on a TPU backend; the gate needs "
+              "real hardware", file=sys.stderr)
+        return 1
+    acc_f32 = measure_accuracy("float32", a.epochs)
+    acc_bf16 = measure_accuracy("bfloat16", a.epochs)
+    promote, reason = decide(artifact["variants"], acc_f32, acc_bf16,
+                             a.acc_tol)
+    print(f"promote_epoch_dtype: {reason}", file=sys.stderr)
+    if not promote:
+        return 1
+    with open(a.out, "w") as f:
+        json.dump({
+            "epoch_kernel_dtype": "bfloat16",
+            "evidence": {
+                "matrix": a.matrix,
+                "matrix_timestamp": artifact.get("timestamp"),
+                "acc_f32": round(acc_f32, 4),
+                "acc_bf16": round(acc_bf16, 4),
+                "epochs": a.epochs,
+                "reason": reason,
+            },
+        }, f, indent=1)
+        f.write("\n")
+    print(f"promote_epoch_dtype: wrote {a.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

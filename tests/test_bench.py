@@ -536,3 +536,92 @@ def test_ring_rejected_off_the_dp_epoch_kernel():
         env=ENV, capture_output=True, text=True, timeout=300)
     assert out.returncode != 0
     assert "--ring" in out.stderr and "pallas_epoch" in out.stderr
+
+
+def test_resolve_bench_dtype_calibration(tmp_path):
+    """--dtype auto resolves through the committed hardware calibration:
+    float32 everywhere except a pallas_epoch kernel with a valid promotion
+    file; malformed/irrelevant calibrations never change behavior."""
+    from bench import resolve_bench_dtype
+
+    assert resolve_bench_dtype("float32", "pallas_epoch") == "float32"
+    assert resolve_bench_dtype("bfloat16", "xla") == "bfloat16"
+    missing = str(tmp_path / "absent.json")
+    assert resolve_bench_dtype("auto", "pallas_epoch", missing) == "float32"
+    cal = tmp_path / "cal.json"
+    cal.write_text('{"epoch_kernel_dtype": "bfloat16"}')
+    assert resolve_bench_dtype("auto", "pallas_epoch", str(cal)) == "bfloat16"
+    # only the epoch kernel is calibrated; other kernels stay f32
+    assert resolve_bench_dtype("auto", "pallas", str(cal)) == "float32"
+    assert resolve_bench_dtype("auto", "xla", str(cal)) == "float32"
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert resolve_bench_dtype("auto", "pallas_epoch", str(bad)) == "float32"
+    weird = tmp_path / "weird.json"
+    weird.write_text('{"epoch_kernel_dtype": "fp8"}')
+    assert resolve_bench_dtype("auto", "pallas_epoch", str(weird)) == "float32"
+
+
+def test_promote_epoch_dtype_gate_logic():
+    """Every branch of the promotion gate (scripts/promote_epoch_dtype.py
+    decide()): needs both plain epoch rows measured, a bf16 WIN in the same
+    matrix, and accuracy parity — and must never read the superstep
+    composite rows."""
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "scripts" \
+        / "promote_epoch_dtype.py"
+    spec = importlib.util.spec_from_file_location("promote_epoch_dtype", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    def row(label, value):
+        return {"label": label, "value": value}
+
+    f32 = "f32 / whole-epoch kernel, uint8 streaming (single-chip headline)"
+    bf16 = "bf16-matmul / whole-epoch kernel, uint8 streaming"
+    sup_f32 = "f32 / whole-epoch kernel / superstep 8"
+    sup_bf16 = "bf16-matmul / whole-epoch kernel / superstep 8"
+
+    ok, why = mod.decide([row(f32, 36e6)], 0.99, 0.99, 0.01)
+    assert not ok and "missing" in why
+    ok, why = mod.decide([row(f32, 36e6), row(bf16, None)], 0.99, 0.99, 0.01)
+    assert not ok and "no measured value" in why
+    ok, why = mod.decide([row(f32, 36e6), row(bf16, 30e6)], 0.99, 0.99, 0.01)
+    assert not ok and "does not win" in why
+    ok, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.99, 0.90, 0.01)
+    assert not ok and "parity failed" in why
+    ok, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.991, 0.994,
+                         0.01)
+    assert ok and "wins" in why
+    # superstep composites with inflated values must not be consulted
+    ok, _ = mod.decide([row(sup_f32, 99e6), row(sup_bf16, 98e6),
+                        row(f32, 36e6), row(bf16, 30e6)], 0.99, 0.99, 0.01)
+    assert not ok
+
+
+def test_promote_gate_labels_and_matrix_explicitness():
+    """The gate's EXACT headline labels must exist in bench_matrix.VARIANTS
+    (a rename there would silently break promotion), and every matrix row
+    must carry an explicit --dtype — bench's `--dtype auto` default reads
+    the committed calibration, which would otherwise turn the f32 rows into
+    mislabeled bf16 runs after a promotion."""
+    import importlib.util
+    import pathlib
+
+    scripts = pathlib.Path(__file__).resolve().parent.parent / "scripts"
+
+    def load(name):
+        spec = importlib.util.spec_from_file_location(name,
+                                                      scripts / f"{name}.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    bm, gate = load("bench_matrix"), load("promote_epoch_dtype")
+    labels = [label for label, _ in bm.VARIANTS]
+    assert gate.F32_LABEL in labels
+    assert gate.BF16_LABEL in labels
+    for label, argv in bm.VARIANTS:
+        assert "--dtype" in argv, (label, argv)
