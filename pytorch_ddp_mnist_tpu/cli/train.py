@@ -269,7 +269,8 @@ def main(argv=None) -> int:
             n_train = min(n_train, dcfg["limit"])
         loader.sampler = ShardedSampler(n_train, num_replicas=num_processes,
                                         rank=process_index, shuffle=True,
-                                        seed=42)
+                                        seed=42,
+                                        permutation=tcfg["sampler_rng"])
     else:
         # Multi-process: rank 0 downloads (when asked) BEFORE anyone probes
         # the path, then a barrier releases the other processes to read the
@@ -303,7 +304,8 @@ def main(argv=None) -> int:
             # images to fit_cached below (no full-dataset host normalize).
             sampler = ShardedSampler(len(train), num_replicas=num_processes,
                                      rank=process_index, shuffle=True,
-                                     seed=42)
+                                     seed=42,
+                                     permutation=tcfg["sampler_rng"])
             loader = BatchLoader(normalize_images(train.images), train.labels,
                                  sampler, batch_size=local_batch)
 
@@ -369,6 +371,16 @@ def main(argv=None) -> int:
         stash["params"] = jax.tree_util.tree_map(np.asarray, state.params)
         stash["key"] = np.asarray(jax.random.key_data(state.key))
 
+    # --eval_shuffle: the reference's shuffled test loader, engine-faithful
+    # (torch-bitwise MT19937 randperm, seeded --seed + epoch since the
+    # reference's is unseeded). Only the ref-unit val_loss's batch
+    # segmentation changes; eval device work is untouched.
+    eval_perm = None
+    if tcfg["eval_shuffle"]:
+        from ..parallel.torch_rng import torch_randperm
+        n_test = len(test_labels)
+        eval_perm = lambda e: torch_randperm(n_test, tcfg["seed"] + e)  # noqa: E731
+
     from ..utils.logging import rank_zero_log
     from ..utils.profiling import trace
     log = rank_zero_log(print)
@@ -394,7 +406,8 @@ def main(argv=None) -> int:
         # Raw uint8 pixels go to HBM; the scan normalizes per gather
         # (train/scan.py resident_images — 4x less HBM than resident f32).
         sampler = ShardedSampler(n_train, num_replicas=1, rank=0,
-                                 shuffle=True, seed=42)
+                                 shuffle=True, seed=42,
+                                 permutation=tcfg["sampler_rng"])
 
         def run_fit(st, start):
             return fit_cached(st, images, y_train, sampler, x_test,
@@ -404,7 +417,8 @@ def main(argv=None) -> int:
                               kernel=tcfg["kernel"],
                               interpret=use_pallas and _pallas_interpret(),
                               fused=tcfg["fused"],
-                              log=log, epoch_hook=hook, start_epoch=start)
+                              log=log, epoch_hook=hook, start_epoch=start,
+                              eval_perm=eval_perm)
     else:
         def run_fit(st, start):
             return fit(st, loader, x_test, test_labels,
@@ -412,7 +426,8 @@ def main(argv=None) -> int:
                        batch_size=global_batch,
                        **({"lr": tcfg["lr"]} if train_step is None else {}),
                        log=log, train_step=train_step, put=put,
-                       epoch_hook=hook, start_epoch=start)
+                       epoch_hook=hook, start_epoch=start,
+                       eval_perm=eval_perm)
     state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
                                      argv)
 

@@ -118,6 +118,24 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "into LOGDIR (view in TensorBoard/XProf); restores "
                         "the timing capability the reference's ancestral "
                         "I/O-cost harness lost (SURVEY.md §5.1)")
+    t.add_argument("--sampler_rng", choices=("pcg64", "torch"),
+                   default="pcg64",
+                   help="train-shard permutation source: pcg64 (default; "
+                        "the documented fast path) or torch — the bitwise "
+                        "MT19937 randperm of torch's DistributedSampler "
+                        "(ddp_tutorial_multi_gpu.py:26-30), making every "
+                        "epoch's shard composition index-identical to a "
+                        "reference run at the same seed")
+    t.add_argument("--eval_shuffle", action="store_true",
+                   help="shuffle the eval batch segmentation per epoch like "
+                        "the reference's test DataLoader(shuffle=True) "
+                        "(ddp_tutorial_multi_gpu.py:43-47). Only the "
+                        "Σ(mean/B) ref-unit val_loss changes — mean loss "
+                        "and accuracy are order-invariant, and no extra "
+                        "device work runs. Drawn with the torch-bitwise "
+                        "MT19937 randperm seeded (--seed + epoch); the "
+                        "reference's loader is UNseeded, so parity here is "
+                        "engine-faithful determinism, not bitwise")
     t.add_argument("--cached", action="store_true",
                    help="cache the dataset in HBM and run each epoch as one "
                         "jitted lax.scan program (fastest path for datasets "
@@ -158,6 +176,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
             "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,
+            "sampler_rng": a.sampler_rng, "eval_shuffle": a.eval_shuffle,
             "dtype": a.dtype, "impl": a.impl,
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,

@@ -114,7 +114,7 @@ def make_snapshot_eval_step() -> Callable:
     return step
 
 
-def evaluate(eval_step, params, x_test, y_test, batch_size: int):
+def evaluate(eval_step, params, x_test, y_test, batch_size: int, perm=None):
     """Full-test-set eval (reference eval loop, ddp_tutorial_multi_gpu.py:
     101-114) in one device call.
 
@@ -122,24 +122,34 @@ def evaluate(eval_step, params, x_test, y_test, batch_size: int):
     the reference accumulator Σ(batch_mean/B) including its true last-batch
     size B (the reference's DataLoader yields a short final batch; here the
     per-sample losses are segmented into the same batch layout on host).
-    The reference shuffles its test loader, so the ref-unit's exact value is
-    RNG-dependent there; deterministic sequential order is used here.
-    """
+
+    The reference SHUFFLES its test loader (ddp_tutorial_multi_gpu.py:43-47),
+    so its ref-unit value is RNG-dependent; the default here is
+    deterministic sequential order. `perm` opts into the reference's
+    shuffled batch segmentation: the fetched per-sample losses are permuted
+    before segmenting — mean loss and accuracy are order-invariant, so only
+    the ref-unit's batch layout changes, exactly like the torch loader, and
+    the DEVICE work is identical either way (no re-evaluation)."""
     # jnp.asarray is a no-op for device-resident arrays; fit() hoists the
     # test set to device ONCE so repeated evaluate() calls do no H2D.
     per_sample, correct = eval_step(
         params, jnp.asarray(x_test), jnp.asarray(y_test))
-    return val_summary(per_sample, correct, batch_size)   # fetch + aggregate
+    return val_summary(per_sample, correct, batch_size,
+                       perm=perm)                         # fetch + aggregate
 
 
 def val_summary(per_sample: np.ndarray, correct: np.ndarray,
-                batch_size: int):
+                batch_size: int, perm=None):
     """Host-side aggregation of fetched per-sample eval values into
     evaluate()'s (val_loss_ref_unit, mean_loss, acc) triple — shared by the
     per-epoch path and the fused snapshot-eval replay so the printed units
-    can never drift between them."""
+    can never drift between them. `perm` (the shuffled-eval opt-in) lives
+    HERE for the same reason: both paths must segment identically.
+    `correct` stays unpermuted — accuracy is order-invariant."""
     n = per_sample.shape[0]
     per_sample = np.asarray(per_sample, np.float64)
+    if perm is not None:
+        per_sample = per_sample[np.asarray(perm)]
     val_loss_ref_unit = 0.0
     for start in range(0, n, batch_size):
         b = min(batch_size, n - start)
@@ -217,7 +227,8 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         epochs: int, batch_size: int, lr: float | None = None,
         log: Callable[[str], None] = print,
         train_step: Callable | None = None, sharding=None, put=None,
-        epoch_hook: Callable | None = None, start_epoch: int = 0) -> TrainState:
+        epoch_hook: Callable | None = None, start_epoch: int = 0,
+        eval_perm: Callable | None = None) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -266,7 +277,8 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             losses.append(loss)
             live.poll(losses)  # async bar update; never waits on the device
         losses = np.asarray(jnp.stack(losses))  # single host fetch per epoch
-        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size)
+        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size,
+                       perm=eval_perm(epoch) if eval_perm else None)
         log(epoch_summary(epoch, losses, batch_size, val,
                           time.perf_counter() - t0,
                           io_seconds=io_timer.total))

@@ -536,7 +536,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                fused: bool = False,
                log: Callable[[str], None] = print,
                epoch_hook: Callable | None = None,
-               start_epoch: int = 0) -> TrainState:
+               start_epoch: int = 0,
+               eval_perm: Callable | None = None) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
 
     `batch_size` is the GLOBAL batch (sampler shards rows per process; with a
@@ -615,7 +616,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         ps_all, corr_all = np.asarray(ps_all), np.asarray(corr_all)
         for i, epoch in enumerate(run_epochs):
             p_e = jax.tree_util.tree_map(lambda a, _i=i: a[_i], p_snaps)
-            val = val_summary(ps_all[i], corr_all[i], batch_size)
+            val = val_summary(ps_all[i], corr_all[i], batch_size,
+                              perm=eval_perm(epoch) if eval_perm else None)
             log(epoch_summary(epoch, losses[i], batch_size, val,
                               per_epoch_dt))
             if epoch_hook is not None:
@@ -635,7 +637,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
         params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
         losses = np.asarray(losses)                 # one host fetch per epoch
-        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size)
+        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size,
+                       perm=eval_perm(epoch) if eval_perm else None)
         log(epoch_summary(epoch, losses, batch_size, val,
                           time.perf_counter() - t0))
         state = TrainState(params, key)

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Unattended hardware-window runner: poll (forever, or $PDMT_WINDOW_POLL_MAX
+# probes) for the TPU backend from fresh hang-bounded subprocesses, then run
+# the full measurement queue (scripts/measure_hw.sh) and commit the results.
+#
+# This is the in-repo version of the /tmp watcher used in rounds 3-4 so the
+# pattern survives the machine: start it with nohup at the beginning of a
+# session whose tunnel is down, and the measurement queue fires the moment a
+# window opens — the single most time-critical action on a backend whose
+# outages run 8-10+ hours and whose windows can be minutes
+# (docs/PERF.md outage log).
+#
+# Usage: nohup scripts/hw_window.sh [matrix_out.json] >> /tmp/hw_window.log 2>&1 &
+#   PDMT_WINDOW_POLL_MAX   max probes before giving up (default: unlimited)
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_matrix_hw.json}"
+MAX="${PDMT_WINDOW_POLL_MAX:-0}"
+
+echo "=== hw_window start $(date -u +%H:%M:%SZ) (out=$OUT) ==="
+n=0
+while true; do
+  if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "backend UP at $(date -u +%H:%M:%SZ)"; break
+  fi
+  n=$((n + 1))
+  if ((MAX > 0 && n >= MAX)); then
+    echo "backend still down after $n probes; giving up"; exit 1
+  fi
+  echo "backend still down $(date -u +%H:%M:%SZ)"; sleep 90
+done
+
+SWEEP="${OUT%.json}_sweep.log"
+echo "hardware window opened $(date -u +%H:%M:%SZ) — automated measurement pass" > "$SWEEP"
+PDMT_WINDOW_WAIT=300 bash scripts/measure_hw.sh "$OUT" >> "$SWEEP" 2>&1
+rc=$?
+echo "measure_hw rc=$rc" >> "$SWEEP"
+git add "$OUT" bench_calibration.json "$SWEEP" 2>/dev/null
+git commit -q -m "Hardware window: automated measurement pass ($OUT)" || true
+echo "=== hw_window done rc=$rc $(date -u +%H:%M:%SZ) ==="
+exit $rc

@@ -182,3 +182,68 @@ def test_pallas_epoch_cli_guards(capsys):
         main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "100"])
     with pytest.raises(SystemExit, match="divisible by 8"):
         main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "2048"])
+
+
+def test_eval_shuffle_changes_only_ref_unit(tmp_path, capsys):
+    """--eval_shuffle reproduces the reference's shuffled test loader
+    (ddp_tutorial_multi_gpu.py:43-47): the Σ(mean/B) ref-unit val_loss gets
+    a different (deterministic) batch segmentation while mean loss and
+    accuracy — order-invariant — stay identical, run to run and vs the
+    sequential default."""
+    args = ["--limit", "512", "--batch_size", "64", "--cached",
+            "--n_epochs", "1", "--path", str(tmp_path), "--checkpoint", ""]
+    assert main(args) == 0
+    _, [plain] = _epoch_lines(capsys)
+    assert main(args + ["--eval_shuffle"]) == 0
+    _, [shuf1] = _epoch_lines(capsys)
+    assert main(args + ["--eval_shuffle"]) == 0
+    _, [shuf2] = _epoch_lines(capsys)
+
+    def parts(line):
+        val = float(re.search(r"val_loss=([0-9.]+)", line).group(1))
+        mean = float(re.search(r"mean_val=([0-9.]+)", line).group(1))
+        acc = float(re.search(r"acc=([0-9.]+)", line).group(1))
+        return val, mean, acc
+
+    vp, mp, ap = parts(plain)
+    v1, m1, a1 = parts(shuf1)
+    v2, m2, a2 = parts(shuf2)
+    assert v1 == v2 and m1 == m2 == mp and a1 == a2 == ap
+    assert v1 != vp     # a different batch segmentation of the same losses
+
+
+def test_eval_shuffle_perm_matches_torch_random_sampler():
+    """The shuffled eval's per-epoch permutation IS torch's test-loader
+    order for a seeded generator: DataLoader(shuffle=True) iterates
+    RandomSampler = torch.randperm — which torch_rng reproduces bitwise."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import RandomSampler
+
+    from pytorch_ddp_mnist_tpu.parallel.torch_rng import torch_randperm
+
+    g = torch.Generator()
+    g.manual_seed(17)
+    order = list(RandomSampler(range(10000), generator=g))
+    assert order == torch_randperm(10000, 17).tolist()
+
+
+def test_sampler_rng_torch_cli_trains_deterministically(tmp_path, capsys):
+    """--sampler_rng torch (bitwise DistributedSampler shard composition)
+    through the CLI: runs end-to-end, deterministic, and actually changes
+    the epoch's batch composition vs the pcg64 default."""
+    args = ["--limit", "512", "--batch_size", "64", "--cached",
+            "--n_epochs", "1", "--path", str(tmp_path), "--checkpoint", ""]
+    assert main(args + ["--sampler_rng", "torch"]) == 0
+    _, [a] = _epoch_lines(capsys)
+    assert main(args + ["--sampler_rng", "torch"]) == 0
+    _, [b] = _epoch_lines(capsys)
+    assert main(args) == 0
+    _, [c] = _epoch_lines(capsys)
+
+    def losses(line):   # every numeric field except wall-clock throughput
+        return (re.search(r"train_loss=([0-9.]+)", line).group(1),
+                re.search(r"val_loss=([0-9.]+)", line).group(1),
+                _mean_train(line))
+
+    assert losses(a) == losses(b)             # deterministic
+    assert _mean_train(a) != _mean_train(c)   # different shard composition

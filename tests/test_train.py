@@ -53,9 +53,9 @@ def test_fit_hoists_test_set_to_device_once(monkeypatch):
     seen = []
     real_evaluate = loop_mod.evaluate
 
-    def spy(eval_step, params, x, y, bs):
+    def spy(eval_step, params, x, y, bs, perm=None):
         seen.append((type(x), type(y)))
-        return real_evaluate(eval_step, params, x, y, bs)
+        return real_evaluate(eval_step, params, x, y, bs, perm=perm)
 
     monkeypatch.setattr(loop_mod, "evaluate", spy)
     fit(state, loader, x_test, y_test, epochs=2, lr=0.01, batch_size=64,
