@@ -47,11 +47,16 @@ timeout 900 python scripts/promote_epoch_dtype.py --matrix "$OUT" \
   && echo "measure_hw: bf16 PROMOTED (bench_calibration.json)" >&2 \
   || echo "measure_hw: bf16 not promoted (gate or matrix incomplete)" >&2
 
-echo "== phase 2: superstep / bf16 sweep" >&2
+echo "== phase 2: superstep / bf16 / batch-scaling sweep" >&2
 status[sweep]=0
-for ARGS in "--superstep 2" "--superstep 4" "--superstep 8" \
+for ARGS in "--dtype float32 --superstep 2" \
+            "--dtype float32 --superstep 4" \
+            "--dtype float32 --superstep 8" \
             "--dtype bfloat16 --superstep 2" \
-            "--dtype bfloat16 --superstep 8"; do
+            "--dtype bfloat16 --superstep 8" \
+            "--dtype float32 --batch_size 256" \
+            "--dtype float32 --batch_size 512" \
+            "--dtype float32 --batch_size 1024"; do
   echo "pallas_epoch $ARGS:" >&2
   timeout 600 python bench.py --backend_wait 120 --kernel pallas_epoch $ARGS \
     || status[sweep]=$?

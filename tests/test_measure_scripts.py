@@ -1,0 +1,35 @@
+"""The measurement-queue scripts' failure accounting (ADVICE r3): a pass
+that collected nothing must exit nonzero — a driver keying on the exit code
+can never mistake a dead-tunnel run for a complete one. The scripts probe
+the backend in subprocesses; JAX_PLATFORMS names a platform that can never
+exist, so the probe deterministically fails on ANY machine (a real backend
+name like rocm could succeed where its plugin is installed and send
+hw_window.sh down its measure-and-git-commit path)."""
+
+import os
+import pathlib
+import subprocess
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+ENV = dict(os.environ, JAX_PLATFORMS="fakeplat")
+
+
+def test_measure_hw_exits_nonzero_when_backend_never_up():
+    env = dict(ENV, PDMT_WINDOW_WAIT="1")
+    out = subprocess.run(["bash", str(REPO / "scripts" / "measure_hw.sh")],
+                         cwd=REPO, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 1
+    assert "still unavailable" in out.stderr
+
+
+def test_hw_window_gives_up_after_max_probes(tmp_path):
+    env = dict(ENV, PDMT_WINDOW_POLL_MAX="1")
+    sentinel = tmp_path / "never_written.json"
+    out = subprocess.run(["bash", str(REPO / "scripts" / "hw_window.sh"),
+                          str(sentinel)],
+                         cwd=REPO, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 1
+    assert "giving up" in out.stdout
+    assert not sentinel.exists()
