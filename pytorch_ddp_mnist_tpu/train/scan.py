@@ -258,6 +258,16 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
             # (VERDICT r3 #4; the dropout of ddp_tutorial_cpu.py:47). DP
             # replicas fold the axis index into the epoch key first, so
             # each rank draws an independent stream (SURVEY.md §7 item 4).
+            if not jax.config.jax_threefry_partitionable:
+                # the in-kernel cipher replays the PARTITIONABLE counter
+                # layout (the jax default); under the legacy layout
+                # dropout_mask's stream differs and the bitwise-parity
+                # contract would break SILENTLY — refuse instead.
+                raise ValueError(
+                    "in-kernel threefry dropout reproduces jax's "
+                    "partitionable threefry stream; this process disabled "
+                    "jax_threefry_partitionable — re-enable it (the "
+                    "default) or use --impl rbg / --kernel pallas")
             skey = sub
             if axis_size > 1:
                 skey = jax.random.fold_in(

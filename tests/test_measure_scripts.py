@@ -15,7 +15,9 @@ ENV = dict(os.environ, JAX_PLATFORMS="fakeplat")
 
 
 def test_measure_hw_exits_nonzero_when_backend_never_up():
-    env = dict(ENV, PDMT_WINDOW_WAIT="1")
+    # WAIT=0: the first failed probe always satisfies the deadline check —
+    # WAIT=1 could race the wall-clock second and sleep 60s before retrying
+    env = dict(ENV, PDMT_WINDOW_WAIT="0")
     out = subprocess.run(["bash", str(REPO / "scripts" / "measure_hw.sh")],
                          cwd=REPO, env=env, capture_output=True, text=True,
                          timeout=300)

@@ -1188,3 +1188,27 @@ def test_scan_threefry_key_trains_on_hardware():
                        x_all, y_all, idxs)
     losses = np.asarray(losses).ravel()
     assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.7
+
+
+def test_threefry_kernel_rejects_legacy_threefry_config():
+    """The in-kernel threefry replays jax's PARTITIONABLE counter layout;
+    with jax_threefry_partitionable disabled, dropout_mask's stream differs
+    and bitwise parity would break silently — the scan layer refuses by
+    name instead. (jax.random.bits itself changes under the legacy flag, so
+    no fallback could be bit-faithful to both.)"""
+    import jax as _jax
+
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+
+    x_all, y_all = _data(32, seed=0)
+    idxs = jnp.arange(32, dtype=jnp.int32).reshape(1, 2, 16)
+    run = make_run_fn(0.05, kernel="pallas_epoch")  # non-interpret: threefry
+    _jax.config.update("jax_threefry_partitionable", False)
+    try:
+        with pytest.raises(ValueError, match="partitionable"):
+            # eval_shape is enough: the guard fires at trace time, before
+            # any Mosaic compile — so this tests on CPU too
+            _jax.eval_shape(run, init_mlp(_jax.random.key(0)),
+                            _jax.random.key(1), x_all, y_all, idxs)
+    finally:
+        _jax.config.update("jax_threefry_partitionable", True)
