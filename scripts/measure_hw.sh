@@ -4,8 +4,10 @@
 # Waits for the accelerator backend to answer (the tunneled TPU drops for
 # multi-hour stretches and can HANG probes — docs/PERF.md), then runs, in
 # priority order so a short window still captures the most valuable data:
+#   0. ONE flagless headline bench (the driver's metric, ~60 s)
 #   1. the full bench variant matrix   -> $1 (default bench_matrix_hw.json)
-#   2. the superstep / bf16 combination sweep (loose bench runs)
+#      + the bf16 promotion gate (phase 1b, informational)
+#   2. the superstep / bf16 / batch-scaling sweep (loose bench runs)
 #   3. inference throughput (--mode eval)
 #   4. the Mosaic hardware test suite  (PDMT_TPU_TESTS=1)
 #
@@ -34,6 +36,13 @@ done
 echo "measure_hw: backend up at $(date -u +%H:%M:%S)" >&2
 
 declare -A status
+
+# Priority order: the most valuable datum first — a window can close in
+# minutes (docs/PERF.md outage log), and one flagless bench (~60 s) IS the
+# driver's headline measurement.
+echo "== phase 0: flagless headline bench" >&2
+timeout 600 python bench.py --backend_wait 120
+status[headline]=$?
 
 echo "== phase 1: variant matrix -> $OUT" >&2
 python scripts/bench_matrix.py --epochs 400 --retries 2 --out "$OUT"
@@ -71,7 +80,7 @@ PDMT_TPU_TESTS=1 timeout 3600 python -u -m pytest tests/test_pallas_step.py -q
 status[mosaic]=$?
 
 fail=0
-for phase in matrix sweep eval mosaic; do
+for phase in headline matrix sweep eval mosaic; do
   echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
   ((status[$phase] != 0)) && fail=1
 done

@@ -1203,6 +1203,7 @@ def test_threefry_kernel_rejects_legacy_threefry_config():
     x_all, y_all = _data(32, seed=0)
     idxs = jnp.arange(32, dtype=jnp.int32).reshape(1, 2, 16)
     run = make_run_fn(0.05, kernel="pallas_epoch")  # non-interpret: threefry
+    prev = _jax.config.jax_threefry_partitionable
     _jax.config.update("jax_threefry_partitionable", False)
     try:
         with pytest.raises(ValueError, match="partitionable"):
@@ -1211,4 +1212,4 @@ def test_threefry_kernel_rejects_legacy_threefry_config():
             _jax.eval_shape(run, init_mlp(_jax.random.key(0)),
                             _jax.random.key(1), x_all, y_all, idxs)
     finally:
-        _jax.config.update("jax_threefry_partitionable", True)
+        _jax.config.update("jax_threefry_partitionable", prev)
