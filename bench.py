@@ -72,16 +72,40 @@ def resolve_bench_dtype(dtype: str, kernel: str,
     if dtype != "auto":
         return dtype
     if kernel == "pallas_epoch" and n_chips == 1:
-        try:
-            with open(calibration_path or CALIBRATION_PATH) as f:
-                cal = json.load(f)
-            if (isinstance(cal, dict)
-                    and cal.get("epoch_kernel_dtype") in ("float32",
-                                                          "bfloat16")):
-                return cal["epoch_kernel_dtype"]
-        except (OSError, ValueError):
-            pass
+        cal = _load_calibration(calibration_path)
+        if cal.get("epoch_kernel_dtype") in ("float32", "bfloat16"):
+            return cal["epoch_kernel_dtype"]
     return "float32"
+
+
+def _load_calibration(calibration_path: str = None) -> dict:
+    """The committed calibration as a dict; {} for absent/invalid/non-object
+    files (the documented fall-back-to-defaults contract)."""
+    try:
+        with open(calibration_path or CALIBRATION_PATH) as f:
+            cal = json.load(f)
+        return cal if isinstance(cal, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def resolve_bench_superstep(superstep: int, kernel: str,
+                            calibration_path: str = None,
+                            n_chips: int = 1) -> int:
+    """bench's `--superstep 0` (auto, the default): 1 unless the committed
+    calibration promotes the single-chip epoch kernel to a larger K.
+
+    Superstep is bitwise-identical math (CI + Mosaic tests pin K==1
+    equality), so its promotion gate is WIN-in-matrix only
+    (scripts/promote_epoch_dtype.py). Same single-chip-only rule as the
+    dtype: the DP ring rejects K>1 by design."""
+    if superstep != 0:
+        return superstep
+    if kernel == "pallas_epoch" and n_chips == 1:
+        k = _load_calibration(calibration_path).get("epoch_kernel_superstep")
+        if k in (1, 2, 4, 8):
+            return k
+    return 1
 
 
 def resolve_bench_kernel(kernel: str, dtype: str, on_tpu: bool,
@@ -261,10 +285,15 @@ def main(argv=None) -> None:
                    help="PER-CHIP batch (the reference flagship is 128; "
                         "larger values measure throughput scaling — the "
                         "gridded Pallas kernel handles any size)")
-    p.add_argument("--superstep", type=int, default=1, choices=(1, 2, 4, 8),
+    p.add_argument("--superstep", type=int, default=0,
+                   choices=(0, 1, 2, 4, 8),
                    help="whole-epoch kernel only: K SGD sub-steps per grid "
                         "iteration (identical math; amortizes per-iteration "
-                        "cost). Rejected by name on per-step kernels")
+                        "cost). 0 (default) = auto: 1 unless the committed "
+                        "hardware calibration promotes the single-chip "
+                        "epoch kernel to a larger K (same win-gated "
+                        "mechanism as --dtype auto). Rejected by name on "
+                        "per-step kernels")
     p.add_argument("--ring", choices=("auto", "allgather", "reduce_scatter"),
                    default="auto",
                    help="DP epoch kernel only: in-kernel allreduce strategy "
@@ -424,6 +453,8 @@ def main(argv=None) -> None:
         a.kernel, "float32" if a.dtype == "auto" else a.dtype, on_tpu,
         n_chips, batch=a.batch_size, unroll=a.unroll)
     a.dtype = resolve_bench_dtype(a.dtype, a.kernel, n_chips=n_chips)
+    a.superstep = resolve_bench_superstep(a.superstep, a.kernel,
+                                          n_chips=n_chips)
     if a.kernel in ("pallas_rng", "pallas_epoch") and not on_tpu:
         p.error(f"--kernel {a.kernel} needs a real TPU (the core PRNG has "
                 "no interpreter lowering)")

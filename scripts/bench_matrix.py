@@ -52,18 +52,20 @@ VARIANTS = [
     # bf16 calibration for pallas_epoch, which would silently turn the f32
     # rows into bf16 runs — and the promotion gate's f32 baseline with it.
     ("f32 / whole-epoch kernel, uint8 streaming (single-chip headline)",
-     ["--kernel", "pallas_epoch", "--dtype", "float32"]),
+     ["--kernel", "pallas_epoch", "--dtype", "float32",
+      "--superstep", "1"]),
     # In-kernel threefry (VPU cipher): the REFERENCE RNG stream (bitwise
     # models/mlp.py dropout) at epoch-kernel speed — measures the cost of
     # reference RNG semantics vs the core-PRNG row above.
     ("f32 / whole-epoch kernel / in-kernel threefry (reference RNG)",
-     ["--kernel", "pallas_epoch", "--dtype", "float32",
+     ["--kernel", "pallas_epoch", "--dtype", "float32", "--superstep", "1",
       "--impl", "threefry2x32"]),
     # bf16 matmul operands inside the epoch kernel (f32 master weights +
     # accumulation): the f32 epoch kernel is MXU-bound, so this targets the
     # dominant term directly.
     ("bf16-matmul / whole-epoch kernel, uint8 streaming",
-     ["--kernel", "pallas_epoch", "--dtype", "bfloat16"]),
+     ["--kernel", "pallas_epoch", "--dtype", "bfloat16",
+      "--superstep", "1"]),
     # Grid super-stepping: K=8 SGD sub-steps per grid iteration (identical
     # math; amortizes the fixed per-iteration cost). Composed with bf16
     # matmuls this is the candidate fastest configuration.

@@ -48,13 +48,14 @@ echo "== phase 1: variant matrix -> $OUT" >&2
 python scripts/bench_matrix.py --epochs 400 --retries 2 --out "$OUT"
 status[matrix]=$?
 
-# Informational (not a pass/fail phase): the bf16 promotion gate — writes
-# bench_calibration.json only if bf16 beats f32 in THIS matrix and the
-# 10-epoch accuracy-parity run passes; rc=1 just means "not promoted".
-echo "== phase 1b: bf16 promotion gate" >&2
+# Informational (not a pass/fail phase): the config promotion gate —
+# writes bench_calibration.json only if a bf16/superstep candidate beats
+# the f32/K1 baseline in THIS matrix (bf16 winners additionally pass the
+# 10-epoch accuracy-parity run); rc=1 just means "not promoted".
+echo "== phase 1b: epoch-kernel config promotion gate" >&2
 timeout 900 python scripts/promote_epoch_dtype.py --matrix "$OUT" \
-  && echo "measure_hw: bf16 PROMOTED (bench_calibration.json)" >&2 \
-  || echo "measure_hw: bf16 not promoted (gate or matrix incomplete)" >&2
+  && echo "measure_hw: config PROMOTED (bench_calibration.json)" >&2 \
+  || echo "measure_hw: config not promoted (gate or matrix incomplete)" >&2
 
 echo "== phase 2: superstep / bf16 / batch-scaling sweep" >&2
 status[sweep]=0
@@ -63,9 +64,9 @@ for ARGS in "--dtype float32 --superstep 2" \
             "--dtype float32 --superstep 8" \
             "--dtype bfloat16 --superstep 2" \
             "--dtype bfloat16 --superstep 8" \
-            "--dtype float32 --batch_size 256" \
-            "--dtype float32 --batch_size 512" \
-            "--dtype float32 --batch_size 1024"; do
+            "--dtype float32 --superstep 1 --batch_size 256" \
+            "--dtype float32 --superstep 1 --batch_size 512" \
+            "--dtype float32 --superstep 1 --batch_size 1024"; do
   echo "pallas_epoch $ARGS:" >&2
   timeout 600 python bench.py --backend_wait 120 --kernel pallas_epoch $ARGS \
     || status[sweep]=$?

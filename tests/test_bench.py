@@ -562,11 +562,12 @@ def test_resolve_bench_dtype_calibration(tmp_path):
     assert resolve_bench_dtype("auto", "pallas_epoch", str(weird)) == "float32"
 
 
-def test_promote_epoch_dtype_gate_logic():
+def test_promote_epoch_config_gate_logic():
     """Every branch of the promotion gate (scripts/promote_epoch_dtype.py
-    decide()): needs both plain epoch rows measured, a bf16 WIN in the same
-    matrix, and accuracy parity — and must never read the superstep
-    composite rows."""
+    decide()): needs a measured f32/superstep-1 baseline, a WIN in the same
+    matrix, an accuracy-parity run ONLY for bf16 winners (superstep alone
+    is bitwise-equal math), and the best candidate — dtype x superstep —
+    lands in the calibration."""
     import importlib.util
     import pathlib
 
@@ -579,26 +580,55 @@ def test_promote_epoch_dtype_gate_logic():
     def row(label, value):
         return {"label": label, "value": value}
 
-    f32 = "f32 / whole-epoch kernel, uint8 streaming (single-chip headline)"
-    bf16 = "bf16-matmul / whole-epoch kernel, uint8 streaming"
-    sup_f32 = "f32 / whole-epoch kernel / superstep 8"
-    sup_bf16 = "bf16-matmul / whole-epoch kernel / superstep 8"
+    acc_calls = []
 
-    ok, why = mod.decide([row(f32, 36e6)], 0.99, 0.99, 0.01)
-    assert not ok and "missing" in why
-    ok, why = mod.decide([row(f32, 36e6), row(bf16, None)], 0.99, 0.99, 0.01)
-    assert not ok and "no measured value" in why
-    ok, why = mod.decide([row(f32, 36e6), row(bf16, 30e6)], 0.99, 0.99, 0.01)
-    assert not ok and "does not win" in why
-    ok, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.99, 0.90, 0.01)
-    assert not ok and "parity failed" in why
-    ok, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.991, 0.994,
-                         0.01)
-    assert ok and "wins" in why
-    # superstep composites with inflated values must not be consulted
-    ok, _ = mod.decide([row(sup_f32, 99e6), row(sup_bf16, 98e6),
-                        row(f32, 36e6), row(bf16, 30e6)], 0.99, 0.99, 0.01)
-    assert not ok
+    def acc(d, k, value=0.99):
+        acc_calls.append((d, k))
+        return value
+
+    f32, bf16 = mod.F32_LABEL, mod.BF16_LABEL
+    s8, s8b = mod.SUP_F32_LABEL, mod.SUP_BF16_LABEL
+
+    # no baseline -> no promotion, no accuracy runs
+    cal, why = mod.decide([row(bf16, 50e6)], 0.01, acc)
+    assert cal is None and "baseline" in why and not acc_calls
+    # unmeasured baseline row -> same
+    cal, why = mod.decide([row(f32, None), row(bf16, 50e6)], 0.01, acc)
+    assert cal is None and "baseline" in why and not acc_calls
+    # baseline fastest -> no promotion, no accuracy runs
+    cal, why = mod.decide([row(f32, 36e6), row(bf16, 30e6), row(s8, 35e6)],
+                          0.01, acc)
+    assert cal is None and "already fastest" in why and not acc_calls
+    # unmeasured candidates are NAMED, not silently folded into "fastest"
+    # (a flaky window must not read as a performance verdict)
+    cal, why = mod.decide([row(f32, 36e6), row(bf16, None)], 0.01, acc)
+    assert cal is None and "unmeasured" in why and not acc_calls
+
+    # superstep-only winner: promoted WITHOUT any accuracy run
+    cal, why = mod.decide([row(f32, 36e6), row(s8, 40e6)], 0.01, acc)
+    assert cal == {"epoch_kernel_dtype": "float32",
+                   "epoch_kernel_superstep": 8,
+                   "evidence": {"winner": s8, "value": 40e6,
+                                "baseline_value": 36e6}}
+    assert not acc_calls and "bitwise" in why
+
+    # bf16 winner: accuracy gate runs, parity passes -> promoted
+    cal, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.01, acc)
+    assert cal["epoch_kernel_dtype"] == "bfloat16"
+    assert cal["epoch_kernel_superstep"] == 1
+    assert acc_calls == [("float32", 1), ("bfloat16", 1)]
+    # bf16 x superstep-8 winner: the accuracy run uses the winning K
+    acc_calls.clear()
+    cal, why = mod.decide([row(f32, 36e6), row(bf16, 40e6), row(s8b, 55e6)],
+                          0.01, acc)
+    assert cal["epoch_kernel_dtype"] == "bfloat16"
+    assert cal["epoch_kernel_superstep"] == 8
+    assert acc_calls == [("float32", 1), ("bfloat16", 8)]
+    # parity failure -> no promotion
+    accs = iter([0.99, 0.90])
+    cal, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.01,
+                          lambda d, k: next(accs))
+    assert cal is None and "parity failed" in why
 
 
 def test_promote_gate_labels_and_matrix_explicitness():
@@ -621,7 +651,35 @@ def test_promote_gate_labels_and_matrix_explicitness():
 
     bm, gate = load("bench_matrix"), load("promote_epoch_dtype")
     labels = [label for label, _ in bm.VARIANTS]
-    assert gate.F32_LABEL in labels
-    assert gate.BF16_LABEL in labels
+    for lbl in (gate.F32_LABEL, gate.BF16_LABEL, gate.SUP_F32_LABEL,
+                gate.SUP_BF16_LABEL):
+        assert lbl in labels, lbl
     for label, argv in bm.VARIANTS:
         assert "--dtype" in argv, (label, argv)
+        if "pallas_epoch" in argv:
+            # --superstep 0 (auto) reads the calibration too: an epoch-
+            # kernel row without an explicit K would silently change
+            # configuration after a superstep promotion
+            assert "--superstep" in argv, (label, argv)
+
+
+def test_resolve_bench_superstep_calibration(tmp_path):
+    """--superstep 0 (auto) resolves through the calibration: 1 everywhere
+    except a single-chip pallas_epoch with a valid promoted K; explicit
+    values always pass through; junk calibrations never change behavior."""
+    from bench import resolve_bench_superstep as r
+
+    missing = str(tmp_path / "absent.json")
+    assert r(0, "pallas_epoch", missing) == 1
+    assert r(8, "pallas_epoch", missing) == 8          # explicit wins
+    assert r(1, "pallas_epoch", missing) == 1
+    cal = tmp_path / "cal.json"
+    cal.write_text('{"epoch_kernel_dtype": "float32", '
+                   '"epoch_kernel_superstep": 8}')
+    assert r(0, "pallas_epoch", str(cal)) == 8
+    assert r(0, "pallas_epoch", str(cal), n_chips=4) == 1   # DP: K>1 invalid
+    assert r(0, "pallas", str(cal)) == 1
+    assert r(0, "xla", str(cal)) == 1
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"epoch_kernel_superstep": 3}')    # not a legal K
+    assert r(0, "pallas_epoch", str(bad)) == 1
