@@ -53,31 +53,6 @@ CALIBRATION_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "bench_calibration.json")
 
 
-def resolve_bench_dtype(dtype: str, kernel: str,
-                        calibration_path: str = None,
-                        n_chips: int = 1) -> str:
-    """bench's `--dtype auto`: float32 unless a committed hardware
-    calibration promotes the SINGLE-chip epoch kernel to bf16 matmuls.
-
-    The calibration file (bench_calibration.json) is written ONLY by
-    scripts/promote_epoch_dtype.py after its two-part gate passes on real
-    hardware — the bf16 epoch row must beat the f32 row in the SAME matrix
-    sweep AND a 10-epoch training run must reach accuracy parity (the same
-    gate that promoted rbg in round 2). `auto` therefore means "the fastest
-    hardware-verified semantics-equivalent dtype", never an unmeasured
-    leap: an absent/invalid/non-object file resolves to float32, and a
-    multi-chip mesh is NEVER promoted (the gate's evidence — matrix rows
-    and accuracy runs — is single-chip only; the DP ring path stays at the
-    explicit-flag-only stage until it has its own hardware evidence)."""
-    if dtype != "auto":
-        return dtype
-    if kernel == "pallas_epoch" and n_chips == 1:
-        cal = _load_calibration(calibration_path)
-        if cal.get("epoch_kernel_dtype") in ("float32", "bfloat16"):
-            return cal["epoch_kernel_dtype"]
-    return "float32"
-
-
 def _load_calibration(calibration_path: str = None) -> dict:
     """The committed calibration as a dict; {} for absent/invalid/non-object
     files (the documented fall-back-to-defaults contract)."""
@@ -89,23 +64,40 @@ def _load_calibration(calibration_path: str = None) -> dict:
         return {}
 
 
-def resolve_bench_superstep(superstep: int, kernel: str,
-                            calibration_path: str = None,
-                            n_chips: int = 1) -> int:
-    """bench's `--superstep 0` (auto, the default): 1 unless the committed
-    calibration promotes the single-chip epoch kernel to a larger K.
+def resolve_bench_config(dtype: str, superstep: int, kernel: str,
+                         calibration_path: str = None,
+                         n_chips: int = 1) -> tuple:
+    """Resolve bench's `--dtype auto` / `--superstep 0` defaults JOINTLY
+    through the committed hardware calibration -> (dtype, superstep).
 
-    Superstep is bitwise-identical math (CI + Mosaic tests pin K==1
-    equality), so its promotion gate is WIN-in-matrix only
-    (scripts/promote_epoch_dtype.py). Same single-chip-only rule as the
-    dtype: the DP ring rejects K>1 by design."""
-    if superstep != 0:
-        return superstep
-    if kernel == "pallas_epoch" and n_chips == 1:
-        k = _load_calibration(calibration_path).get("epoch_kernel_superstep")
-        if k in (1, 2, 4, 8):
-            return k
-    return 1
+    The calibration (bench_calibration.json) is written ONLY by
+    scripts/promote_epoch_dtype.py when one of the four single-chip
+    epoch-kernel matrix rows — {f32, bf16-matmul} x {K1, K8} — beats the
+    f32/K1 baseline in the SAME sweep (bf16 winners additionally pass a
+    10-epoch accuracy-parity run; superstep alone is bitwise-equal math).
+    That gate validates a single (dtype, K) PAIR, so the auto fields adopt
+    the calibrated values only when every EXPLICITLY-set field matches the
+    pair: e.g. an explicit `--superstep 1` against a {bf16, K8}
+    calibration resolves dtype to float32, NOT bf16 — bf16/K1 was never
+    validated and may even have lost the sweep. Auto therefore means "the
+    fastest hardware-verified configuration", never a chimera of it.
+    Absent/invalid calibrations, non-epoch kernels, and multi-chip meshes
+    (the DP ring rejects K>1, and the gate's evidence is single-chip)
+    always resolve to the plain defaults (float32, 1)."""
+    out_d = dtype if dtype != "auto" else "float32"
+    out_k = superstep if superstep != 0 else 1
+    if kernel != "pallas_epoch" or n_chips != 1:
+        return out_d, out_k
+    cal = _load_calibration(calibration_path)
+    cd = cal.get("epoch_kernel_dtype")
+    ck = cal.get("epoch_kernel_superstep")
+    if cd not in ("float32", "bfloat16") or ck not in (1, 2, 4, 8):
+        return out_d, out_k
+    if dtype != "auto" and dtype != cd:
+        return out_d, out_k
+    if superstep != 0 and superstep != ck:
+        return out_d, out_k
+    return cd, ck
 
 
 def resolve_bench_kernel(kernel: str, dtype: str, on_tpu: bool,
@@ -452,9 +444,8 @@ def main(argv=None) -> None:
     a.kernel = resolve_bench_kernel(
         a.kernel, "float32" if a.dtype == "auto" else a.dtype, on_tpu,
         n_chips, batch=a.batch_size, unroll=a.unroll)
-    a.dtype = resolve_bench_dtype(a.dtype, a.kernel, n_chips=n_chips)
-    a.superstep = resolve_bench_superstep(a.superstep, a.kernel,
-                                          n_chips=n_chips)
+    a.dtype, a.superstep = resolve_bench_config(a.dtype, a.superstep,
+                                                a.kernel, n_chips=n_chips)
     if a.kernel in ("pallas_rng", "pallas_epoch") and not on_tpu:
         p.error(f"--kernel {a.kernel} needs a real TPU (the core PRNG has "
                 "no interpreter lowering)")

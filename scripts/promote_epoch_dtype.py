@@ -68,18 +68,19 @@ def pick_best(rows):
             continue
         if r["value"] > best_v:
             best_label, best_d, best_k, best_v = label, d, k, r["value"]
+    # distinguish a full-sweep verdict from an incomplete matrix on EVERY
+    # outcome — a flaky window must never read as a performance verdict,
+    # whether the baseline "won" by default or a candidate won over rows
+    # that never measured (the committed evidence records the gap too)
+    missing = (f"; NOTE {len(unmeasured)} candidate row(s) unmeasured: "
+               f"{unmeasured}" if unmeasured else "")
     if best_label == F32_LABEL:
-        # distinguish a real loss from an incomplete matrix: an operator
-        # reading "already fastest" over rows that never measured would
-        # mistake a flaky window for a performance verdict
-        missing = (f"; NOTE {len(unmeasured)} candidate row(s) unmeasured: "
-                   f"{unmeasured}" if unmeasured else "")
         return None, (f"baseline f32/superstep-1 is already fastest among "
                       f"the measured rows ({best_v:,.0f} img/s/chip)"
                       f"{missing}")
-    return ((best_label, best_d, best_k, best_v, base["value"]),
+    return ((best_label, best_d, best_k, best_v, base["value"], unmeasured),
             (f"{best_label!r} wins {best_v:,.0f} vs baseline "
-             f"{base['value']:,.0f} img/s/chip"))
+             f"{base['value']:,.0f} img/s/chip{missing}"))
 
 
 def decide(rows, acc_tol: float, measure_acc):
@@ -92,8 +93,10 @@ def decide(rows, acc_tol: float, measure_acc):
     best, reason = pick_best(rows)
     if best is None:
         return None, reason
-    label, d, k, v, base_v = best
+    label, d, k, v, base_v, unmeasured = best
     evidence = {"winner": label, "value": v, "baseline_value": base_v}
+    if unmeasured:
+        evidence["unmeasured_candidates"] = unmeasured
     if d == "bfloat16":
         acc_f32 = measure_acc("float32", 1)
         acc_b = measure_acc("bfloat16", k)

@@ -538,28 +538,49 @@ def test_ring_rejected_off_the_dp_epoch_kernel():
     assert "--ring" in out.stderr and "pallas_epoch" in out.stderr
 
 
-def test_resolve_bench_dtype_calibration(tmp_path):
-    """--dtype auto resolves through the committed hardware calibration:
-    float32 everywhere except a pallas_epoch kernel with a valid promotion
-    file; malformed/irrelevant calibrations never change behavior."""
-    from bench import resolve_bench_dtype
+def test_resolve_bench_config_calibration(tmp_path):
+    """--dtype auto / --superstep 0 resolve JOINTLY through the committed
+    calibration: the gate validates one (dtype, K) pair, so auto fields
+    adopt it only when every explicit field matches the pair — no chimera
+    configurations (e.g. bf16/K1 from a {bf16, K8} calibration, which was
+    never validated and may have lost the sweep). Junk files, non-epoch
+    kernels, and multi-chip meshes always fall back to (float32, 1)."""
+    from bench import resolve_bench_config as r
 
-    assert resolve_bench_dtype("float32", "pallas_epoch") == "float32"
-    assert resolve_bench_dtype("bfloat16", "xla") == "bfloat16"
     missing = str(tmp_path / "absent.json")
-    assert resolve_bench_dtype("auto", "pallas_epoch", missing) == "float32"
+    # explicit values pass through untouched
+    assert r("float32", 1, "pallas_epoch", missing) == ("float32", 1)
+    assert r("bfloat16", 8, "xla", missing) == ("bfloat16", 8)
+    # auto without calibration -> plain defaults
+    assert r("auto", 0, "pallas_epoch", missing) == ("float32", 1)
     cal = tmp_path / "cal.json"
-    cal.write_text('{"epoch_kernel_dtype": "bfloat16"}')
-    assert resolve_bench_dtype("auto", "pallas_epoch", str(cal)) == "bfloat16"
-    # only the epoch kernel is calibrated; other kernels stay f32
-    assert resolve_bench_dtype("auto", "pallas", str(cal)) == "float32"
-    assert resolve_bench_dtype("auto", "xla", str(cal)) == "float32"
+    cal.write_text('{"epoch_kernel_dtype": "bfloat16", '
+                   '"epoch_kernel_superstep": 8}')
+    # both auto: the validated pair applies as a unit
+    assert r("auto", 0, "pallas_epoch", str(cal)) == ("bfloat16", 8)
+    # an explicit field that CONTRADICTS the pair disables the promotion
+    # entirely (bf16/K1 and f32/K8 were not what the gate validated)
+    assert r("auto", 1, "pallas_epoch", str(cal)) == ("float32", 1)
+    assert r("float32", 0, "pallas_epoch", str(cal)) == ("float32", 1)
+    # an explicit field that MATCHES the pair keeps it
+    assert r("auto", 8, "pallas_epoch", str(cal)) == ("bfloat16", 8)
+    assert r("bfloat16", 0, "pallas_epoch", str(cal)) == ("bfloat16", 8)
+    # only the single-chip epoch kernel is calibrated
+    assert r("auto", 0, "pallas", str(cal)) == ("float32", 1)
+    assert r("auto", 0, "xla", str(cal)) == ("float32", 1)
+    assert r("auto", 0, "pallas_epoch", str(cal), n_chips=4) == \
+        ("float32", 1)
+    # junk calibrations never change behavior
     bad = tmp_path / "bad.json"
     bad.write_text("{not json")
-    assert resolve_bench_dtype("auto", "pallas_epoch", str(bad)) == "float32"
+    assert r("auto", 0, "pallas_epoch", str(bad)) == ("float32", 1)
+    notdict = tmp_path / "nd.json"
+    notdict.write_text('["bfloat16"]')
+    assert r("auto", 0, "pallas_epoch", str(notdict)) == ("float32", 1)
     weird = tmp_path / "weird.json"
-    weird.write_text('{"epoch_kernel_dtype": "fp8"}')
-    assert resolve_bench_dtype("auto", "pallas_epoch", str(weird)) == "float32"
+    weird.write_text('{"epoch_kernel_dtype": "fp8", '
+                     '"epoch_kernel_superstep": 3}')
+    assert r("auto", 0, "pallas_epoch", str(weird)) == ("float32", 1)
 
 
 def test_promote_epoch_config_gate_logic():
@@ -604,18 +625,21 @@ def test_promote_epoch_config_gate_logic():
     cal, why = mod.decide([row(f32, 36e6), row(bf16, None)], 0.01, acc)
     assert cal is None and "unmeasured" in why and not acc_calls
 
-    # superstep-only winner: promoted WITHOUT any accuracy run
+    # superstep-only winner: promoted WITHOUT any accuracy run; the two
+    # never-measured candidates are recorded in evidence AND the reason
     cal, why = mod.decide([row(f32, 36e6), row(s8, 40e6)], 0.01, acc)
     assert cal == {"epoch_kernel_dtype": "float32",
                    "epoch_kernel_superstep": 8,
                    "evidence": {"winner": s8, "value": 40e6,
-                                "baseline_value": 36e6}}
-    assert not acc_calls and "bitwise" in why
+                                "baseline_value": 36e6,
+                                "unmeasured_candidates": [bf16, s8b]}}
+    assert not acc_calls and "bitwise" in why and "unmeasured" in why
 
     # bf16 winner: accuracy gate runs, parity passes -> promoted
     cal, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.01, acc)
     assert cal["epoch_kernel_dtype"] == "bfloat16"
     assert cal["epoch_kernel_superstep"] == 1
+    assert cal["evidence"]["unmeasured_candidates"] == [s8, s8b]
     assert acc_calls == [("float32", 1), ("bfloat16", 1)]
     # bf16 x superstep-8 winner: the accuracy run uses the winning K
     acc_calls.clear()
@@ -661,25 +685,3 @@ def test_promote_gate_labels_and_matrix_explicitness():
             # kernel row without an explicit K would silently change
             # configuration after a superstep promotion
             assert "--superstep" in argv, (label, argv)
-
-
-def test_resolve_bench_superstep_calibration(tmp_path):
-    """--superstep 0 (auto) resolves through the calibration: 1 everywhere
-    except a single-chip pallas_epoch with a valid promoted K; explicit
-    values always pass through; junk calibrations never change behavior."""
-    from bench import resolve_bench_superstep as r
-
-    missing = str(tmp_path / "absent.json")
-    assert r(0, "pallas_epoch", missing) == 1
-    assert r(8, "pallas_epoch", missing) == 8          # explicit wins
-    assert r(1, "pallas_epoch", missing) == 1
-    cal = tmp_path / "cal.json"
-    cal.write_text('{"epoch_kernel_dtype": "float32", '
-                   '"epoch_kernel_superstep": 8}')
-    assert r(0, "pallas_epoch", str(cal)) == 8
-    assert r(0, "pallas_epoch", str(cal), n_chips=4) == 1   # DP: K>1 invalid
-    assert r(0, "pallas", str(cal)) == 1
-    assert r(0, "xla", str(cal)) == 1
-    bad = tmp_path / "bad.json"
-    bad.write_text('{"epoch_kernel_superstep": 3}')    # not a legal K
-    assert r(0, "pallas_epoch", str(bad)) == 1

@@ -31,14 +31,14 @@ def _args(tmp_path, ckpt, extra):
             "--checkpoint", str(ckpt)] + extra
 
 
-def _bomb_fit_cached(monkeypatch, fail_epoch=1, times=1):
-    """Wrap the real fit_cached so its FIRST `times` invocations raise a
+def _bomb(monkeypatch, module, attr, fail_epoch=1, times=1):
+    """Wrap a fit entry point so its FIRST `times` invocations raise a
     backend-style RuntimeError from the epoch hook after `fail_epoch`
     completes — the stash has recorded that epoch, exactly like a device
-    loss between epochs."""
-    from pytorch_ddp_mnist_tpu.train import scan
-
-    real = scan.fit_cached
+    loss between epochs. One helper serves both the cached (scan.fit_cached)
+    and streaming (cli.train.fit) paths so the simulated-outage contract
+    can never drift between them."""
+    real = getattr(module, attr)
     calls = {"n": 0}
 
     def flaky(*a, **kw):
@@ -57,8 +57,13 @@ def _bomb_fit_cached(monkeypatch, fail_epoch=1, times=1):
             kw["epoch_hook"] = bomb
         return real(*a, **kw)
 
-    monkeypatch.setattr(scan, "fit_cached", flaky)
+    monkeypatch.setattr(module, attr, flaky)
     return calls
+
+
+def _bomb_fit_cached(monkeypatch, fail_epoch=1, times=1):
+    from pytorch_ddp_mnist_tpu.train import scan
+    return _bomb(monkeypatch, scan, "fit_cached", fail_epoch, times)
 
 
 def test_midrun_outage_resumes_bitwise_identical(tmp_path, monkeypatch,
@@ -175,3 +180,24 @@ def test_outage_retries_rejected_by_name_with_parallel_and_fused(tmp_path):
     with pytest.raises(SystemExit, match="start_epoch"):
         main(["--start_epoch", "5", "--n_epochs", "3",
               "--path", str(tmp_path)])
+
+
+def test_midrun_outage_resumes_streaming_path(tmp_path, monkeypatch):
+    """The retry wrapper covers the STREAMING loop too (no --cached): same
+    stash/resume machinery through train.loop.fit, bitwise equal to the
+    unbroken run."""
+    from pytorch_ddp_mnist_tpu.cli import train as cli_mod
+
+    args = ["--limit", "512", "--batch_size", "64", "--lr", "0.1",
+            "--n_epochs", "3", "--path", str(tmp_path)]
+    golden = tmp_path / "golden.msgpack"
+    assert main(args + ["--checkpoint", str(golden)]) == 0
+
+    calls = _bomb(monkeypatch, cli_mod, "fit", fail_epoch=1)
+    flaky_ckpt = tmp_path / "flaky.msgpack"
+    assert main(args + ["--checkpoint", str(flaky_ckpt),
+                        "--outage_retries", "1"]) == 0
+    assert calls["n"] == 2
+    for a_, b_ in zip(jax.tree_util.tree_leaves(_params(flaky_ckpt)),
+                      jax.tree_util.tree_leaves(_params(golden))):
+        np.testing.assert_array_equal(np.asarray(a_), np.asarray(b_))
