@@ -125,3 +125,21 @@ def test_torch_permutation_default_unchanged():
     a.set_epoch(0), b.set_epoch(0)
     assert a.permutation == "pcg64"
     assert not np.array_equal(a.indices(), b.indices())
+
+
+def test_torch_randperm_fuzz_random_sizes_and_seeds():
+    """Randomized sweep (fixed meta-seed) of torch_randperm vs real torch:
+    sizes straddle tile/twist boundaries by chance rather than curation, so
+    a draw-order or block-boundary regression can't hide behind the
+    hand-picked cases."""
+    from pytorch_ddp_mnist_tpu.parallel.torch_rng import torch_randperm
+
+    meta = np.random.default_rng(2026)
+    for _ in range(25):
+        n = int(meta.integers(0, 5000))
+        seed = int(meta.integers(0, 2**63 - 1))
+        g = torch.Generator()
+        g.manual_seed(seed)
+        np.testing.assert_array_equal(
+            torch_randperm(n, seed),
+            torch.randperm(n, generator=g).numpy(), err_msg=f"{n=} {seed=}")
