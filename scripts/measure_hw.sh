@@ -8,7 +8,8 @@
 #   1. the full bench variant matrix   -> $1 (default bench_matrix_hw.json)
 #      + the bf16 promotion gate (phase 1b, informational)
 #   2. the superstep / bf16 / batch-scaling sweep (loose bench runs)
-#   3. inference throughput (--mode eval)
+#   3. inference throughput (--mode eval) + 10-epoch accuracy parity
+#      (--mode accuracy, the north-star semantics check)
 #   4. the Mosaic hardware test suite  (PDMT_TPU_TESTS=1)
 #
 # Every phase's exit status is tracked: the script exits nonzero with a
@@ -76,12 +77,16 @@ echo "== phase 3: inference throughput" >&2
 timeout 600 python bench.py --backend_wait 120 --mode eval
 status[eval]=$?
 
+echo "== phase 3b: 10-epoch accuracy parity (north-star semantics)" >&2
+timeout 900 python bench.py --backend_wait 120 --mode accuracy
+status[accuracy]=$?
+
 echo "== phase 4: Mosaic hardware suite" >&2
 PDMT_TPU_TESTS=1 timeout 3600 python -u -m pytest tests/test_pallas_step.py -q
 status[mosaic]=$?
 
 fail=0
-for phase in headline matrix sweep eval mosaic; do
+for phase in headline matrix sweep eval accuracy mosaic; do
   echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
   ((status[$phase] != 0)) && fail=1
 done

@@ -685,3 +685,21 @@ def test_promote_gate_labels_and_matrix_explicitness():
             # kernel row without an explicit K would silently change
             # configuration after a superstep promotion
             assert "--superstep" in argv, (label, argv)
+
+
+def test_accuracy_mode_contract():
+    """--mode accuracy: the north-star semantics check — final test
+    accuracy of the resolved flagless config, vs_baseline = ratio to the
+    reference-semantics config trained identically, plus the continuous
+    val-loss pair (the sensitive signal once accuracy saturates)."""
+    rec = _run(["--mode", "accuracy", "--epochs", "1"])
+    assert rec["metric"] == "mnist_1epoch_test_accuracy"
+    assert rec["unit"] == "fraction"
+    assert 0 < rec["value"] <= 1.0
+    assert rec["vs_baseline"] > 0.9      # parity with the reference config
+    assert rec["mean_val_loss"] > 0 and rec["ref_mean_val_loss"] > 0
+    # knobs accuracy mode never reads stay rejected by name
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "accuracy", "--unroll", "2"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--unroll" in out.stderr
