@@ -889,7 +889,11 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         raise ValueError("pass either masks= (pre-drawn) or "
                          "rng_impl='threefry' (in-kernel draw), not both")
     rng = "masks" if masks is not None else rng_impl
-    if rng == "core" and interpret:
+    if rng == "core" and interpret is True:
+        # (interpret=True is the PLAIN Pallas interpreter; a
+        # pltpu.InterpretParams instance selects the TPU-semantics
+        # simulator, which does model the core PRNG — and remote DMAs,
+        # see below — so it deliberately passes this check.)
         raise ValueError("the core-PRNG epoch kernel has no interpreter "
                          "lowering; pass explicit `masks` or "
                          "rng_impl='threefry' to interpret")
@@ -908,6 +912,11 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         raise ValueError("epoch_fused_sgd: axis_size > 1 needs axis_name "
                          "(the shard_map mesh axis of the DP ring)")
     if dp and interpret:
+        # (Also rejects pltpu.InterpretParams here: the TPU-semantics
+        # simulator runs the SERIAL epoch kernel fine — CI uses that — but
+        # hangs on this kernel's DP ring in the current jax; the ring
+        # protocol itself is simulator-executed by a standalone kernel in
+        # tests/test_pallas_step.py instead.)
         raise ValueError(
             "the DP epoch kernel's ICI ring allreduce (remote DMAs + "
             "cross-chip semaphores) has no interpreter lowering; interpret "

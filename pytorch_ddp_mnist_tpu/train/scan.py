@@ -235,7 +235,11 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
         else:
             xp = _gathered_x(x_all, rows, jnp.float32)
         yp = jnp.take(y_all, rows, axis=0)
-        if interpret:
+        # interpret=True -> the PLAIN interpreter (masks streamed; the
+        # seeds->mask mapping abstracted out). An InterpretParams instance
+        # instead runs the REAL kernel under the TPU-semantics simulator
+        # and falls through to the in-kernel RNG branches below.
+        if interpret is True:
             subs = jax.random.split(sub, nsteps)
             masks = jax.vmap(lambda k: dropout_mask(k, batch))(subs)
             masks = masks.reshape(nsteps * batch, -1)
@@ -279,6 +283,7 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
                     [keys, jnp.zeros((pad_steps, 2), jnp.int32)])
             params, losses = epoch_fused_sgd(
                 params, xp, yp, keys, lr, batch, rng_impl="threefry",
+                interpret=interpret,   # False, or an InterpretParams
                 axis_name=pmean_axis if axis_size > 1 else None,
                 axis_size=axis_size, compute_bf16=compute_bf16,
                 steps_per_iter=steps_per_iter, valid_steps=nsteps,
@@ -290,6 +295,7 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
                 jax.random.key_data(sub).ravel()[0], jnp.int32)
             params, losses = epoch_fused_sgd(
                 params, xp, yp, seed, lr, batch,
+                interpret=interpret,   # False, or an InterpretParams
                 axis_name=pmean_axis if axis_size > 1 else None,
                 axis_size=axis_size, compute_bf16=compute_bf16,
                 steps_per_iter=steps_per_iter, valid_steps=nsteps,

@@ -119,7 +119,7 @@ def test_eval_bench_scan_does_not_collapse():
     y = jax.device_put(split.labels.astype(np.int32))
     params = jax.device_put(init_mlp(jax.random.key(0)))
 
-    def best_of(prog, n=3):
+    def best_of(prog, n=5):
         prog(params, x, y)[0].block_until_ready()       # compile + warm
         best = float("inf")
         for _ in range(n):
@@ -128,8 +128,11 @@ def test_eval_bench_scan_does_not_collapse():
             best = min(best, time.perf_counter() - t0)
         return best
 
+    # 2.5x with best-of-5: a collapsed scan measures ~1x, so the margin
+    # still discriminates sharply while tolerating a loaded CI host
+    # inflating t1's fastest window (observed flaking at 3x/best-of-3)
     t1, t16 = best_of(make(1)), best_of(make(16))
-    assert t16 >= 3 * t1, (t1, t16)
+    assert t16 >= 2.5 * t1, (t1, t16)
 
 
 def test_kernel_auto_composes_with_bfloat16():
