@@ -1,0 +1,44 @@
+"""The ONE torch re-statement of the reference model.
+
+Both the torch-parity unit tests (tests/test_torch_parity.py) and the
+10-epoch golden-accuracy generator (scripts/golden_accuracy.py) certify
+this framework against an independent torch implementation of the
+reference trainer's model (create_model, ddp_tutorial_cpu.py:43-53:
+dropout 0.2 only after layer 1, no bias on the output layer, torch
+default Linear init). Keeping that re-statement — and the
+state_dict -> params-pytree weight-transpose convention — in one place
+means the two certifications can never silently drift onto different
+models.
+
+torch is imported lazily: the framework itself never needs it.
+"""
+
+from __future__ import annotations
+
+
+def build_reference_model(seed: int):
+    """The reference create_model graph under torch.manual_seed(seed)."""
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(seed)
+    return nn.Sequential(
+        nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
+        nn.Linear(128, 128), nn.ReLU(),
+        nn.Linear(128, 10, bias=False),
+    )
+
+
+def params_from_torch(model):
+    """Torch state_dict -> the framework's params pytree, weights
+    transposed to the (fan_in, fan_out) `x @ w` layout of models/mlp.py."""
+    import jax.numpy as jnp
+
+    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    return {
+        "fc1": {"w": jnp.asarray(sd["0.weight"].T),
+                "b": jnp.asarray(sd["0.bias"])},
+        "fc2": {"w": jnp.asarray(sd["3.weight"].T),
+                "b": jnp.asarray(sd["3.bias"])},
+        "fc3": {"w": jnp.asarray(sd["5.weight"].T)},
+    }

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Regenerate the 10-epoch accuracy-parity golden artifact.
+
+The north-star acceptance (BASELINE.json; SURVEY.md §4 item 1) is
+"identical 10-epoch test accuracy" vs the reference trainer
+(/root/reference/ddp_tutorial_multi_gpu.py:100-116, final accuracy :127).
+This script backs that claim with a checked-in artifact instead of a
+30-step unit test: it trains the reference workload END-TO-END, twice —
+
+  * in this framework (xla kernel, float32, threefry dropout: the
+    reference-semantics configuration), and
+  * in an independent torch re-statement of the reference model + loop
+    (tests/test_torch_parity.py's model, extended to full training with
+    dropout ACTIVE),
+
+from the SAME initial weights (torch's init, exported), on the SAME data
+(the deterministic synthetic MNIST stand-in — this environment is
+zero-egress; pass --data_root to use real IDX files) in the SAME batch
+order (ShardedSampler, seed 42).  Dropout masks are each side's native RNG
+stream — exactly the reference's own situation across two seeds — so the
+expected accuracy gap is run-to-run mask noise.  The script MEASURES that
+noise by training torch twice more with different dropout seeds, then
+asserts
+
+    |acc_framework - acc_torch| <= max(NOISE_MULT * torch_spread, ACC_FLOOR)
+
+and the analogous bound on mean val loss.  Writes the full per-epoch
+curves + verdict to --out (committed as docs/golden_accuracy.json) and
+exits nonzero on failure, so CI and a human get the same judgement.
+
+Usage:
+    python scripts/golden_accuracy.py                 # the 10-epoch artifact
+    python scripts/golden_accuracy.py --epochs 1 --train_n 4096 \
+        --test_n 1024 --out /tmp/golden_quick.json    # smoke (tests use this)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force the CPU backend BEFORE any framework import touches jax: the session
+# may have a (possibly hanging, tunneled) TPU backend pre-registered at
+# interpreter startup, and env vars alone don't drop it (tests/conftest.py
+# documents the same dance). The golden run is a CPU artifact by design.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+    clear_backends()
+except Exception:
+    pass
+
+# Gap thresholds: the accuracy bound is NOISE_MULT x the measured torch
+# run-to-run spread, floored at max(ACC_FLOOR, ACC_FLOOR_SAMPLES/test_n) —
+# the absolute floor covers the saturated regime (two-run spread can be ~0
+# when every run lands on the same handful of residual errors) and the
+# sample floor covers small test sets, where one flipped prediction moves
+# accuracy by 1/test_n and a two-run spread badly underestimates the true
+# run-to-run sigma. The val-loss ratio bound is fixed — loss is the
+# continuous, sensitive signal either way.
+NOISE_MULT = 3.0
+ACC_FLOOR = 0.004
+ACC_FLOOR_SAMPLES = 8.0
+LOSS_RATIO_BOUND = 0.05
+
+
+# The single shared torch re-statement of the reference model + weight
+# conversion (also used by tests/test_torch_parity.py — one statement, so
+# the golden artifact and the parity unit tests can never certify against
+# different models).
+from pytorch_ddp_mnist_tpu.utils.torch_ref import (build_reference_model,
+                                                   params_from_torch)
+
+
+def _torch_modules():
+    import torch
+    import torch.nn.functional as F
+    return torch, None, F
+
+
+def shared_batch_indices(n_train: int, epochs: int, batch: int) -> np.ndarray:
+    """(E, nbatches, batch) int32 — the flagship sampler order (seed 42,
+    reshuffled per epoch), identical for both trainers."""
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train.scan import epoch_batch_indices
+    sampler = ShardedSampler(n_train, num_replicas=1, rank=0, shuffle=True,
+                             seed=42)
+    idxs = []
+    for e in range(epochs):
+        sampler.set_epoch(e)
+        idxs.append(epoch_batch_indices(sampler, batch))
+    return np.stack(idxs)
+
+
+def train_torch(init_seed: int, dropout_seed: int, x_train: np.ndarray,
+                y_train: np.ndarray, idxs: np.ndarray, x_test: np.ndarray,
+                y_test: np.ndarray, lr: float) -> dict:
+    """One full torch training run (dropout ACTIVE — the reference's
+    nn.Dropout draws from torch's global CPU RNG, ddp_tutorial_cpu.py:47),
+    evaluated on the full test set after every epoch."""
+    torch, _, F = _torch_modules()
+    model = build_reference_model(init_seed)
+    torch.manual_seed(dropout_seed)  # the dropout stream, separate from init
+    opt = torch.optim.SGD(model.parameters(), lr=lr)
+    xt = torch.tensor(x_test)
+    yt = torch.tensor(y_test.astype(np.int64))
+    curve = []
+    for epoch_idx in idxs:
+        model.train()
+        for b in epoch_idx:
+            xb = torch.tensor(x_train[b])
+            yb = torch.tensor(y_train[b].astype(np.int64))
+            opt.zero_grad()
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            opt.step()
+        model.eval()
+        with torch.no_grad():
+            logits = model(xt)
+            per_sample = F.cross_entropy(logits, yt, reduction="none")
+            acc = (logits.argmax(1) == yt).float().mean()
+        curve.append({"mean_val_loss": float(per_sample.mean()),
+                      "accuracy": float(acc)})
+    return {"init_seed": init_seed, "dropout_seed": dropout_seed,
+            "curve": curve, "final_accuracy": curve[-1]["accuracy"],
+            "final_mean_val_loss": curve[-1]["mean_val_loss"]}
+
+
+def train_framework(params0, x_train_u8: np.ndarray, y_train: np.ndarray,
+                    idxs: np.ndarray, x_test: np.ndarray, y_test: np.ndarray,
+                    lr: float) -> dict:
+    """The framework run: reference-semantics config (xla kernel, float32,
+    threefry dropout stream), whole run as one fused program with per-epoch
+    params snapshots, then one vmapped eval over the snapshots."""
+    import jax
+    import jax.numpy as jnp
+    from pytorch_ddp_mnist_tpu.train.loop import (make_snapshot_eval_step,
+                                                  val_summary)
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn, resident_images
+
+    run = make_run_fn(lr, dtype="float32", kernel="xla", snapshots=True)
+    _, _, losses, (p_snaps, _) = run(
+        params0, jax.random.key(1, impl="threefry2x32"),
+        jax.device_put(resident_images(x_train_u8)),
+        jax.device_put(y_train.astype(np.int32)), jax.device_put(idxs))
+    assert np.isfinite(np.asarray(losses)).all(), "non-finite training loss"
+    per_sample, correct = make_snapshot_eval_step()(
+        p_snaps, jnp.asarray(x_test), jnp.asarray(y_test.astype(np.int32)))
+    per_sample, correct = np.asarray(per_sample), np.asarray(correct)
+    curve = []
+    for e in range(per_sample.shape[0]):
+        _, mean_loss, acc = val_summary(per_sample[e], correct[e],
+                                        batch_size=idxs.shape[-1])
+        curve.append({"mean_val_loss": mean_loss, "accuracy": acc})
+    return {"impl": "threefry2x32", "kernel": "xla", "dtype": "float32",
+            "curve": curve, "final_accuracy": curve[-1]["accuracy"],
+            "final_mean_val_loss": curve[-1]["mean_val_loss"]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--train_n", type=int, default=60000)
+    ap.add_argument("--test_n", type=int, default=10000)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--init_seed", type=int, default=7)
+    ap.add_argument("--dropout_seeds", type=int, nargs=3,
+                    default=(1234, 5678, 91011),
+                    help="torch dropout streams: run A (the comparison run) "
+                         "+ two noise-estimation reruns")
+    ap.add_argument("--data_root", default=None,
+                    help="directory with real MNIST IDX files; default: the "
+                         "deterministic synthetic stand-in (zero-egress)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "golden_accuracy.json"))
+    a = ap.parse_args(argv)
+
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    if a.data_root:
+        from pytorch_ddp_mnist_tpu.data.mnist import load_mnist
+        train, test = load_mnist(a.data_root, True), load_mnist(a.data_root, False)
+        if train is None or test is None:
+            raise SystemExit(f"--data_root {a.data_root}: IDX files not found")
+        data_source = "mnist_idx"
+    else:
+        train = synthetic_mnist(a.train_n, seed=0)
+        test = synthetic_mnist(a.test_n, seed=1)
+        data_source = "synthetic"
+    x_train = normalize_images(train.images)
+    x_test = normalize_images(test.images)
+    idxs = shared_batch_indices(len(train.images), a.epochs, a.batch)
+
+    print(f"[golden] torch runs: 1 comparison + 2 noise "
+          f"({len(train.images)} train rows, {a.epochs} epochs)", flush=True)
+    torch_runs = [train_torch(a.init_seed, ds, x_train, train.labels, idxs,
+                              x_test, test.labels, a.lr)
+                  for ds in a.dropout_seeds]
+    print("[golden] framework run", flush=True)
+    fw = train_framework(params_from_torch(build_reference_model(a.init_seed)),
+                         train.images, train.labels, idxs, x_test,
+                         test.labels, a.lr)
+
+    accs = [r["final_accuracy"] for r in torch_runs]
+    losses = [r["final_mean_val_loss"] for r in torch_runs]
+    noise_acc = max(accs) - min(accs)
+    acc_bound = max(NOISE_MULT * noise_acc, ACC_FLOOR,
+                    ACC_FLOOR_SAMPLES / len(test.images))
+    acc_gap = abs(fw["final_accuracy"] - accs[0])
+    loss_ratio = abs(fw["final_mean_val_loss"] - losses[0]) / max(losses[0], 1e-9)
+    ok = acc_gap <= acc_bound and loss_ratio <= LOSS_RATIO_BOUND
+
+    artifact = {
+        "what": "10-epoch accuracy-parity golden run: this framework vs an "
+                "independent torch re-statement of the reference trainer, "
+                "same init/data/batch-order, native dropout streams",
+        "reference": "ddp_tutorial_multi_gpu.py:100-116 (eval loop), :127 "
+                     "(final accuracy print)",
+        "config": {"epochs": a.epochs, "batch": a.batch, "lr": a.lr,
+                   "train_n": len(train.images), "test_n": len(test.images),
+                   "data": data_source, "sampler_seed": 42,
+                   "init_seed": a.init_seed},
+        "torch_runs": torch_runs,
+        "framework_run": fw,
+        "verdict": {
+            "framework_final_accuracy": fw["final_accuracy"],
+            "torch_final_accuracy": accs[0],
+            "accuracy_gap": round(acc_gap, 6),
+            "torch_run_to_run_spread": round(noise_acc, 6),
+            "accuracy_bound": round(acc_bound, 6),
+            "val_loss_ratio_gap": round(loss_ratio, 6),
+            "val_loss_ratio_bound": LOSS_RATIO_BOUND,
+            "pass": ok,
+        },
+    }
+    with open(a.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    v = artifact["verdict"]
+    print(f"[golden] framework acc={v['framework_final_accuracy']:.4f} "
+          f"torch acc={v['torch_final_accuracy']:.4f} "
+          f"gap={v['accuracy_gap']:.4f} (bound {v['accuracy_bound']:.4f}, "
+          f"torch spread {v['torch_run_to_run_spread']:.4f}) "
+          f"loss_ratio={v['val_loss_ratio_gap']:.4f} -> "
+          f"{'PASS' if ok else 'FAIL'}")
+    print(f"[golden] wrote {a.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

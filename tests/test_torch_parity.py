@@ -28,6 +28,11 @@ import jax.numpy as jnp  # noqa: E402
 from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist  # noqa: E402
 from pytorch_ddp_mnist_tpu.models import mlp_apply  # noqa: E402
 from pytorch_ddp_mnist_tpu.ops import cross_entropy, sgd_step  # noqa: E402
+# the ONE shared torch re-statement of the reference model (also drives
+# scripts/golden_accuracy.py — a drift here would desynchronize the golden
+# artifact from these unit tests, so both import the same statement)
+from pytorch_ddp_mnist_tpu.utils.torch_ref import (  # noqa: E402
+    build_reference_model, params_from_torch)
 
 STEPS = 30
 BATCH = 128
@@ -35,25 +40,10 @@ LR = 0.01
 
 
 def _torch_model() -> nn.Sequential:
-    # The reference create_model graph (ddp_tutorial_cpu.py:45-51): dropout
-    # only after layer 1, no bias on the output layer.
-    torch.manual_seed(7)
-    return nn.Sequential(
-        nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
-        nn.Linear(128, 128), nn.ReLU(),
-        nn.Linear(128, 10, bias=False),
-    )
+    return build_reference_model(7)
 
 
-def _params_from_torch(model: nn.Sequential):
-    """Torch state_dict -> our params pytree (weights transposed to the
-    (fan_in, fan_out) x @ w layout models/mlp.py uses)."""
-    sd = {k: v.detach().numpy() for k, v in model.state_dict().items()}
-    return {
-        "fc1": {"w": jnp.asarray(sd["0.weight"].T), "b": jnp.asarray(sd["0.bias"])},
-        "fc2": {"w": jnp.asarray(sd["3.weight"].T), "b": jnp.asarray(sd["3.bias"])},
-        "fc3": {"w": jnp.asarray(sd["5.weight"].T)},
-    }
+_params_from_torch = params_from_torch
 
 
 def _data():
