@@ -911,16 +911,22 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
     if dp and axis_name is None:
         raise ValueError("epoch_fused_sgd: axis_size > 1 needs axis_name "
                          "(the shard_map mesh axis of the DP ring)")
-    if dp and interpret:
-        # (Also rejects pltpu.InterpretParams here: the TPU-semantics
-        # simulator runs the SERIAL epoch kernel fine — CI uses that — but
-        # hangs on this kernel's DP ring in the current jax; the ring
-        # protocol itself is simulator-executed by a standalone kernel in
-        # tests/test_pallas_step.py instead.)
+    if dp and interpret is True:
+        # The PLAIN Pallas interpreter has no lowering for remote DMAs /
+        # cross-chip semaphores. A pltpu.InterpretParams instance passes:
+        # the TPU-semantics simulator models both, and CI executes the
+        # real DP ring kernel under it (tests/test_pallas_step.py).
+        # Caveat (the diagnosed round-4 "hang"): the simulator blocks one
+        # host thread per live kernel, and the ring's entry barrier needs
+        # ALL replicas' kernels live at once — above ~4 concurrent
+        # kernels a small (1-core) CI host starves the pool and the run
+        # deadlocks at ~0% CPU. Callers keep simulator execution to <=4
+        # devices there; larger meshes stay trace-validated.
         raise ValueError(
             "the DP epoch kernel's ICI ring allreduce (remote DMAs + "
-            "cross-chip semaphores) has no interpreter lowering; interpret "
-            "the n=1 degenerate or use kernel='pallas' for interpreted DP")
+            "cross-chip semaphores) has no plain-interpreter lowering; "
+            "pass interpret=pltpu.InterpretParams() (the TPU-semantics "
+            "simulator) or use kernel='pallas' for interpreted DP")
     if ring not in ("auto", "allgather", "reduce_scatter"):
         raise ValueError(f"ring must be 'auto', 'allgather' or "
                          f"'reduce_scatter'; got {ring!r}")

@@ -463,11 +463,15 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
             raise ValueError(
                 "kernel 'pallas_epoch' has no per-step scan to unroll; drop "
                 "unroll or use kernel='pallas'")
-        if interpret and n_dev > 1:
+        if interpret is True and n_dev > 1:
+            # An InterpretParams instance passes: the TPU-semantics
+            # simulator models the ring's remote DMAs + semaphores, and CI
+            # executes the real DP kernel under it (test_pallas_step.py).
             raise ValueError(
                 "kernel 'pallas_epoch' on a multi-device mesh uses ICI "
-                "remote DMAs with no interpreter lowering; interpret the "
-                "1-device mesh or use kernel='pallas' for interpreted DP")
+                "remote DMAs with no plain-interpreter lowering; pass "
+                "interpret=pltpu.InterpretParams() (TPU-semantics "
+                "simulator) or use kernel='pallas' for interpreted DP")
         # No mesh-size cap: epoch_fused_sgd's ring='auto' picks the
         # all-gather ring up to EPOCH_KERNEL_MAX_DEVICES replicas and the
         # near-constant-VMEM reduce-scatter ring beyond it.

@@ -1221,9 +1221,9 @@ def test_epoch_kernel_executes_under_tpu_semantics_simulator():
     TPU-semantics simulator (pltpu.InterpretParams), and bitwise equal to
     the plain-interpreter masked run of the same keys. This runs the exact
     code Mosaic compiles (not the masks-abstracted CI variant), so kernel
-    logic regressions surface here without a chip. (The DP ring hangs
-    under the simulator in current jax — it is rejected by name there and
-    pinned by the protocol test below plus the numeric oracle.)"""
+    logic regressions surface here without a chip. (The DP ring executes
+    under the simulator too, at <=4 devices — see
+    test_dp_epoch_kernel_executes_under_tpu_semantics_simulator.)"""
     from jax.experimental.pallas import tpu as pltpu
 
     from pytorch_ddp_mnist_tpu.ops.pallas_step import (dropout_mask,
@@ -1337,6 +1337,140 @@ def test_ring_protocol_executes_under_tpu_semantics_simulator():
         np.testing.assert_array_equal(out[d], out[0])
         for s in range(S):
             np.testing.assert_allclose(out[d, s], expect[s])
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("ring,n", [("allgather", 2), ("reduce_scatter", 2),
+                                    ("allgather", 4), ("reduce_scatter", 4)])
+def test_dp_epoch_kernel_executes_under_tpu_semantics_simulator(ring, n):
+    """The REAL `_make_epoch_kernel` DP branch — entry barrier, per-step
+    two-neighbor handshake, ring remote DMAs, fixed-order mean, resident-
+    weight SGD — EXECUTED end-to-end on the virtual CPU mesh by the
+    TPU-semantics simulator (VERDICT r4 #4: previously only shape-traced;
+    the round-4 hang does not reproduce under current jax). Two pins:
+
+    1. every replica's returned weights are BITWISE identical across the
+       mesh — the lockstep invariant on the SHIPPED kernel, not a
+       protocol re-statement;
+    2. final params match the serial oracle (`epoch_sgd_reference` on the
+       equivalent global batch with the same per-replica threefry masks)
+       to f32 summation-order tolerance, and the pmean'd losses match the
+       global-batch losses.
+    """
+    import jax as _jax
+
+    if _jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+    if _jax.default_backend() != "cpu":
+        pytest.skip("oracle tolerances are CPU-calibrated")
+
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (dropout_mask,
+                                                       epoch_fused_sgd,
+                                                       epoch_sgd_reference)
+
+    S, B, lr = 3, 16, 0.05
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    params0 = init_mlp(jax.random.key(0))
+    rng = np.random.default_rng(5)
+    # replica-major layout: x_rep[d] is replica d's epoch (S*B rows)
+    x_rep = rng.normal(size=(n, S * B, 784)).astype(np.float32)
+    y_rep = rng.integers(0, 10, size=(n, S * B)).astype(np.int32)
+    subs = jax.random.split(jax.random.key(11), n * S)   # distinct streams
+    keys_rep = jax.random.key_data(subs).astype(jnp.int32).reshape(n, S, 2)
+
+    def shard_fn(params, xs, ys, ks):
+        p2, losses = epoch_fused_sgd(
+            params, xs, ys, ks, lr, B, rng_impl="threefry",
+            axis_name="dp", axis_size=n, ring=ring,
+            interpret=pltpu.InterpretParams())
+        # leading length-1 axis per leaf -> out_specs P('dp') stacks the
+        # replicas, exposing each device's resident weights for the
+        # bitwise lockstep check
+        return jax.tree_util.tree_map(lambda a: a[None], p2), losses[None]
+
+    f = jax.jit(shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(), P("dp"), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp")), check_vma=False))
+    p_stack, losses = f(params0, x_rep.reshape(n * S * B, 784),
+                        y_rep.reshape(n * S * B),
+                        jnp.asarray(keys_rep.reshape(n * S, 2)))
+
+    # 1. bitwise lockstep across the mesh
+    for leaf in jax.tree_util.tree_leaves(p_stack):
+        arr = np.asarray(leaf)
+        for d in range(1, n):
+            np.testing.assert_array_equal(arr[d], arr[0])
+
+    # 2. serial oracle on the global batch: step s trains on the
+    # concatenation of every replica's step-s block, with each replica's
+    # in-kernel threefry mask (bit-equal to dropout_mask of the same key
+    # words — pinned by test_threefry_cipher_and_mask_bitwise_vs_jax)
+    x_glob = np.concatenate(
+        [x_rep[:, s * B:(s + 1) * B].reshape(n * B, 784) for s in range(S)])
+    y_glob = np.concatenate(
+        [y_rep[:, s * B:(s + 1) * B].reshape(n * B) for s in range(S)])
+    m_glob = np.concatenate(
+        [np.concatenate([np.asarray(dropout_mask(subs[d * S + s], B))
+                         for d in range(n)]) for s in range(S)])
+    p_ref, losses_ref = epoch_sgd_reference(
+        params0, jnp.asarray(x_glob), jnp.asarray(y_glob),
+        jnp.asarray(m_glob), lr, n * B)
+    p_dev0 = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], p_stack)
+    for a, b in zip(jax.tree_util.tree_leaves(p_dev0),
+                    jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(losses).mean(0),
+                               np.asarray(losses_ref), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.integration
+def test_dp_run_fn_epoch_kernel_executes_under_simulator():
+    """The SCAN-layer DP wrapper (make_dp_run_fn, kernel='pallas_epoch')
+    with interpret=pltpu.InterpretParams() EXECUTES the real ring kernel
+    over the mesh — the full fused multi-epoch program with snapshots and
+    pmean'd losses — instead of being rejected or shape-traced. Pins the
+    wrapper plumbing (key fold-in, index sharding, InterpretParams
+    threading) end-to-end off-hardware.
+
+    4-device sub-mesh, not the full CI mesh: the simulator runs each
+    device's kernel on a blocking thread, and the ring's entry barrier
+    needs every replica's kernel LIVE at once — above ~4 concurrent
+    kernels this 1-core CI host starves the pool and the run deadlocks
+    (the diagnosed round-4 'hang'; see epoch_fused_sgd's guard note).
+    The 8-device program stays trace-validated (dryrun_multichip)."""
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import Mesh
+
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
+
+    n = 4
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    E, S, B = 2, 2, 8
+    G = S * B * n
+    x_all, y_all = _data(G, seed=3)
+    idxs = jnp.arange(E * S * B * n, dtype=jnp.int32).reshape(
+        E, S, B * n) % G
+    run = make_dp_run_fn(mesh, lr=0.05, kernel="pallas_epoch",
+                         interpret=pltpu.InterpretParams(), snapshots=True)
+    p2, _, losses, (p_snaps, _) = run(
+        init_mlp(jax.random.key(0)), jax.random.key(9), x_all, y_all, idxs)
+    losses = np.asarray(losses)
+    assert losses.shape == (E, S) and np.isfinite(losses).all()
+    # training moved the weights, and the per-epoch snapshots end at the
+    # final params
+    assert not np.allclose(np.asarray(p2["fc1"]["w"]),
+                           np.asarray(init_mlp(jax.random.key(0))["fc1"]["w"]))
+    for leaf, snap in zip(jax.tree_util.tree_leaves(p2),
+                          jax.tree_util.tree_leaves(p_snaps)):
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.asarray(snap)[-1])
 
 
 def test_run_epochal_executes_under_tpu_semantics_simulator():
