@@ -2,10 +2,18 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test bench native clean convert
+.PHONY: test test-fast test-integration bench native clean convert
 
+# BOTH tiers — the committed way to run everything (-m "" overrides the
+# fast-tier default addopts in pyproject.toml).
 test:
+	$(PY) -m pytest tests/ -m "" -q
+
+test-fast:
 	$(PY) -m pytest tests/ -q
+
+test-integration:
+	$(PY) -m pytest tests/ -m integration -q
 
 bench:
 	$(PY) bench.py
