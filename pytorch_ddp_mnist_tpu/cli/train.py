@@ -51,7 +51,9 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
     import os
 
     from ..parallel.wireup import (BackendUnavailableError,
-                                   BackendWedgedError, backend_wait_env,
+                                   BackendWedgedError,
+                                   _subprocess_backend_healthy,
+                                   backend_wait_env, looks_like_backend_loss,
                                    wait_for_backend)
 
     retries = tcfg["outage_retries"]
@@ -63,6 +65,15 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
                 return run_fit(state, start)
         except RuntimeError as e:
             if attempt >= retries:
+                raise
+            # Outage vs program error (ADVICE r4): a deterministic failure
+            # (XLA shape/compile error, NaN guard) on a healthy backend
+            # would just burn every retry re-hitting the same error before
+            # surfacing. Retry only when the error carries a backend-loss
+            # signature, or — for unrecognized messages — when a fresh
+            # out-of-process probe confirms the backend is actually down.
+            if not looks_like_backend_loss(e) and \
+                    _subprocess_backend_healthy(30.0):
                 raise
             attempt += 1
             print(f"[outage] training interrupted mid-run: {e}; waiting for "
@@ -82,6 +93,11 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
                       f"client is wedged; re-exec'ing with --resume {ckpt} "
                       f"--start_epoch {stash['epoch'] + 1}",
                       file=sys.stderr, flush=True)
+                # execv replaces the process without flushing Python's
+                # buffers: under nohup/tee (block-buffered stdout — the
+                # outage workflow) unflushed epoch lines would vanish here.
+                sys.stdout.flush()
+                sys.stderr.flush()
                 os.execv(sys.executable, [
                     sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train",
                     *sys.argv[1:], "--resume", ckpt,
@@ -314,23 +330,39 @@ def main(argv=None) -> int:
     # of the TRAIN key, i.e. the dropout stream.
     state = TrainState(init_mlp(jax.random.key(tcfg["seed"])),
                        jax.random.key(tcfg["seed"] + 1, impl=tcfg["impl"]))
+    # Sidecar lifetime (ADVICE r4): the (checkpoint, .rng.npz) pair must
+    # survive until the resumed run actually OVERWRITES that checkpoint —
+    # deleting at load time would let a resume that dies before its first
+    # save strand the next manual --resume on the --seed key chain. The
+    # pair is consumed by _consume_sidecar below, at the first save to the
+    # same path; a sidecar paired with a checkpoint this run never writes
+    # to stays on disk, still correctly paired.
+    import os
+    sidecar_box = {"sidecar": None, "ckpt": None}
+
+    def _consume_sidecar(saved_path: str) -> None:
+        if (sidecar_box["sidecar"]
+                and os.path.abspath(saved_path)
+                == os.path.abspath(sidecar_box["ckpt"])):
+            try:
+                os.remove(sidecar_box["sidecar"])
+            except FileNotFoundError:
+                pass
+            sidecar_box["sidecar"] = None
+
     if tcfg["resume"]:
         state = TrainState(load_checkpoint(tcfg["resume"], state.params),
                            state.key)
         # RNG sidecar (written by the outage-resume re-exec): restores the
         # epoch-k key so the resumed dropout stream continues the unbroken
         # run's chain bitwise, not restarting from --seed.
-        import os
         rng_sidecar = tcfg["resume"] + ".rng.npz"
         if os.path.exists(rng_sidecar):
             z = np.load(rng_sidecar)
             state = TrainState(state.params, jax.random.wrap_key_data(
                 jax.numpy.asarray(z["key"]), impl=str(z["impl"])))
-            # One-shot: the sidecar's key matches THIS checkpoint snapshot
-            # only. The resumed run overwrites the checkpoint every epoch;
-            # a stale sidecar would silently pair a later resume's fresh
-            # params with this old key — consume it now.
-            os.remove(rng_sidecar)
+            sidecar_box["sidecar"] = rng_sidecar
+            sidecar_box["ckpt"] = tcfg["resume"]
     if mesh is not None:
         state = TrainState(replicate_state(mesh, state.params),
                            replicate_state(mesh, state.key))
@@ -348,7 +380,9 @@ def main(argv=None) -> int:
     # intermediate checkpoint (documented on the flag).
     user_hook = None
     if process_index == 0 and tcfg["checkpoint"]:
-        user_hook = lambda e, st: save_checkpoint(tcfg["checkpoint"], st.params)  # noqa: E731
+        def user_hook(e, st):
+            save_checkpoint(tcfg["checkpoint"], st.params)
+            _consume_sidecar(tcfg["checkpoint"])
     hook = user_hook
 
     # Mid-run outage resilience (--outage_retries, serial only): the hook
@@ -433,6 +467,7 @@ def main(argv=None) -> int:
 
     if process_index == 0 and tcfg["checkpoint"]:
         save_checkpoint(tcfg["checkpoint"], state.params)
+        _consume_sidecar(tcfg["checkpoint"])
         print(f"saved checkpoint to {tcfg['checkpoint']}")
     return 0
 

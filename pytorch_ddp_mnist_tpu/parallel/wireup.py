@@ -289,17 +289,49 @@ def _probe_devices_bounded(timeout_s: float):
 
 def _subprocess_backend_healthy(timeout_s: float) -> bool:
     """Probe backend health from a FRESH interpreter — immune to this
-    process's wedged bridge lock. rc=0 within the timeout means the tunnel
-    answers queries again."""
+    process's wedged bridge lock. rc=0 within the timeout means the backend
+    answers queries again.
+
+    The child honors the parent's JAX_PLATFORMS intent through jax.config
+    (not just the env var): a pre-registered accelerator plugin can hang
+    backend enumeration at env-var-only platform selection, which would
+    make a CPU-intent probe (tests, --parallel-on-CPU runs) report the
+    DEAD accelerator instead of the healthy backend the run actually uses.
+    With an accelerator intent the probe touches that backend, so a downed
+    tunnel still times out -> unhealthy, as wanted."""
     import subprocess
     import sys
 
+    code = ("import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+            "p and jax.config.update('jax_platforms', p); jax.devices()")
     try:
         return subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", code],
             timeout=timeout_s, capture_output=True).returncode == 0
     except Exception:  # TimeoutExpired, spawn failure: not healthy
         return False
+
+
+# Substrings (lowercased match) of RuntimeErrors that a lost/dropping
+# backend produces: gRPC status names the XLA client surfaces when the
+# tunnel dies mid-run, plus socket-level phrasings. Deliberately narrow —
+# a compile/shape error must NOT match (see looks_like_backend_loss).
+BACKEND_LOSS_SIGNATURES = (
+    "unavailable", "deadline exceeded", "deadline_exceeded",
+    "socket closed", "connection reset", "connection refused",
+    "connection closed", "failed to connect", "broken pipe",
+    "transport closed", "stream terminated", "stream removed",
+    "rst_stream", "goaway", "endpoint read failed", "heartbeat",
+)
+
+
+def looks_like_backend_loss(e: BaseException) -> bool:
+    """Does this RuntimeError look like the backend DIED (vs a deterministic
+    program error)? Used by retry wrappers to decide whether re-running can
+    possibly help: a shape/compile error on a healthy backend would just
+    fail again N times before surfacing (ADVICE r4)."""
+    msg = str(e).lower()
+    return any(sig in msg for sig in BACKEND_LOSS_SIGNATURES)
 
 
 def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,

@@ -49,14 +49,24 @@ echo "== phase 1: variant matrix -> $OUT" >&2
 python scripts/bench_matrix.py --epochs 400 --retries 2 --out "$OUT"
 status[matrix]=$?
 
-# Informational (not a pass/fail phase): the config promotion gate —
-# writes bench_calibration.json only if a bf16/superstep candidate beats
-# the f32/K1 baseline in THIS matrix (bf16 winners additionally pass the
-# 10-epoch accuracy-parity run); rc=1 just means "not promoted".
+# The config promotion gate — writes bench_calibration.json only if a
+# bf16/superstep candidate beats the f32/K1 baseline in THIS matrix (bf16
+# winners additionally pass the 10-epoch accuracy-parity run). rc=0/1 are
+# the gate's two VERDICTS (promoted / not promoted — both fine); anything
+# else (crash rc=2, timeout rc=124) is a tracked phase failure, not a
+# losing candidate (ADVICE r4).
 echo "== phase 1b: epoch-kernel config promotion gate" >&2
-timeout 900 python scripts/promote_epoch_dtype.py --matrix "$OUT" \
-  && echo "measure_hw: config PROMOTED (bench_calibration.json)" >&2 \
-  || echo "measure_hw: config not promoted (gate or matrix incomplete)" >&2
+timeout 900 python scripts/promote_epoch_dtype.py --matrix "$OUT"
+promote_rc=$?
+status[promote]=0
+if ((promote_rc == 0)); then
+  echo "measure_hw: config PROMOTED (bench_calibration.json)" >&2
+elif ((promote_rc == 1)); then
+  echo "measure_hw: config not promoted (gate or matrix incomplete)" >&2
+else
+  echo "measure_hw: promotion gate FAILED rc=$promote_rc" >&2
+  status[promote]=$promote_rc
+fi
 
 echo "== phase 2: superstep / bf16 / batch-scaling sweep" >&2
 status[sweep]=0
@@ -86,7 +96,7 @@ PDMT_TPU_TESTS=1 timeout 3600 python -u -m pytest tests/test_pallas_step.py -q
 status[mosaic]=$?
 
 fail=0
-for phase in headline matrix sweep eval accuracy mosaic; do
+for phase in headline matrix promote sweep eval accuracy mosaic; do
   echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
   ((status[$phase] != 0)) && fail=1
 done

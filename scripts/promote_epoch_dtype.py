@@ -136,6 +136,13 @@ def main(argv=None) -> int:
         / "bench_calibration.json"))
     a = p.parse_args(argv)
 
+    # The launcher's JAX_PLATFORMS intent must win over any pre-registered
+    # accelerator plugin BEFORE the first backend query below — env-var-only
+    # selection can leave a dead tunnel's plugin hanging the on_tpu_backend
+    # probe (the same dance as the trainer CLI).
+    from pytorch_ddp_mnist_tpu.parallel.wireup import _honor_platform_env
+    _honor_platform_env()
+
     with open(a.matrix) as f:
         artifact = json.load(f)
 
@@ -169,4 +176,16 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # rc contract (ADVICE r4): 0 = promoted, 1 = the RESERVED "not
+    # promoted" verdict, 2 = the gate itself crashed (missing/corrupt
+    # matrix, traceback) — so callers can tell a losing candidate from a
+    # broken gate. A bare uncaught exception would exit 1 and masquerade
+    # as "not promoted".
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        sys.exit(2)

@@ -6,9 +6,11 @@ exist, so the probe deterministically fails on ANY machine (a real backend
 name like rocm could succeed where its plugin is installed and send
 hw_window.sh down its measure-and-git-commit path)."""
 
+import json
 import os
 import pathlib
 import subprocess
+import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 ENV = dict(os.environ, JAX_PLATFORMS="fakeplat")
@@ -35,3 +37,114 @@ def test_hw_window_gives_up_after_max_probes(tmp_path):
     assert out.returncode == 1
     assert "giving up" in out.stdout
     assert not sentinel.exists()
+
+
+# ---------------------------------------------------------------------------
+# The promotion gate, end-to-end on realistic matrix artifacts (VERDICT r4
+# weak #4 / next #6): the first unattended hardware window must not be the
+# first time promote_epoch_dtype.py parses real input shapes. These feed the
+# SCRIPT (not decide()) full bench_matrix.py-shaped JSON files and pin the
+# calibration it writes or refuses, plus the rc contract measure_hw.sh keys
+# on (0 = promoted, 1 = the reserved "not promoted" verdict, 2 = the gate
+# itself crashed — ADVICE r4).
+# ---------------------------------------------------------------------------
+
+_GATE = REPO / "scripts" / "promote_epoch_dtype.py"
+# exact labels the gate keys on (pinned against bench_matrix.VARIANTS by
+# tests/test_bench.py::test_promote_gate_labels_and_matrix_explicitness)
+_F32 = "f32 / whole-epoch kernel, uint8 streaming (single-chip headline)"
+_BF16 = "bf16-matmul / whole-epoch kernel, uint8 streaming"
+_SUP8 = "f32 / whole-epoch kernel / superstep 8"
+_SUP8B = "bf16-matmul / whole-epoch kernel / superstep 8"
+
+
+def _row(label, value, argv=("--kernel", "pallas_epoch")):
+    # the full row shape bench_matrix.py commits, not a minimal stub
+    return {"label": label, "argv": list(argv), "value": value,
+            "unit": "images/sec/chip",
+            "vs_baseline": None if value is None else round(value / 1e6, 4),
+            "tflops": None if value is None else 12.3,
+            "mfu_vs_197t_bf16": None if value is None else 4.5,
+            **({} if value is not None else {"error": "timeout rc=124"})}
+
+
+def _matrix(tmp_path, rows, name="matrix.json"):
+    path = tmp_path / name
+    path.write_text(json.dumps({
+        "timestamp": "2026-08-01T00:00:00+00:00", "epochs_per_window": 400,
+        "backend": "tpu", "device_kind": "TPU v5e", "jax_version": "0.9.0",
+        "variants": rows}, indent=1))
+    return path
+
+
+def _run_gate(matrix_path, out_path):
+    return subprocess.run(
+        [sys.executable, str(_GATE), "--matrix", str(matrix_path),
+         "--out", str(out_path), "--epochs", "1"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+
+
+def test_promote_script_f32_baseline_wins(tmp_path):
+    m = _matrix(tmp_path, [_row(_F32, 36.9e6), _row(_BF16, 30e6),
+                           _row(_SUP8, 35e6), _row(_SUP8B, 33e6)])
+    out = tmp_path / "cal.json"
+    r = _run_gate(m, out)
+    assert r.returncode == 1, r.stderr
+    assert "already fastest" in r.stderr
+    assert not out.exists()
+
+
+def test_promote_script_superstep_wins_writes_calibration(tmp_path):
+    m = _matrix(tmp_path, [_row(_F32, 36.9e6), _row(_BF16, 30e6),
+                           _row(_SUP8, 41e6), _row(_SUP8B, 33e6)])
+    out = tmp_path / "cal.json"
+    r = _run_gate(m, out)
+    assert r.returncode == 0, r.stderr
+    cal = json.loads(out.read_text())
+    assert cal["epoch_kernel_dtype"] == "float32"
+    assert cal["epoch_kernel_superstep"] == 8
+    assert cal["evidence"]["winner"] == _SUP8
+    assert cal["evidence"]["matrix"] == str(m)
+    assert cal["evidence"]["matrix_timestamp"] == "2026-08-01T00:00:00+00:00"
+
+
+def test_promote_script_bf16_win_refused_off_hardware(tmp_path):
+    # A bf16 winner needs the 10-epoch accuracy gate ON THE CHIP; off
+    # hardware the script must refuse (rc=1), never promote unverified.
+    m = _matrix(tmp_path, [_row(_F32, 36.9e6), _row(_BF16, 55e6),
+                           _row(_SUP8, 35e6), _row(_SUP8B, 33e6)])
+    out = tmp_path / "cal.json"
+    r = _run_gate(m, out)
+    assert r.returncode == 1, r.stderr
+    assert "real TPU" in r.stderr
+    assert not out.exists()
+
+
+def test_promote_script_incomplete_matrix_not_promoted(tmp_path):
+    # a flaky window: candidate rows failed (value null + error field)
+    m = _matrix(tmp_path, [_row(_F32, 36.9e6), _row(_BF16, None),
+                           _row(_SUP8, None), _row(_SUP8B, None)])
+    out = tmp_path / "cal.json"
+    r = _run_gate(m, out)
+    assert r.returncode == 1, r.stderr
+    assert "unmeasured" in r.stderr
+    assert not out.exists()
+    # ... and a matrix whose baseline itself never measured
+    m2 = _matrix(tmp_path, [_row(_F32, None), _row(_BF16, 55e6)], "m2.json")
+    r = _run_gate(m2, out)
+    assert r.returncode == 1 and "baseline" in r.stderr
+    assert not out.exists()
+
+
+def test_promote_script_crash_is_rc2_not_a_verdict(tmp_path):
+    # missing matrix file and corrupt JSON are gate CRASHES (rc=2) —
+    # distinguishable from the reserved rc=1 "not promoted" verdict so
+    # measure_hw.sh can track them as phase failures (ADVICE r4)
+    out = tmp_path / "cal.json"
+    r = _run_gate(tmp_path / "nope.json", out)
+    assert r.returncode == 2, (r.returncode, r.stderr)
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    r = _run_gate(corrupt, out)
+    assert r.returncode == 2, (r.returncode, r.stderr)
+    assert not out.exists()

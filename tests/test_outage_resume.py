@@ -171,6 +171,60 @@ def test_wedged_client_persists_and_reexecs_then_completes(
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_program_error_not_retried_on_healthy_backend(tmp_path, monkeypatch):
+    """A deterministic program error (no backend-loss signature) on a
+    HEALTHY backend must surface immediately instead of burning the retry
+    budget re-running into it (ADVICE r4): the wrapper checks the message
+    signature, then confirms backend health from a fresh interpreter."""
+    from pytorch_ddp_mnist_tpu.train import scan
+
+    calls = {"n": 0}
+
+    def broken(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError("Mismatched XLA computation shapes "
+                           "(simulated deterministic program bug)")
+
+    monkeypatch.setattr(scan, "fit_cached", broken)
+    with pytest.raises(RuntimeError, match="Mismatched"):
+        main(_args(tmp_path, tmp_path / "x.msgpack",
+                   ["--outage_retries", "3"]))
+    assert calls["n"] == 1  # no silent re-runs
+
+
+def test_sidecar_survives_resume_that_dies_before_first_save(
+        tmp_path, monkeypatch):
+    """The (checkpoint, .rng.npz) pair must stay intact when a resumed run
+    dies before its first checkpoint save (ADVICE r4) — a later manual
+    --resume of the same pair still restores the sidecar key chain — and
+    must be consumed once the resumed run overwrites the checkpoint."""
+    ckpt = tmp_path / "c.msgpack"
+    base = ["--limit", "512", "--batch_size", "64", "--cached",
+            "--path", str(tmp_path), "--checkpoint", str(ckpt)]
+    assert main(base + ["--n_epochs", "1"]) == 0
+    sidecar = tmp_path / "c.msgpack.rng.npz"
+    np.savez(sidecar,
+             key=np.asarray(jax.random.key_data(jax.random.key(123))),
+             impl="threefry2x32")
+
+    from pytorch_ddp_mnist_tpu.train import scan
+
+    def dies(*a, **kw):
+        raise RuntimeError("UNAVAILABLE: socket closed (simulated outage "
+                           "before any epoch completes)")
+
+    monkeypatch.setattr(scan, "fit_cached", dies)
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        main(base + ["--n_epochs", "2", "--resume", str(ckpt),
+                     "--start_epoch", "1"])
+    assert sidecar.exists()  # pair intact for the next manual --resume
+
+    monkeypatch.undo()
+    assert main(base + ["--n_epochs", "2", "--resume", str(ckpt),
+                        "--start_epoch", "1"]) == 0
+    assert not sidecar.exists()  # consumed at the first overwrite
+
+
 def test_outage_retries_rejected_by_name_with_parallel_and_fused(tmp_path):
     with pytest.raises(SystemExit, match="serial-only"):
         main(["--parallel", "--outage_retries", "1", "--path", str(tmp_path)])
