@@ -12,6 +12,7 @@ Run: python -m pytorch_ddp_mnist_tpu.cli.train [--parallel] [--n_epochs N] ...
 
 from __future__ import annotations
 
+import os
 import sys
 
 import jax
@@ -48,8 +49,6 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
     With retries == 0 (the default) this is exactly one un-wrapped call —
     interactive errors stay immediate.
     """
-    import os
-
     from ..parallel.wireup import (BackendUnavailableError,
                                    BackendWedgedError,
                                    _subprocess_backend_healthy,
@@ -184,6 +183,42 @@ def main(argv=None) -> int:
             "--outage_retries needs per-epoch state to resume from; "
             "--fused runs all epochs as one device program with no "
             "mid-run state (use plain --cached)")
+    if tcfg["dropout_rng"] == "torch":
+        # The torch mask stream is drawn on the HOST per step (exactly like
+        # torch) — that shape fits only the serial streaming loop. The
+        # cached/fused epoch programs draw masks in-device, and DP replicas
+        # need per-rank streams the single global torch generator does not
+        # model; each combination is rejected by name, not degraded.
+        if tcfg["parallel"]:
+            raise SystemExit(
+                "--dropout_rng torch is serial-only: DDP replicas draw "
+                "per-rank dropout streams, and the reference's single "
+                "global torch generator has no per-rank split to mirror")
+        if tcfg["cached"]:
+            raise SystemExit(
+                "--dropout_rng torch streams host-drawn masks per step; "
+                "the --cached/--fused epoch programs draw masks in-device "
+                "— drop --cached (the streaming loop) to use it")
+        if tcfg["kernel"] not in ("auto", "xla"):
+            raise SystemExit(
+                f"--dropout_rng torch uses the XLA step with streamed "
+                f"masks; --kernel {tcfg['kernel']} draws its own masks "
+                f"in-kernel")
+        if (tcfg["outage_retries"] or tcfg["resume"]
+                or tcfg["start_epoch"]):
+            # The host-side torch generator's position is not captured by
+            # the checkpoint/sidecar state, so any resumed run would
+            # continue (or restart) the mask stream at the wrong position
+            # — silently breaking the bitwise contract that is this
+            # flag's entire point. Reject by name rather than degrade.
+            raise SystemExit(
+                "--dropout_rng torch does not compose with "
+                "--outage_retries/--resume/--start_epoch: the torch mask "
+                "stream's position is host state the checkpoint does not "
+                "carry, and a resumed run would train on out-of-position "
+                "masks; use the default jax dropout stream for resumable "
+                "runs")
+        tcfg["kernel"] = "xla"
 
     # .pt/.pth checkpoint paths need torch — fail BEFORE training, not after
     # a completed run's first save (which would lose the trained params).
@@ -250,6 +285,13 @@ def main(argv=None) -> int:
             train_step = make_pallas_train_step(
                 tcfg["lr"], interpret=_pallas_interpret(),
                 dtype=tcfg["dtype"])
+        elif tcfg["dropout_rng"] == "torch":
+            # masks stream from torch's bitwise CPU bernoulli stream
+            # (train/loop.py make_torch_dropout_train_step; the draw of
+            # ddp_tutorial_cpu.py:47, seeded --seed)
+            from ..train.loop import make_torch_dropout_train_step
+            train_step = make_torch_dropout_train_step(tcfg["lr"],
+                                                       tcfg["seed"])
         num_shards = local_shards = 1
 
     global_batch = tcfg["batch_size"] * num_shards
@@ -265,7 +307,6 @@ def main(argv=None) -> int:
         # sharded row-gathers straight from the .nc file; the test split is
         # read whole per process, like the serial variant's collective read
         # (mnist_pnetcdf_cpu.py:47).
-        import os
         from ..data.loader import NetCDFShardLoader
         from ..data.netcdf import read_mnist_netcdf
         train_nc = os.path.join(dcfg["path"], "mnist_train_images.nc")
@@ -337,7 +378,6 @@ def main(argv=None) -> int:
     # pair is consumed by _consume_sidecar below, at the first save to the
     # same path; a sidecar paired with a checkpoint this run never writes
     # to stays on disk, still correctly paired.
-    import os
     sidecar_box = {"sidecar": None, "ckpt": None}
 
     def _consume_sidecar(saved_path: str) -> None:

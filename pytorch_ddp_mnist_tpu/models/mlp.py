@@ -68,24 +68,36 @@ def init_mlp(key: jax.Array, dtype=jnp.float32) -> Params:
 
 
 def mlp_apply(params: Params, x: jax.Array, *, train: bool = False,
-              dropout_key: jax.Array | None = None) -> jax.Array:
+              dropout_key: jax.Array | None = None,
+              dropout_mask: jax.Array | None = None) -> jax.Array:
     """Forward pass. `x` is (batch, 784) (callers flatten, matching the
     reference's x.view(B, -1) at ddp_tutorial_multi_gpu.py:90).
 
     In train mode a dropout mask is drawn from `dropout_key`; each data-parallel
     replica must pass a distinct key (DDP ranks draw independent masks — see
-    SURVEY.md §7 parity item 4). Compute dtype follows x; params are cast to it.
+    SURVEY.md §7 parity item 4). Alternatively `dropout_mask` streams a
+    pre-drawn {0,1} mask of `h`'s shape (the `--dropout_rng torch` path:
+    masks drawn host-side from torch's bitwise CPU bernoulli stream,
+    parallel/torch_rng.py); exactly one of the two must be given in train
+    mode. Compute dtype follows x; params are cast to it.
     """
     dt = x.dtype
     h = x @ params["fc1"]["w"].astype(dt) + params["fc1"]["b"].astype(dt)
     h = jax.nn.relu(h)
     if train:
-        if dropout_key is None:
-            raise ValueError("train=True requires dropout_key")
         keep = 1.0 - DROPOUT_RATE
-        mask = jax.random.bernoulli(dropout_key, keep, h.shape)
-        # Inverted dropout, same as torch.nn.Dropout: scale kept units by 1/keep.
-        h = jnp.where(mask, h / jnp.asarray(keep, dt), jnp.zeros((), dt))
+        if (dropout_key is None) == (dropout_mask is None):
+            raise ValueError("train=True requires exactly one of "
+                             "dropout_key / dropout_mask")
+        if dropout_mask is not None:
+            # torch applies input * mask * (1/keep); mask∈{0,1} and 1/0.8
+            # is exactly representable, so the product order is bit-inert.
+            h = h * (dropout_mask.astype(dt) * jnp.asarray(1.0 / keep, dt))
+        else:
+            mask = jax.random.bernoulli(dropout_key, keep, h.shape)
+            # Inverted dropout, same as torch.nn.Dropout: scale kept units
+            # by 1/keep.
+            h = jnp.where(mask, h / jnp.asarray(keep, dt), jnp.zeros((), dt))
     h = h @ params["fc2"]["w"].astype(dt) + params["fc2"]["b"].astype(dt)
     h = jax.nn.relu(h)
     return h @ params["fc3"]["w"].astype(dt)

@@ -112,3 +112,24 @@ def torch_randperm(n: int, seed: int) -> np.ndarray:
         if j != i:
             r[i], r[j] = r[j], r[i]
     return r
+
+
+def torch_bernoulli(gen: TorchMT19937, n: int, p: float) -> np.ndarray:
+    """``tensor.bernoulli_(p)`` on a CPU float tensor, bitwise: ``n`` {0,1}
+    float32 values in element (row-major) order from ``gen``'s stream.
+
+    Torch's CPU kernel draws, per element, one 64-bit word (two sequential
+    32-bit engine outputs, FIRST draw = high word), keeps the low 53 bits as
+    a double in [0, 1) (x * 2^-53), and emits 1 iff that uniform is < p.
+    Reimplemented from the observed stream (fuzz-pinned against real torch
+    in tests/test_sampler.py across seeds/sizes/probabilities); vectorized —
+    one ``draws(2n)`` block, no per-element Python.
+
+    This is the mask stream of ``nn.Dropout`` (reference
+    ddp_tutorial_cpu.py:47): ``Dropout(p)`` draws ``bernoulli_(1-p)`` on the
+    SAME global generator, so pass the keep probability here.
+    """
+    d = gen.draws(2 * n).astype(np.uint64)
+    x = (d[0::2] << np.uint64(32)) | d[1::2]
+    u = (x & np.uint64((1 << 53) - 1)).astype(np.float64) * (2.0 ** -53)
+    return (u < p).astype(np.float32)

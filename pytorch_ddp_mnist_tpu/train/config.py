@@ -126,6 +126,17 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "(ddp_tutorial_multi_gpu.py:26-30), making every "
                         "epoch's shard composition index-identical to a "
                         "reference run at the same seed")
+    t.add_argument("--dropout_rng", choices=("jax", "torch"),
+                   default="jax",
+                   help="dropout mask source: jax (default; the --impl key "
+                        "chain) or torch — masks stream from torch's "
+                        "bitwise CPU bernoulli stream (the nn.Dropout draw "
+                        "of ddp_tutorial_cpu.py:47, seeded --seed). With "
+                        "--sampler_rng torch the serial streaming "
+                        "trajectory is bitwise-reproducible against a live "
+                        "torch run that reseeds its generator with --seed "
+                        "after model init. Serial streaming path only "
+                        "(no --parallel/--cached)")
     t.add_argument("--eval_shuffle", action="store_true",
                    help="shuffle the eval batch segmentation per epoch like "
                         "the reference's test DataLoader(shuffle=True) "
@@ -177,6 +188,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
             "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,
             "sampler_rng": a.sampler_rng, "eval_shuffle": a.eval_shuffle,
+            "dropout_rng": a.dropout_rng,
             "dtype": a.dtype, "impl": a.impl,
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,

@@ -68,6 +68,45 @@ def make_train_step(lr: float) -> Callable:
     return step
 
 
+def make_torch_dropout_train_step(lr: float, seed: int) -> Callable:
+    """The `--dropout_rng torch` step: dropout masks stream from torch's
+    bitwise CPU bernoulli stream (parallel/torch_rng.torch_bernoulli, the
+    stream of reference ddp_tutorial_cpu.py:47) instead of jax's key chain.
+
+    Combined with `--sampler_rng torch`, the serial trajectory —
+    sampler shard, per-step dropout masks, SGD — is bitwise-reproducible
+    against a live torch run that seeds its global generator with `seed`
+    after model init (torch's init consumes the same generator; reseeding
+    post-init is the documented comparator shim). Masks are drawn on the
+    HOST per step, exactly like torch; the jitted device step takes the
+    mask as an input. The RNG key is threaded through untouched so the
+    TrainState contract (and checkpoint/resume sidecars) are unchanged.
+    """
+    from ..models.mlp import DROPOUT_RATE, MLP_DIMS
+    from ..parallel.torch_rng import TorchMT19937, torch_bernoulli
+
+    gen = TorchMT19937(seed)
+    keep = 1.0 - DROPOUT_RATE
+    hidden = MLP_DIMS[1]
+
+    def mask_loss_fn(params, x, y, mask):
+        logits = mlp_apply(params, x, train=True, dropout_mask=mask)
+        return cross_entropy(logits, y)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def device_step(params, x, y, mask):
+        loss, grads = jax.value_and_grad(mask_loss_fn)(params, x, y, mask)
+        return sgd_step(params, grads, lr), loss
+
+    def step(params, key, x, y):
+        mask = torch_bernoulli(gen, int(x.shape[0]) * hidden, keep)
+        mask = jnp.asarray(mask.reshape(x.shape[0], hidden))
+        params, loss = device_step(params, x, y, mask)
+        return params, key, loss
+
+    return step
+
+
 def _eval_math(params, x, y):
     """Per-sample test-set forward: (params, x (n,784), y (n,)) ->
     (per_sample_loss, correct), both (n,) float32. Dropout off, exactly the

@@ -78,10 +78,17 @@ def _matrix(tmp_path, rows, name="matrix.json"):
 
 
 def _run_gate(matrix_path, out_path):
+    # Pin the gate subprocess to the CPU backend: on a TPU-attached host
+    # the inherited env would let on_tpu_backend() see the real chip and
+    # send the bf16 branch into 10-epoch hardware accuracy runs instead of
+    # the deterministic off-hardware refusal these tests assert. (cpu, not
+    # the module's fakeplat: the gate QUERIES the backend, it doesn't just
+    # probe for liveness — fakeplat would crash the query into rc=2.)
     return subprocess.run(
         [sys.executable, str(_GATE), "--matrix", str(matrix_path),
          "--out", str(out_path), "--epochs", "1"],
-        cwd=REPO, capture_output=True, text=True, timeout=300)
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=300)
 
 
 def test_promote_script_f32_baseline_wins(tmp_path):

@@ -143,3 +143,30 @@ def test_torch_randperm_fuzz_random_sizes_and_seeds():
         np.testing.assert_array_equal(
             torch_randperm(n, seed),
             torch.randperm(n, generator=g).numpy(), err_msg=f"{n=} {seed=}")
+
+
+def test_torch_bernoulli_fuzz_vs_real_torch():
+    """torch_bernoulli IS torch's CPU ``tensor.bernoulli_(p)`` stream,
+    bitwise: randomized sweep (fixed meta-seed) over seeds, sizes, and
+    probabilities, with sizes straddling the 624-word twist blocks by
+    chance. Also pins the nn.Dropout identity the trainer relies on
+    (`--dropout_rng torch`): Dropout(p) == bernoulli_(1-p)/(1-p) on the
+    same generator stream (ddp_tutorial_cpu.py:47)."""
+    from pytorch_ddp_mnist_tpu.parallel.torch_rng import (TorchMT19937,
+                                                          torch_bernoulli)
+
+    meta = np.random.default_rng(31337)
+    for _ in range(20):
+        n = int(meta.integers(1, 40000))
+        seed = int(meta.integers(0, 2**31 - 1))
+        p = float(meta.uniform(0.05, 0.95))
+        torch.manual_seed(seed)
+        obs = torch.empty(n).bernoulli_(p).numpy()
+        np.testing.assert_array_equal(
+            torch_bernoulli(TorchMT19937(seed), n, p), obs,
+            err_msg=f"{n=} {seed=} {p=}")
+    # the dropout identity, on the reference's exact rate
+    torch.manual_seed(7)
+    drop = torch.nn.Dropout(0.2)(torch.ones(64, 128)).numpy()
+    mask = torch_bernoulli(TorchMT19937(7), 64 * 128, 0.8)
+    np.testing.assert_array_equal(drop, mask.reshape(64, 128) * np.float32(1.25))
