@@ -37,6 +37,28 @@ NOMINAL_BASELINE_IMGS_PER_SEC = 1_000_000.0
 # (ADVICE r3).
 NOMINAL_BASELINE_EVAL_IMGS_PER_SEC = 1_000_000.0
 NOMINAL_BASELINE_STREAM_IMGS_PER_SEC = 1_000_000.0
+
+# Roofline context for every throughput line (VERDICT r4 #8: a reader of a
+# BENCH_r0X.json should see how close the chip is to its ceiling without
+# opening docs/PERF.md). The model cost is exact — 118,016 fwd MACs/image
+# (784*128 + 128*128 + 128*10), backward ~2x forward — and the ceiling is
+# the v5e chip's 197 TFLOP/s bf16 peak (f32 programs face the same MXU, so
+# quoting one fixed ceiling keeps MFU comparable across dtype variants;
+# docs/PERF.md derives the same roofline). scripts/bench_matrix.py uses
+# these constants for its per-row tflops/mfu columns.
+MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016
+V5E_PEAK_FLOPS_BF16 = 197e12
+
+
+def perf_fields(per_chip_imgs_per_sec: float, *, fwd_only: bool = False):
+    """{tflops, mfu_pct_vs_bf16_peak} for a measured per-chip image rate.
+
+    `fwd_only` for inference rates (eval mode): 2 FLOPs/MAC, no backward."""
+    flops_per_img = (2 if fwd_only else 6) * MACS_FWD_PER_IMG
+    tf = per_chip_imgs_per_sec * flops_per_img / 1e12
+    return {"tflops": round(tf, 2),
+            "mfu_pct_vs_bf16_peak": round(100 * tf * 1e12
+                                          / V5E_PEAK_FLOPS_BF16, 2)}
 # Window length: each timing window carries a fixed ~30 ms of program
 # dispatch + sync RTT over the TPU tunnel (measured: 50/100/200/400-epoch
 # windows report 15.5/16.7/17.3/18.1M img/s — a 1/x approach to the ~18.5M
@@ -147,6 +169,8 @@ def _stream_bench(a) -> None:
                 n = sum(len(x) for x, _ in ldr)
             if trial:
                 best = min(best, t.seconds)
+        # no tflops/mfu: the stream mode measures the DISK loader, not
+        # device compute — a roofline fraction would be meaningless here
         print(json.dumps({
             "metric": "mnist_netcdf_stream_images_per_sec",
             "value": round(n / best, 1),
@@ -225,6 +249,7 @@ def _eval_bench(a) -> None:
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / NOMINAL_BASELINE_EVAL_IMGS_PER_SEC, 4),
+        **perf_fields(per_chip, fwd_only=True),
     }))
 
 
@@ -612,6 +637,7 @@ def main(argv=None) -> None:
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(imgs_per_sec / NOMINAL_BASELINE_IMGS_PER_SEC, 4),
+        **perf_fields(per_chip),
     }))
 
 

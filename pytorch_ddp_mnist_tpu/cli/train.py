@@ -26,13 +26,50 @@ from ..train import (TrainState, fit, save_checkpoint, load_checkpoint)
 from ..train.config import configure
 
 
-def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
+def _persist_and_reexec(tcfg, stash, remaining: int, process_index: int,
+                        why: str) -> None:
+    """Persist the stash (per-rank checkpoint + RNG sidecar) and replace
+    this process with a fresh CLI invocation resuming at the next global
+    epoch. Never returns. Shared by the serial wedged-client path and the
+    parallel coordinated resume; callers have already verified the CLI
+    context (argv is None, no PDMT_NO_REEXEC)."""
+    ckpt = tcfg["checkpoint"] or "outage_resume.msgpack"
+    # Rank-gated stash files: rank 0 persists to the real checkpoint path;
+    # every other rank to a rank-suffixed sibling (multi-host ranks cannot
+    # read each other's filesystems, and params are replicated — identical
+    # bytes on every rank). The resumed processes re-rendezvous and each
+    # loads its own file.
+    my_ckpt = ckpt if process_index == 0 else f"{ckpt}.rank{process_index}"
+    save_checkpoint(my_ckpt, stash["params"])
+    np.savez(my_ckpt + ".rng.npz", key=stash["key"], impl=tcfg["impl"])
+    if not tcfg["parallel"]:
+        # Serial wedged path: once-only (the marker survives execv). The
+        # PARALLEL path must NOT set it — its re-exec'd world carries the
+        # decremented --outage_retries budget, which is the loop bound, and
+        # a marker would make every remaining retry dead on arrival.
+        os.environ["PDMT_NO_REEXEC"] = "1"
+    print(f"[outage] {why}; re-exec'ing with --resume {my_ckpt} "
+          f"--start_epoch {stash['epoch'] + 1}", file=sys.stderr, flush=True)
+    # execv replaces the process without flushing Python's buffers: under
+    # nohup/tee (block-buffered stdout — the outage workflow) unflushed
+    # epoch lines would vanish here.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [
+        sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train",
+        *sys.argv[1:], "--resume", my_ckpt,
+        "--start_epoch", str(stash["epoch"] + 1),
+        "--outage_retries", str(remaining)])
+
+
+def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv,
+                             process_index: int = 0):
     """Run the fit closure, retraining through backend outages when
     --outage_retries > 0 (the tunneled-TPU failure mode this framework's
     bench machinery already handles at startup — this extends it MID-run).
 
-    On a device/backend RuntimeError escaping the fit: wait for the backend
-    (hang-bounded probes, parallel/wireup.py), then
+    On a device/backend RuntimeError escaping the fit, SERIAL runs: wait
+    for the backend (hang-bounded probes, parallel/wireup.py), then
 
     - recovered in-process: rebuild device state from the host stash (last
       completed epoch's params + key) and continue at the next GLOBAL epoch
@@ -46,9 +83,23 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
     - backend stays down past the wait budget (PDMT_BACKEND_WAIT, default
       1 h): SystemExit with the named error.
 
+    PARALLEL runs (VERDICT r4 #5) go straight to the coordinated
+    persist + re-exec: every rank independently catches the collective's
+    failure, stashes the last completed epoch's replicated state to its
+    own rank-gated file, polls backend health OUT of process (bounded by
+    the same wait budget; an in-process probe could wedge behind the dead
+    client's bridge lock), and re-execs into a fresh CLI invocation —
+    fresh processes re-rendezvous through a clean jax.distributed
+    initialize, where in-place re-initialization would have to rebuild
+    every mesh/step closure against a torn-down client. The resumed world
+    resumes at the next global epoch, bitwise the unbroken run
+    (tests/test_multiprocess.py pins it at 4 processes).
+
     With retries == 0 (the default) this is exactly one un-wrapped call —
     interactive errors stay immediate.
     """
+    import time
+
     from ..parallel.wireup import (BackendUnavailableError,
                                    BackendWedgedError,
                                    _subprocess_backend_healthy,
@@ -65,43 +116,56 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv):
         except RuntimeError as e:
             if attempt >= retries:
                 raise
-            # Outage vs program error (ADVICE r4): a deterministic failure
-            # (XLA shape/compile error, NaN guard) on a healthy backend
-            # would just burn every retry re-hitting the same error before
-            # surfacing. Retry only when the error carries a backend-loss
-            # signature, or — for unrecognized messages — when a fresh
-            # out-of-process probe confirms the backend is actually down.
-            if not looks_like_backend_loss(e) and \
-                    _subprocess_backend_healthy(30.0):
+            # Outage vs program error (ADVICE r4), SERIAL runs only: a
+            # deterministic failure (XLA shape/compile error, NaN guard)
+            # on a healthy backend would just burn every retry re-hitting
+            # the same error before surfacing. Retry only when the error
+            # carries a backend-loss signature, or — for unrecognized
+            # messages — when a fresh out-of-process probe confirms the
+            # backend is actually down. PARALLEL runs deliberately skip
+            # this triage: the decision must be IDENTICAL on every rank
+            # (per-rank error strings and probe timings differ mid-outage,
+            # and a rank that re-raises while the others re-exec leaves
+            # the new world hanging in its rendezvous), so every rank
+            # retries unconditionally — a deterministic program error
+            # burns the bounded budget re-running, which is the price of
+            # never splitting the world's brain.
+            if not tcfg["parallel"] and not looks_like_backend_loss(e) \
+                    and _subprocess_backend_healthy(30.0):
                 raise
             attempt += 1
             print(f"[outage] training interrupted mid-run: {e}; waiting for "
                   f"the backend (retry {attempt}/{retries})",
                   file=sys.stderr, flush=True)
+            if tcfg["parallel"]:
+                # All ranks poll health from FRESH interpreters until the
+                # backend answers (never an in-process device query: the
+                # dead client can hold the bridge lock forever), then
+                # re-exec; the fresh processes' initialize() is the
+                # re-rendezvous barrier. No PDMT_NO_REEXEC check here: the
+                # decremented budget in the re-exec'd argv is the loop
+                # bound, and main() validated the CLI context at parse
+                # time (argv is None).
+                deadline = time.monotonic() + backend_wait_env(3600.0)
+                while not _subprocess_backend_healthy(45.0):
+                    if time.monotonic() > deadline:
+                        raise SystemExit(
+                            "[outage] backend did not recover within the "
+                            "wait budget after a mid-run interruption of "
+                            "the parallel run") from e
+                    time.sleep(10.0)
+                _persist_and_reexec(
+                    tcfg, stash, retries - attempt, process_index,
+                    "backend answers again; coordinated parallel resume")
             try:
                 wait_for_backend(max_wait_s=backend_wait_env(3600.0))
             except BackendWedgedError:
                 if argv is not None or os.environ.get("PDMT_NO_REEXEC") == "1":
                     raise
-                ckpt = tcfg["checkpoint"] or "outage_resume.msgpack"
-                save_checkpoint(ckpt, stash["params"])
-                np.savez(ckpt + ".rng.npz", key=stash["key"],
-                         impl=tcfg["impl"])
-                os.environ["PDMT_NO_REEXEC"] = "1"
-                print(f"[outage] backend recovered but this process's jax "
-                      f"client is wedged; re-exec'ing with --resume {ckpt} "
-                      f"--start_epoch {stash['epoch'] + 1}",
-                      file=sys.stderr, flush=True)
-                # execv replaces the process without flushing Python's
-                # buffers: under nohup/tee (block-buffered stdout — the
-                # outage workflow) unflushed epoch lines would vanish here.
-                sys.stdout.flush()
-                sys.stderr.flush()
-                os.execv(sys.executable, [
-                    sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train",
-                    *sys.argv[1:], "--resume", ckpt,
-                    "--start_epoch", str(stash["epoch"] + 1),
-                    "--outage_retries", str(retries - attempt)])
+                _persist_and_reexec(
+                    tcfg, stash, retries - attempt, process_index,
+                    "backend recovered but this process's jax client is "
+                    "wedged")
             except BackendUnavailableError as be:
                 raise SystemExit(
                     f"[outage] backend did not recover within the wait "
@@ -173,11 +237,17 @@ def main(argv=None) -> int:
                          f"run length; start_epoch resumes inside it)")
     if tcfg["outage_retries"] < 0:
         raise SystemExit("--outage_retries must be >= 0")
-    if tcfg["outage_retries"] and tcfg["parallel"]:
+    # --outage_retries composes with --parallel since round 5: every rank
+    # persists its own stash and the world re-execs into a fresh
+    # rendezvous (_train_with_outage_retry's parallel branch). That resume
+    # REPLACES the process, so it needs the CLI context — fail fast at
+    # parse time for programmatic callers instead of logging a retry line
+    # and re-raising at the first outage.
+    if tcfg["outage_retries"] and tcfg["parallel"] and argv is not None:
         raise SystemExit(
-            "--outage_retries is serial-only: a multi-process run that "
-            "loses its backend mid-collective cannot re-rendezvous in "
-            "place — relaunch with --resume instead")
+            "--outage_retries with --parallel resumes by re-exec'ing the "
+            "process and is only available from the CLI (argv=None); "
+            "programmatic callers should relaunch with --resume instead")
     if tcfg["outage_retries"] and tcfg["fused"]:
         raise SystemExit(
             "--outage_retries needs per-epoch state to resume from; "
@@ -503,12 +573,23 @@ def main(argv=None) -> int:
                        epoch_hook=hook, start_epoch=start,
                        eval_perm=eval_perm)
     state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
-                                     argv)
+                                     argv, process_index=process_index)
 
     if process_index == 0 and tcfg["checkpoint"]:
         save_checkpoint(tcfg["checkpoint"], state.params)
         _consume_sidecar(tcfg["checkpoint"])
         print(f"saved checkpoint to {tcfg['checkpoint']}")
+    # A non-zero rank resumed from its own outage stash: the run completed,
+    # so the rank-suffixed file (and its sidecar, never path-matched by
+    # _consume_sidecar) has served its purpose — same durable-progress
+    # rule as the sidecar itself.
+    if (tcfg["resume"] and process_index > 0
+            and tcfg["resume"].endswith(f".rank{process_index}")):
+        for stale in (tcfg["resume"], tcfg["resume"] + ".rng.npz"):
+            try:
+                os.remove(stale)
+            except FileNotFoundError:
+                pass
     return 0
 
 

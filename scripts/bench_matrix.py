@@ -76,9 +76,13 @@ VARIANTS = [
      ["--kernel", "pallas_epoch", "--dtype", "bfloat16", "--superstep", "8"]),
 ]
 
-MACS_FWD_PER_IMG = 784 * 128 + 128 * 128 + 128 * 10      # 118,016
+# Single source of truth for the roofline math: bench.py's constants (a
+# model-shape change updated in one place keeps BENCH_r0X.json lines and
+# these matrix rows consistent).
+from bench import MACS_FWD_PER_IMG, V5E_PEAK_FLOPS_BF16  # noqa: E402
+
 FLOPS_PER_IMG = 3 * 2 * MACS_FWD_PER_IMG                  # fwd + ~2x bwd
-V5E_PEAK_BF16 = 197e12
+V5E_PEAK_BF16 = V5E_PEAK_FLOPS_BF16
 
 
 def run_variant(argv, epochs: int):

@@ -1,0 +1,46 @@
+"""Worker for the multi-process mid-run-outage resume test — NOT collected
+by pytest (no test_ prefix).
+
+Each of the WORLD ranks runs the real trainer CLI (`cli.train.main(None)`,
+the CLI context the re-exec path requires) with a BOMB installed on the
+cached fit: after global epoch FAIL_EPOCH completes (the stash has it), the
+epoch hook raises a backend-loss-shaped RuntimeError on every rank —
+exactly a collective dying mid-run. The retry path then persists each
+rank's stash and re-execs `python -m pytorch_ddp_mnist_tpu.cli.train ...`,
+which is the PLAIN CLI: the bomb does not exist in the resumed processes,
+so the world re-rendezvouses and finishes the run. The parent test asserts
+the final checkpoint is bitwise an unbroken 4-process run's.
+"""
+
+import sys
+
+FAIL_EPOCH = 1
+
+
+def main() -> int:
+    from pytorch_ddp_mnist_tpu.cli.train import main as cli_main
+    from pytorch_ddp_mnist_tpu.train import scan
+
+    real = scan.fit_cached
+
+    def flaky(*a, **kw):
+        user = kw.get("epoch_hook")
+
+        def bomb(e, st):
+            if user is not None:
+                user(e, st)
+            if e == FAIL_EPOCH:
+                raise RuntimeError("UNAVAILABLE: socket closed (simulated "
+                                   "mid-run tunnel outage, parallel)")
+
+        kw["epoch_hook"] = bomb
+        return real(*a, **kw)
+
+    scan.fit_cached = flaky
+    # argv=None: the CLI context (sys.argv carries the flags) — required by
+    # the persist+re-exec path, and exactly how a launcher invokes this.
+    return cli_main(None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

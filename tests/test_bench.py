@@ -28,6 +28,16 @@ def test_train_mode_contract():
     assert rec["metric"] == "mnist_train_images_per_sec_per_chip"
     assert rec["unit"] == "images/sec/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    # roofline context on every throughput line (VERDICT r4 #8): the
+    # fields must be the exact rounded derivations of value — comparing
+    # recomputed roundings (not `> 0`) keeps the contract host-speed
+    # independent (a slow CI host legitimately rounds tiny MFUs to 0.0)
+    import bench
+    flops = rec["value"] * 6 * bench.MACS_FWD_PER_IMG
+    assert rec["tflops"] == round(flops / 1e12, 2)
+    assert rec["mfu_pct_vs_bf16_peak"] == round(
+        100 * flops / bench.V5E_PEAK_FLOPS_BF16, 2)
+    assert 0 <= rec["mfu_pct_vs_bf16_peak"] < 100
 
 
 def test_stream_mode_contract():
@@ -46,6 +56,12 @@ def test_eval_mode_contract():
     assert rec["metric"] == "mnist_eval_images_per_sec_per_chip"
     assert rec["unit"] == "images/sec/chip"
     assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    # eval is forward-only: 2 FLOPs/MAC in the roofline fields
+    import bench
+    flops = rec["value"] * 2 * bench.MACS_FWD_PER_IMG
+    assert rec["tflops"] == round(flops / 1e12, 2)
+    assert rec["mfu_pct_vs_bf16_peak"] == round(
+        100 * flops / bench.V5E_PEAK_FLOPS_BF16, 2)
 
 
 def test_mode_knob_compat_rejected_by_name():

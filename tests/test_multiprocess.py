@@ -225,6 +225,59 @@ def test_four_process_cached_cli():
     assert 0.0 <= acc <= 1.0, lines[-1]
 
 
+def test_four_process_midrun_outage_coordinated_resume(tmp_path):
+    """Coordinated multi-process mid-run outage resume (VERDICT r4 #5):
+    a 4-process --parallel --cached run loses its backend after global
+    epoch 1 (every rank raises a backend-loss RuntimeError — the bomb in
+    tests/mp_outage_worker.py), and with --outage_retries each rank
+    persists its own stash (rank 0 -> the checkpoint; ranks 1..3 ->
+    rank-suffixed siblings + RNG sidecars), confirms backend health out
+    of process, and re-execs into the PLAIN CLI. The fresh world
+    re-rendezvouses (a clean jax.distributed.initialize on the same
+    coordinator address) and finishes epochs 2.. — bitwise the unbroken
+    4-process run, with the temp stash files consumed on success."""
+    golden = tmp_path / "golden.msgpack"
+    tail = ["--parallel", "--cached", "--wireup_method", "env",
+            "--n_epochs", "3", "--limit", "1024", "--batch_size", "64",
+            "--lr", "0.1", "--path", str(tmp_path)]
+    _run_world([sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train",
+                *tail, "--checkpoint", str(golden)])
+
+    flaky = tmp_path / "flaky.msgpack"
+    # generous budget: the flow is two full 4-process worlds back to back
+    # (original + re-exec'd), each paying fresh jax imports and jit
+    # compiles, plus 4 out-of-process health probes — on a contended
+    # 1-core CI host the whole dance has been observed near 7 minutes
+    outs = _run_world(
+        [sys.executable, os.path.join("tests", "mp_outage_worker.py"),
+         *tail, "--checkpoint", str(flaky), "--outage_retries", "1"],
+        timeout=600)
+    # every rank saw the interruption and took the coordinated-resume path
+    for rank, (_, _, err) in enumerate(outs):
+        assert "[outage] training interrupted" in err, (rank, err)
+        assert "coordinated parallel resume" in err, (rank, err)
+    # the resumed world continued at GLOBAL epoch 2, printed once by rank 0
+    # (epochs 0/1 are not re-run), and no other rank prints epoch lines
+    assert outs[0][1].count("Epoch=2,") == 1, outs[0][1]
+    for _, out, _ in outs[1:]:
+        assert "Epoch=" not in out
+
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.train.checkpoint import load_checkpoint
+    a = load_checkpoint(str(flaky), init_mlp(jax.random.key(0)))
+    b = load_checkpoint(str(golden), init_mlp(jax.random.key(0)))
+    for u, v in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+
+    # durable-progress cleanup: the rank-suffixed stashes and every RNG
+    # sidecar were consumed by the successful resumed run
+    assert not (tmp_path / "flaky.msgpack.rng.npz").exists()
+    for r in range(1, WORLD):
+        assert not (tmp_path / f"flaky.msgpack.rank{r}").exists()
+        assert not (tmp_path / f"flaky.msgpack.rank{r}.rng.npz").exists()
+
+
 def test_four_process_netcdf_cli(tmp_path):
     """DDP + NetCDF data plane over 4 real processes — the flagship
     mnist_pnetcdf_cpu_mp.py capability at its own launch shape

@@ -225,9 +225,14 @@ def test_sidecar_survives_resume_that_dies_before_first_save(
     assert not sidecar.exists()  # consumed at the first overwrite
 
 
-def test_outage_retries_rejected_by_name_with_parallel_and_fused(tmp_path):
-    with pytest.raises(SystemExit, match="serial-only"):
+def test_outage_retries_rejected_by_name_with_fused(tmp_path):
+    # --parallel composes since round 5 (the coordinated re-exec resume,
+    # tests/test_multiprocess.py) — but only from the CLI: the resume
+    # REPLACES the process, so programmatic callers fail fast at parse
+    # time instead of getting a retry flag that cannot act.
+    with pytest.raises(SystemExit, match="CLI"):
         main(["--parallel", "--outage_retries", "1", "--path", str(tmp_path)])
+    # --fused still has no mid-run state to resume from
     with pytest.raises(SystemExit, match="fused"):
         main(["--cached", "--fused", "--outage_retries", "1",
               "--path", str(tmp_path)])
