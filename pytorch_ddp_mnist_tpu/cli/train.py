@@ -116,22 +116,26 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv,
         except RuntimeError as e:
             if attempt >= retries:
                 raise
-            # Outage vs program error (ADVICE r4), SERIAL runs only: a
-            # deterministic failure (XLA shape/compile error, NaN guard)
-            # on a healthy backend would just burn every retry re-hitting
-            # the same error before surfacing. Retry only when the error
-            # carries a backend-loss signature, or — for unrecognized
-            # messages — when a fresh out-of-process probe confirms the
-            # backend is actually down. PARALLEL runs deliberately skip
-            # this triage: the decision must be IDENTICAL on every rank
-            # (per-rank error strings and probe timings differ mid-outage,
-            # and a rank that re-raises while the others re-exec leaves
-            # the new world hanging in its rendezvous), so every rank
-            # retries unconditionally — a deterministic program error
-            # burns the bounded budget re-running, which is the price of
-            # never splitting the world's brain.
-            if not tcfg["parallel"] and not looks_like_backend_loss(e) \
-                    and _subprocess_backend_healthy(30.0):
+            # Outage vs program error (ADVICE r4). SERIAL runs retry when
+            # the error carries a backend-loss signature, or — for
+            # unrecognized messages — when a fresh out-of-process probe
+            # confirms the backend is actually down: a deterministic
+            # failure (XLA shape/compile error, NaN guard) on a healthy
+            # backend would just burn every retry re-hitting the same
+            # error. PARALLEL runs triage by SIGNATURE ONLY — no health
+            # probe: the retry decision must be as close to identical on
+            # every rank as possible, and probe outcomes are
+            # timing-dependent mid-outage while the signature is a pure
+            # function of the message. A rank-local error (host I/O, a
+            # program bug) thus fails fast instead of re-exec'ing one
+            # lone rank into a rendezvous no other rank will join; a real
+            # backend loss surfaces the gRPC signatures on every rank and
+            # the whole world takes the coordinated path together.
+            if tcfg["parallel"]:
+                if not looks_like_backend_loss(e):
+                    raise
+            elif not looks_like_backend_loss(e) and \
+                    _subprocess_backend_healthy(30.0):
                 raise
             attempt += 1
             print(f"[outage] training interrupted mid-run: {e}; waiting for "

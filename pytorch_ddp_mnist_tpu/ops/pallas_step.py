@@ -917,11 +917,16 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
         # the TPU-semantics simulator models both, and CI executes the
         # real DP ring kernel under it (tests/test_pallas_step.py).
         # Caveat (the diagnosed round-4 "hang"): the simulator blocks one
-        # host thread per live kernel, and the ring's entry barrier needs
-        # ALL replicas' kernels live at once — above ~4 concurrent
-        # kernels a small (1-core) CI host starves the pool and the run
-        # deadlocks at ~0% CPU. Callers keep simulator execution to <=4
-        # devices there; larger meshes stay trace-validated.
+        # host worker thread per live kernel, and the ring's entry
+        # barrier needs ALL replicas' kernels live at once — when the
+        # ring occupies EVERY device of the host pool there is no worker
+        # left for the simulator's coordination and the run deadlocks at
+        # ~0% CPU (measured: an 8-device ring starves an 8-device pool;
+        # n<=7 of 8 executes, and 8 of a 9-device pool executes).
+        # Workaround: provision ONE SPARE host device beyond the mesh
+        # (xla_force_host_platform_device_count = mesh + 1), as
+        # __graft_entry__.dryrun_multichip and the 8-replica simulator
+        # test do.
         raise ValueError(
             "the DP epoch kernel's ICI ring allreduce (remote DMAs + "
             "cross-chip semaphores) has no plain-interpreter lowering; "

@@ -76,13 +76,12 @@ VARIANTS = [
      ["--kernel", "pallas_epoch", "--dtype", "bfloat16", "--superstep", "8"]),
 ]
 
-# Single source of truth for the roofline math: bench.py's constants (a
-# model-shape change updated in one place keeps BENCH_r0X.json lines and
-# these matrix rows consistent).
-from bench import MACS_FWD_PER_IMG, V5E_PEAK_FLOPS_BF16  # noqa: E402
-
-FLOPS_PER_IMG = 3 * 2 * MACS_FWD_PER_IMG                  # fwd + ~2x bwd
-V5E_PEAK_BF16 = V5E_PEAK_FLOPS_BF16
+# Single source of truth for the roofline math: bench.perf_fields — the
+# same formula AND constants as the per-line tflops/mfu fields in
+# BENCH_r0X.json, so a FLOP-model change can never skew the two apart.
+# (The matrix keeps its historical row key 'mfu_vs_197t_bf16' for
+# cross-round diffability.)
+from bench import perf_fields  # noqa: E402
 
 
 def run_variant(argv, epochs: int):
@@ -163,12 +162,12 @@ def main(argv=None) -> int:
             return {"label": label, "argv": extra, "value": None,
                     "unit": None, "vs_baseline": None, "tflops": None,
                     "mfu_vs_197t_bf16": None, "error": err}
-        tf = rec["value"] * FLOPS_PER_IMG / 1e12
+        pf = perf_fields(rec["value"])
         print(f"  {label}: {rec['value']:,.0f} img/s/chip", file=sys.stderr)
         return {"label": label, "argv": extra, "value": rec["value"],
                 "unit": rec["unit"], "vs_baseline": rec["vs_baseline"],
-                "tflops": round(tf, 2),
-                "mfu_vs_197t_bf16": round(100 * tf * 1e12 / V5E_PEAK_BF16, 2)}
+                "tflops": pf["tflops"],
+                "mfu_vs_197t_bf16": pf["mfu_pct_vs_bf16_peak"]}
 
     rows = [measure(label, extra) for label, extra in VARIANTS]
 

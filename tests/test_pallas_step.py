@@ -6,6 +6,7 @@ implementation — the same verification the TPU compile gets, minus Mosaic.
 """
 
 import os
+import sys
 
 import pytest
 import numpy as np
@@ -1339,31 +1340,13 @@ def test_ring_protocol_executes_under_tpu_semantics_simulator():
             np.testing.assert_allclose(out[d, s], expect[s])
 
 
-@pytest.mark.integration
-@pytest.mark.parametrize("ring,n", [("allgather", 2), ("reduce_scatter", 2),
-                                    ("allgather", 4), ("reduce_scatter", 4)])
-def test_dp_epoch_kernel_executes_under_tpu_semantics_simulator(ring, n):
-    """The REAL `_make_epoch_kernel` DP branch — entry barrier, per-step
-    two-neighbor handshake, ring remote DMAs, fixed-order mean, resident-
-    weight SGD — EXECUTED end-to-end on the virtual CPU mesh by the
-    TPU-semantics simulator (VERDICT r4 #4: previously only shape-traced;
-    the round-4 hang does not reproduce under current jax). Two pins:
-
-    1. every replica's returned weights are BITWISE identical across the
-       mesh — the lockstep invariant on the SHIPPED kernel, not a
-       protocol re-statement;
-    2. final params match the serial oracle (`epoch_sgd_reference` on the
-       equivalent global batch with the same per-replica threefry masks)
-       to f32 summation-order tolerance, and the pmean'd losses match the
-       global-batch losses.
-    """
-    import jax as _jax
-
-    if _jax.device_count() < n:
-        pytest.skip(f"needs {n} devices")
-    if _jax.default_backend() != "cpu":
-        pytest.skip("oracle tolerances are CPU-calibrated")
-
+def _dp_sim_ring_check(ring, n):
+    """Shared body of the DP-simulator execution tests: run the REAL
+    `_make_epoch_kernel` DP branch at `n` replicas under the TPU-semantics
+    simulator and pin (1) bitwise cross-replica weight lockstep and
+    (2) equality with the serial global-batch oracle. Called in-process by
+    the parametrized test (n<=4 on the exactly-8-device CI pool) and from
+    a spare-device subprocess for the full 8-replica flagship shape."""
     from jax.experimental.pallas import tpu as pltpu
     from jax.sharding import Mesh, PartitionSpec as P
     from jax import shard_map
@@ -1426,6 +1409,66 @@ def test_dp_epoch_kernel_executes_under_tpu_semantics_simulator(ring, n):
                                    rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(losses).mean(0),
                                np.asarray(losses_ref), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("ring,n", [("allgather", 2), ("reduce_scatter", 2),
+                                    ("allgather", 4), ("reduce_scatter", 4)])
+def test_dp_epoch_kernel_executes_under_tpu_semantics_simulator(ring, n):
+    """The REAL `_make_epoch_kernel` DP branch — entry barrier, per-step
+    two-neighbor handshake, ring remote DMAs, fixed-order mean, resident-
+    weight SGD — EXECUTED end-to-end on the virtual CPU mesh by the
+    TPU-semantics simulator (VERDICT r4 #4: previously only shape-traced).
+    Two pins (see _dp_sim_ring_check): bitwise cross-replica weight
+    lockstep on the SHIPPED kernel, and equality with the serial
+    global-batch oracle. n<=4 in-process: the kernel must not occupy the
+    whole 8-device pool (the starvation deadlock in the epoch_fused_sgd
+    guard note); the full 8-replica shape runs in the spare-device
+    subprocess test below."""
+    import jax as _jax
+
+    if _jax.device_count() < n:
+        pytest.skip(f"needs {n} devices")
+    if _jax.default_backend() != "cpu":
+        pytest.skip("oracle tolerances are CPU-calibrated")
+    _dp_sim_ring_check(ring, n)
+
+
+@pytest.mark.integration
+def test_dp_epoch_kernel_full_eight_replica_ring_in_subprocess():
+    """The FLAGSHIP multi-chip shape — the 8-replica all-gather ring —
+    executed under the TPU-semantics simulator, lockstep- and
+    oracle-checked (_dp_sim_ring_check). Runs in a subprocess whose host
+    pool holds 8 + 1 devices: a ring occupying EVERY device of the pool
+    deadlocks the simulator's worker threads (measured; guard note in
+    epoch_fused_sgd), so the spare device is the enabling workaround —
+    and this test is the proof the workaround holds."""
+    import subprocess
+
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +"
+        " ' --xla_force_host_platform_device_count=9')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from jax.extend.backend import clear_backends\n"
+        "clear_backends()\n"
+        "assert jax.device_count() == 9\n"
+        "from test_pallas_step import _dp_sim_ring_check\n"
+        "_dp_sim_ring_check('allgather', 8)\n"
+        "print('RING8 OK')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the child sets its own 9-device pool
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo, os.path.join(repo, "tests"),
+         env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                       capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RING8 OK" in r.stdout
 
 
 @pytest.mark.integration
