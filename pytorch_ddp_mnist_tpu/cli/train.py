@@ -278,20 +278,29 @@ def main(argv=None) -> int:
                 f"--dropout_rng torch uses the XLA step with streamed "
                 f"masks; --kernel {tcfg['kernel']} draws its own masks "
                 f"in-kernel")
-        if (tcfg["outage_retries"] or tcfg["resume"]
-                or tcfg["start_epoch"]):
-            # The host-side torch generator's position is not captured by
-            # the checkpoint/sidecar state, so any resumed run would
-            # continue (or restart) the mask stream at the wrong position
-            # — silently breaking the bitwise contract that is this
-            # flag's entire point. Reject by name rather than degrade.
+        if tcfg["outage_retries"]:
+            # --resume/--start_epoch compose: the mask position is a pure
+            # function of completed steps, so a COLD resume fast-forwards
+            # the stream (make_torch_dropout_train_step skip_steps). The
+            # in-process retry cannot: its live generator has already
+            # advanced through the dead epoch's partial draws, and that
+            # position is host state the stash does not capture — reject
+            # by name rather than silently train on out-of-position masks.
             raise SystemExit(
                 "--dropout_rng torch does not compose with "
-                "--outage_retries/--resume/--start_epoch: the torch mask "
-                "stream's position is host state the checkpoint does not "
-                "carry, and a resumed run would train on out-of-position "
-                "masks; use the default jax dropout stream for resumable "
-                "runs")
+                "--outage_retries: the in-process retry would continue "
+                "the torch mask stream mid-epoch instead of at the resume "
+                "boundary; use --resume/--start_epoch (which re-seat the "
+                "stream exactly) or the default jax dropout stream")
+        if tcfg["resume"] and not tcfg["start_epoch"]:
+            # the fast-forward is driven by --start_epoch; a resume
+            # without it would train mid-run weights on masks from stream
+            # position 0 — silently off the bitwise trajectory this flag
+            # exists to guarantee
+            raise SystemExit(
+                "--dropout_rng torch with --resume needs --start_epoch "
+                "(it positions the mask stream at the resume boundary; "
+                "without it the stream would restart at epoch 0)")
         tcfg["kernel"] = "xla"
 
     # .pt/.pth checkpoint paths need torch — fail BEFORE training, not after
@@ -359,13 +368,8 @@ def main(argv=None) -> int:
             train_step = make_pallas_train_step(
                 tcfg["lr"], interpret=_pallas_interpret(),
                 dtype=tcfg["dtype"])
-        elif tcfg["dropout_rng"] == "torch":
-            # masks stream from torch's bitwise CPU bernoulli stream
-            # (train/loop.py make_torch_dropout_train_step; the draw of
-            # ddp_tutorial_cpu.py:47, seeded --seed)
-            from ..train.loop import make_torch_dropout_train_step
-            train_step = make_torch_dropout_train_step(tcfg["lr"],
-                                                       tcfg["seed"])
+        # (--dropout_rng torch builds its step AFTER the loader exists —
+        # the resume fast-forward needs the epoch's step count)
         num_shards = local_shards = 1
 
     global_batch = tcfg["batch_size"] * num_shards
@@ -568,6 +572,20 @@ def main(argv=None) -> int:
                               log=log, epoch_hook=hook, start_epoch=start,
                               eval_perm=eval_perm)
     else:
+        if tcfg["dropout_rng"] == "torch":
+            # Masks stream from torch's bitwise CPU bernoulli stream
+            # (train/loop.py make_torch_dropout_train_step; the draw of
+            # ddp_tutorial_cpu.py:47, seeded --seed). Built HERE, after
+            # the loader, because a resumed run (--start_epoch k)
+            # fast-forwards the stream by k epochs' worth of steps — the
+            # per-epoch step count comes from the sampler's padded shard
+            # size (every batch is wrap-padded to full batch_size).
+            from ..train.loop import make_torch_dropout_train_step
+            train_step = make_torch_dropout_train_step(
+                tcfg["lr"], tcfg["seed"],
+                skip_steps=tcfg["start_epoch"] * len(loader),
+                batch_size=tcfg["batch_size"])
+
         def run_fit(st, start):
             return fit(st, loader, x_test, test_labels,
                        epochs=tcfg["n_epochs"],

@@ -93,6 +93,18 @@ class TorchMT19937:
     def __call__(self) -> int:
         return int(self.draws(1)[0])
 
+    def skip(self, k: int) -> None:
+        """Advance the stream by ``k`` outputs without keeping them —
+        the deterministic fast-forward a resumed consumer uses to re-seat
+        its position (e.g. the dropout-mask stream at a --start_epoch
+        boundary). Chunked so skipping hundreds of millions of draws never
+        materializes one giant array."""
+        CHUNK = 1 << 20
+        while k > 0:
+            take = min(k, CHUNK)
+            self.draws(take)
+            k -= take
+
 
 def torch_randperm(n: int, seed: int) -> np.ndarray:
     """``torch.randperm(n, generator=manual_seed(seed))`` on CPU, bitwise.

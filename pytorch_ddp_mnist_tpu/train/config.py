@@ -136,7 +136,9 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "trajectory is bitwise-reproducible against a live "
                         "torch run that reseeds its generator with --seed "
                         "after model init. Serial streaming path only "
-                        "(no --parallel/--cached)")
+                        "(no --parallel/--cached); --resume/--start_epoch "
+                        "compose (the mask stream fast-forwards to the "
+                        "resume boundary), --outage_retries does not")
     t.add_argument("--eval_shuffle", action="store_true",
                    help="shuffle the eval batch segmentation per epoch like "
                         "the reference's test DataLoader(shuffle=True) "

@@ -68,7 +68,9 @@ def make_train_step(lr: float) -> Callable:
     return step
 
 
-def make_torch_dropout_train_step(lr: float, seed: int) -> Callable:
+def make_torch_dropout_train_step(lr: float, seed: int, *,
+                                  skip_steps: int = 0,
+                                  batch_size: int | None = None) -> Callable:
     """The `--dropout_rng torch` step: dropout masks stream from torch's
     bitwise CPU bernoulli stream (parallel/torch_rng.torch_bernoulli, the
     stream of reference ddp_tutorial_cpu.py:47) instead of jax's key chain.
@@ -81,11 +83,23 @@ def make_torch_dropout_train_step(lr: float, seed: int) -> Callable:
     HOST per step, exactly like torch; the jitted device step takes the
     mask as an input. The RNG key is threaded through untouched so the
     TrainState contract (and checkpoint/resume sidecars) are unchanged.
+
+    `skip_steps` re-seats the stream for a resumed run (--resume /
+    --start_epoch): the mask position is a pure function of completed
+    steps — every step draws exactly batch_size*HIDDEN1 masks of 2 engine
+    words each (the loaders wrap-pad every batch to full size) — so
+    fast-forwarding skip_steps*batch_size*HIDDEN1*2 outputs lands the
+    resumed trajectory bitwise on the unbroken run's masks.
     """
     from ..models.mlp import DROPOUT_RATE, MLP_DIMS
     from ..parallel.torch_rng import TorchMT19937, torch_bernoulli
 
     gen = TorchMT19937(seed)
+    if skip_steps:
+        if batch_size is None:
+            raise ValueError("skip_steps needs batch_size (the per-step "
+                             "mask row count)")
+        gen.skip(skip_steps * batch_size * MLP_DIMS[1] * 2)
     keep = 1.0 - DROPOUT_RATE
     hidden = MLP_DIMS[1]
 

@@ -276,9 +276,38 @@ def test_dropout_rng_torch_cli_trains_and_rejections(tmp_path, capsys):
         main(args + ["--cached"])
     with pytest.raises(SystemExit, match="in-kernel"):
         main(args + ["--kernel", "pallas"])
-    # resume paths cannot restore the host-side mask stream's position —
+    # the in-process retry cannot re-seat the host-side mask stream
+    # (already advanced through the dead epoch's partial draws) —
     # rejected by name so the bitwise contract can't silently break
-    for extra in (["--outage_retries", "1"], ["--resume", "x.msgpack"],
-                  ["--start_epoch", "1"]):
-        with pytest.raises(SystemExit, match="mask stream"):
-            main(args + extra)
+    with pytest.raises(SystemExit, match="mask stream"):
+        main(args + ["--outage_retries", "1"])
+    # --resume without --start_epoch would silently restart the stream at
+    # position 0 against mid-run weights — rejected by name
+    with pytest.raises(SystemExit, match="start_epoch"):
+        main(args + ["--resume", "x.msgpack"])
+
+
+def test_dropout_rng_torch_resume_is_bitwise(tmp_path):
+    """--dropout_rng torch composes with --resume/--start_epoch: the mask
+    stream's position is a pure function of completed steps (every batch
+    wrap-padded to full size), so the resumed run fast-forwards the
+    stream and lands bitwise on the unbroken trajectory."""
+    import jax as _jax
+    import numpy as _np
+
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.train.checkpoint import load_checkpoint
+
+    base = ["--limit", "300", "--batch_size", "64", "--path", str(tmp_path),
+            "--dropout_rng", "torch", "--lr", "0.1"]
+    golden = tmp_path / "golden.msgpack"
+    assert main(base + ["--n_epochs", "3", "--checkpoint", str(golden)]) == 0
+    part = tmp_path / "part.msgpack"
+    assert main(base + ["--n_epochs", "2", "--checkpoint", str(part)]) == 0
+    assert main(base + ["--n_epochs", "3", "--checkpoint", str(part),
+                        "--resume", str(part), "--start_epoch", "2"]) == 0
+    a = load_checkpoint(str(part), init_mlp(_jax.random.key(0)))
+    b = load_checkpoint(str(golden), init_mlp(_jax.random.key(0)))
+    for u, v in zip(_jax.tree_util.tree_leaves(a),
+                    _jax.tree_util.tree_leaves(b)):
+        _np.testing.assert_array_equal(_np.asarray(u), _np.asarray(v))
