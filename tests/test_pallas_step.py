@@ -1439,6 +1439,41 @@ def test_dp_epoch_kernel_executes_under_tpu_semantics_simulator(ring, n):
 
 
 @pytest.mark.integration
+def test_serial_epoch_kernel_clean_under_race_detector(capsys):
+    """The SERIAL whole-epoch kernel under the simulator's race detector:
+    no cross-device ring here, but the detector still checks the
+    pipelined input-block DMAs against the kernel body's reads and the
+    revisited loss-tile/resident-weight output blocks for unfenced
+    overlap — the single-chip half of the §5.2 machine-check."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (dropout_mask,
+                                                       epoch_fused_sgd)
+
+    S, B = 3, 16
+    params = init_mlp(jax.random.key(0))
+    x, y = _data(S * B, seed=9)
+    subs = jax.random.split(jax.random.key(4), S)
+    keys = jax.random.key_data(subs).astype(jnp.int32)
+    p_sim, l_sim = epoch_fused_sgd(
+        params, x, y, keys, 0.05, B, rng_impl="threefry",
+        interpret=pltpu.InterpretParams(detect_races=True))
+    # same numeric pin as the plain simulator test: bitwise equal to the
+    # interpreter masked run of the same keys
+    masks = jax.vmap(lambda k: dropout_mask(k, B))(subs).reshape(S * B, -1)
+    p_mk, l_mk = epoch_fused_sgd(params, x, y, None, 0.05, B, masks=masks,
+                                 interpret=True)
+    np.testing.assert_array_equal(np.asarray(l_sim), np.asarray(l_mk))
+    for a, b in zip(jax.tree_util.tree_leaves(p_sim),
+                    jax.tree_util.tree_leaves(p_mk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert "RACE DETECTED" not in capsys.readouterr().out
+    from jax._src.pallas.mosaic.interpret import (
+        interpret_pallas_call as _ipc)
+    assert _ipc.races is not None and _ipc.races.races_found is False
+
+
+@pytest.mark.integration
 @pytest.mark.parametrize("ring,n", [("allgather", 2), ("allgather", 3),
                                     ("reduce_scatter", 4)])
 def test_dp_ring_kernel_clean_under_simulator_race_detector(ring, n, capsys):
