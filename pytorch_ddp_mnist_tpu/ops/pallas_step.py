@@ -546,11 +546,12 @@ def _make_epoch_kernel(block: int, lr: float, *, rng: str = "core",
                 # Reference-RNG dropout: this sub-step's key words (already
                 # replica-distinct for DP — the wrapper folds the axis index
                 # into the epoch key before splitting) drive the exact
-                # models/mlp.py bernoulli draw on the VPU. A padded tail
-                # sub-step gets zero key words — harmless, its update is
-                # lr=0-masked below.
-                m = _threefry_mask_block(m_ref[k, 0].astype(jnp.uint32),
-                                         m_ref[k, 1].astype(jnp.uint32),
+                # models/mlp.py bernoulli draw on the VPU. The key table is
+                # SMEM-resident whole (see third_spec), indexed by global
+                # step. A padded tail sub-step gets zero key words —
+                # harmless, its update is lr=0-masked below.
+                m = _threefry_mask_block(m_ref[gs, 0].astype(jnp.uint32),
+                                         m_ref[gs, 1].astype(jnp.uint32),
                                          block)
             elif rng == "core":
                 # Multi-word seed: the hardware hashes (epoch_seed[,
@@ -1003,8 +1004,16 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
                 f"rng_impl='threefry' needs one key-word row per step: seed "
                 f"has {seed.shape[0]} rows for {nsteps} steps")
         third = seed.astype(jnp.int32)
-        third_spec = pl.BlockSpec((K, 2), lambda i: (i, 0),
-                                  memory_space=pltpu.SMEM)  # per-step keys
+        # The WHOLE per-step key table rides resident in SMEM (padded_steps
+        # x 2 int32 — ~4 KB for a real epoch) and the kernel indexes it by
+        # global step. A per-iteration (K, 2) streamed block would violate
+        # Mosaic's block-shape rule (second-to-minor dim must be divisible
+        # by 8 or equal the array dim — K is 1..8 against S rows), which
+        # the interpreter never checks: exactly the class of
+        # hardware-only lowering error tests/test_export_lowering.py now
+        # pins for every epoch-kernel variant.
+        third_spec = pl.BlockSpec((padded_steps, 2), lambda i: (0, 0),
+                                  memory_space=pltpu.SMEM)  # step key table
     elif rng == "core":
         third = jnp.asarray(seed, jnp.int32).reshape((1,))
         third_spec = pl.BlockSpec((1,), lambda i: (0,),

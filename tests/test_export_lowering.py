@@ -1,0 +1,136 @@
+"""TPU-platform export lowering of every single-chip Pallas variant.
+
+`jax.export` runs the full Pallas->Mosaic lowering pipeline CLIENT-SIDE
+(platforms=('tpu',)) — including Mosaic's block-shape legality checks
+(last two block dims divisible by (8, 128) or equal to the array dims,
+memory-space rules, etc.) that the Pallas INTERPRETER never enforces. A
+kernel can therefore pass every interpreter/simulator test and still be
+unlaunchable on hardware: exactly what happened to the round-4 in-kernel
+threefry epoch kernel, whose per-iteration (K, 2) SMEM key block was
+illegal (K=1 row: neither divisible by 8 nor equal to the S-row array) and
+which only surfaced in the round-5 hardware window's variant matrix.
+
+These tests pin "lowers for TPU" for every single-chip kernel variant the
+bench matrix measures, on a plain CPU host — no TPU needed, so CI catches
+the whole class. (The DP ring variants need a multi-device mesh inside
+shard_map; their hardware-semantics coverage is the TPU-semantics
+simulator suite in test_pallas_step.py.)
+
+Reference workload being lowered: the flagship trainer of
+/root/reference/ddp_tutorial_multi_gpu.py (118,272-param MLP, batch 128).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export
+
+from pytorch_ddp_mnist_tpu.models.mlp import init_mlp
+from pytorch_ddp_mnist_tpu.ops.pallas_step import (
+    HIDDEN1,
+    dropout_mask,
+    epoch_fused_sgd,
+    fused_loss_and_grads,
+    fused_loss_and_grads_rng,
+)
+
+B = 128
+S = 12  # steps: exercises loss-tile revisit (12 steps -> 2 tiles) + K tails
+
+
+def _export_tpu(fn, *args):
+    """Export `fn` for the TPU platform from this CPU host; any Mosaic
+    lowering-legality error raises here, without hardware."""
+    return export.export(jax.jit(fn), platforms=("tpu",))(*args)
+
+
+@pytest.fixture(scope="module")
+def epoch_args():
+    params = init_mlp(jax.random.PRNGKey(0))
+    xp8 = jnp.zeros((S * B, 784), jnp.uint8)
+    yp = jnp.zeros((S * B,), jnp.int32)
+    return params, xp8, yp
+
+
+@pytest.mark.parametrize("K", [1, 2, 4, 8])
+@pytest.mark.parametrize("bf16", [False, True], ids=["f32", "bf16"])
+def test_epoch_kernel_core_rng_lowers(epoch_args, K, bf16):
+    params, xp8, yp = epoch_args
+    f = functools.partial(epoch_fused_sgd, lr=0.01, batch=B,
+                          steps_per_iter=K, compute_bf16=bf16)
+    _export_tpu(f, params, xp8, yp, jnp.int32(7))
+
+
+@pytest.mark.parametrize("K", [1, 2, 4, 8])
+def test_epoch_kernel_threefry_lowers(epoch_args, K):
+    # The round-4 regression: per-step threefry key words streamed as an
+    # illegal (K, 2) SMEM block failed exactly this lowering; the key
+    # table is now SMEM-resident whole.
+    params, xp8, yp = epoch_args
+    keys = jax.random.split(jax.random.PRNGKey(1), S)
+    seed = jnp.asarray(jax.vmap(jax.random.key_data)(keys), jnp.int32)
+    f = functools.partial(epoch_fused_sgd, lr=0.01, batch=B,
+                          rng_impl="threefry", steps_per_iter=K)
+    _export_tpu(f, params, xp8, yp, seed)
+
+
+def test_epoch_kernel_threefry_ragged_tail_lowers(epoch_args):
+    # valid_steps < padded steps: the hot-path ragged form (scan body
+    # pre-pads indices and masks the tail) must lower too.
+    params, xp8, yp = epoch_args
+    keys = jax.random.split(jax.random.PRNGKey(1), S)
+    seed = jnp.asarray(jax.vmap(jax.random.key_data)(keys), jnp.int32)
+    f = functools.partial(epoch_fused_sgd, lr=0.01, batch=B,
+                          rng_impl="threefry", steps_per_iter=8,
+                          valid_steps=S - 2)
+    _export_tpu(f, params, xp8, yp, seed)
+
+
+def test_epoch_kernel_f32_input_lowers(epoch_args):
+    # Pre-normalized f32 input stream (the non-uint8 path).
+    params, xp8, yp = epoch_args
+    f = functools.partial(epoch_fused_sgd, lr=0.01, batch=B)
+    _export_tpu(f, params, xp8.astype(jnp.float32), yp, jnp.int32(7))
+
+
+def test_epoch_kernel_mask_streaming_lowers(epoch_args):
+    params, xp8, yp = epoch_args
+    masks = jnp.ones((S * B, HIDDEN1), jnp.float32)
+
+    def f(params, xp, yp, masks):
+        return epoch_fused_sgd(params, xp, yp, jnp.int32(0), 0.01, B,
+                               masks=masks)
+
+    _export_tpu(f, params, xp8, yp, masks)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_per_step_kernel_lowers(dtype):
+    params = init_mlp(jax.random.PRNGKey(0))
+    x = jnp.zeros((B, 784), dtype)
+    y = jnp.zeros((B,), jnp.int32)
+    mask = dropout_mask(jax.random.PRNGKey(2), B)
+    f = functools.partial(fused_loss_and_grads, scaled_mask=mask)
+    _export_tpu(f, params, x, y)
+
+
+def test_per_step_rng_kernel_lowers():
+    params = init_mlp(jax.random.PRNGKey(0))
+    x = jnp.zeros((B, 784), jnp.float32)
+    y = jnp.zeros((B,), jnp.int32)
+    _export_tpu(functools.partial(fused_loss_and_grads_rng, seed=7),
+                params, x, y)
+
+
+def test_per_step_kernel_ragged_batch_lowers():
+    # Non-block-multiple batch: grid + zero-padded tail path.
+    params = init_mlp(jax.random.PRNGKey(0))
+    n = 300
+    x = jnp.zeros((n, 784), jnp.float32)
+    y = jnp.zeros((n,), jnp.int32)
+    mask = dropout_mask(jax.random.PRNGKey(2), n)
+    f = functools.partial(fused_loss_and_grads, scaled_mask=mask)
+    _export_tpu(f, params, x, y)
