@@ -148,6 +148,14 @@ def main(argv=None) -> int:
                         "(per-variant value + timestamp + backend) so perf "
                         "claims are diffable across rounds, e.g. "
                         "bench_matrix_r03.json")
+    p.add_argument("--skip", default=None, metavar="SUBSTR",
+                   help="skip variants whose label contains SUBSTR (case-"
+                        "insensitive); they appear in the artifact as "
+                        "explicit null-valued skipped rows. Lets an "
+                        "unattended window defer wedge-suspect rows (the "
+                        "r05 superstep-8 row ran into a backend outage "
+                        "mid-row and could not be cleared of wedging the "
+                        "chip) to a final risky phase instead of mid-matrix")
     a = p.parse_args(argv)
     epochs = a.epochs if a.epochs is not None else (5 if a.quick else 50)
     if epochs < 1:
@@ -169,13 +177,26 @@ def main(argv=None) -> int:
                 "tflops": pf["tflops"],
                 "mfu_vs_197t_bf16": pf["mfu_pct_vs_bf16_peak"]}
 
-    rows = [measure(label, extra) for label, extra in VARIANTS]
+    def skipped(label, extra):
+        print(f"  {label}: SKIPPED (--skip {a.skip!r})", file=sys.stderr)
+        return {"label": label, "argv": extra, "value": None,
+                "unit": None, "vs_baseline": None, "tflops": None,
+                "mfu_vs_197t_bf16": None,
+                "error": [f"skipped by --skip {a.skip!r}"]}
+
+    def wanted(label):
+        return a.skip is None or a.skip.lower() not in label.lower()
+
+    rows = [measure(label, extra) if wanted(label) else skipped(label, extra)
+            for label, extra in VARIANTS]
 
     # A tunneled backend can drop mid-sweep and recover (each variant is its
     # own subprocess with bench.py's bounded startup retry); give failed rows
     # fresh passes at the end rather than losing them from the artifact.
+    # (Skipped rows are deliberate absences, not failures — never retried.)
     for attempt in range(a.retries):
-        failed = [i for i, r in enumerate(rows) if r["value"] is None]
+        failed = [i for i, r in enumerate(rows)
+                  if r["value"] is None and wanted(r["label"])]
         if not failed:
             break
         print(f"retry pass {attempt + 1}/{a.retries}: "
@@ -199,7 +220,10 @@ def main(argv=None) -> int:
     print("|---|---|---|---|")
     for r in rows:
         if r["value"] is None:
-            print(f"| {r['label']} | (failed) | — | — |")
+            word = ("skipped" if any("skipped by --skip" in e
+                                     for e in r.get("error") or [])
+                    else "failed")
+            print(f"| {r['label']} | ({word}) | — | — |")
             continue
         print(f"| {r['label']} | {r['value']:,.0f} | {r['tflops']:.2f} "
               f"| {r['mfu_vs_197t_bf16']:.2f}% |")

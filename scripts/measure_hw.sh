@@ -5,12 +5,16 @@
 # multi-hour stretches and can HANG probes — docs/PERF.md), then runs, in
 # priority order so a short window still captures the most valuable data:
 #   0. ONE flagless headline bench (the driver's metric, ~60 s)
-#   1. the full bench variant matrix   -> $1 (default bench_matrix_hw.json)
-#      + the bf16 promotion gate (phase 1b, informational)
-#   2. the superstep / bf16 / batch-scaling sweep (loose bench runs)
-#   3. inference throughput (--mode eval) + 10-epoch accuracy parity
+#   1. the bench variant matrix minus superstep rows -> $1
+#      (default bench_matrix_hw.json) + the bf16 promotion gate
+#      (phase 1b, informational)
+#   2. inference throughput (--mode eval) + 10-epoch accuracy parity
 #      (--mode accuracy, the north-star semantics check)
-#   4. the Mosaic hardware test suite  (PDMT_TPU_TESTS=1)
+#   3. the Mosaic hardware test suite  (PDMT_TPU_TESTS=1)
+#   4. LAST, the superstep / bf16 / batch-scaling sweep: the r05 window's
+#      outage began mid-superstep-8-row and the kernel could not be
+#      cleared of wedging the chip — everything wedge-suspect runs after
+#      the data we can't afford to lose.
 #
 # Every phase's exit status is tracked: the script exits nonzero with a
 # per-phase summary if ANY phase failed, so a caller keying on the exit
@@ -45,8 +49,9 @@ echo "== phase 0: flagless headline bench" >&2
 timeout 600 python bench.py --backend_wait 120
 status[headline]=$?
 
-echo "== phase 1: variant matrix -> $OUT" >&2
-python scripts/bench_matrix.py --epochs 400 --retries 2 --out "$OUT"
+echo "== phase 1: variant matrix (superstep rows deferred to phase 4) -> $OUT" >&2
+python scripts/bench_matrix.py --epochs 400 --retries 2 --skip "superstep" \
+  --out "$OUT"
 status[matrix]=$?
 
 # The config promotion gate — writes bench_calibration.json only if a
@@ -68,35 +73,38 @@ else
   status[promote]=$promote_rc
 fi
 
-echo "== phase 2: superstep / bf16 / batch-scaling sweep" >&2
+echo "== phase 2: inference throughput" >&2
+timeout 600 python bench.py --backend_wait 120 --mode eval
+status[eval]=$?
+
+echo "== phase 2b: 10-epoch accuracy parity (north-star semantics)" >&2
+timeout 900 python bench.py --backend_wait 120 --mode accuracy
+status[accuracy]=$?
+
+echo "== phase 3: Mosaic hardware suite" >&2
+PDMT_TPU_TESTS=1 timeout 3600 python -u -m pytest tests/test_pallas_step.py -q
+status[mosaic]=$?
+
+# Wedge-suspect rows LAST (see header): batch scaling first (K=1, safe
+# shapes), then superstep K ascending so a small-K wedge stops the sweep
+# before the K=8 configuration that coincided with the r05 outage.
+echo "== phase 4: batch-scaling + superstep sweep (wedge-suspect, last)" >&2
 status[sweep]=0
-for ARGS in "--dtype float32 --superstep 2" \
-            "--dtype float32 --superstep 4" \
-            "--dtype float32 --superstep 8" \
-            "--dtype bfloat16 --superstep 2" \
-            "--dtype bfloat16 --superstep 8" \
-            "--dtype float32 --superstep 1 --batch_size 256" \
+for ARGS in "--dtype float32 --superstep 1 --batch_size 256" \
             "--dtype float32 --superstep 1 --batch_size 512" \
-            "--dtype float32 --superstep 1 --batch_size 1024"; do
+            "--dtype float32 --superstep 1 --batch_size 1024" \
+            "--dtype float32 --superstep 2" \
+            "--dtype float32 --superstep 4" \
+            "--dtype bfloat16 --superstep 2" \
+            "--dtype float32 --superstep 8" \
+            "--dtype bfloat16 --superstep 8"; do
   echo "pallas_epoch $ARGS:" >&2
   timeout 600 python bench.py --backend_wait 120 --kernel pallas_epoch $ARGS \
     || status[sweep]=$?
 done
 
-echo "== phase 3: inference throughput" >&2
-timeout 600 python bench.py --backend_wait 120 --mode eval
-status[eval]=$?
-
-echo "== phase 3b: 10-epoch accuracy parity (north-star semantics)" >&2
-timeout 900 python bench.py --backend_wait 120 --mode accuracy
-status[accuracy]=$?
-
-echo "== phase 4: Mosaic hardware suite" >&2
-PDMT_TPU_TESTS=1 timeout 3600 python -u -m pytest tests/test_pallas_step.py -q
-status[mosaic]=$?
-
 fail=0
-for phase in headline matrix promote sweep eval accuracy mosaic; do
+for phase in headline matrix promote eval accuracy mosaic sweep; do
   echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
   ((status[$phase] != 0)) && fail=1
 done

@@ -155,3 +155,26 @@ def test_promote_script_crash_is_rc2_not_a_verdict(tmp_path):
     r = _run_gate(corrupt, out)
     assert r.returncode == 2, (r.returncode, r.stderr)
     assert not out.exists()
+
+
+def test_bench_matrix_skip_defers_rows_without_running_them(tmp_path):
+    # --skip records matching rows as explicit null-valued skips (never
+    # launched, never retried) so measure_hw.sh can defer the
+    # wedge-suspect superstep rows to its final phase; a skip-all pattern
+    # makes the run instant and backend-free. The gate must read such an
+    # artifact as "candidate rows unmeasured" -> not promoted (rc=1).
+    out_json = tmp_path / "m.json"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_matrix.py"),
+         "--skip", "/", "--epochs", "5", "--out", str(out_json)],
+        cwd=REPO, env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    rows = json.loads(out_json.read_text())["variants"]
+    assert len(rows) == 12
+    assert all(row["value"] is None and
+               "skipped by --skip" in row["error"][0] for row in rows)
+    assert "retry pass" not in r.stderr       # skips are not failures
+    assert "(skipped)" in r.stdout and "(failed)" not in r.stdout
+    cal = tmp_path / "cal.json"
+    g = _run_gate(out_json, cal)
+    assert g.returncode == 1 and not cal.exists()
