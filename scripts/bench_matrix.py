@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import subprocess
 import sys
@@ -94,13 +95,34 @@ def run_variant(argv, epochs: int):
     cmd = [sys.executable, "bench.py", "--epochs", str(epochs),
            "--backend_wait", "300"] + argv
     try:
-        out = subprocess.run(cmd, capture_output=True, text=True, timeout=1200)
+        # Unfiltered tracebacks: a failed row's artifact error must carry
+        # the real exception, not jax's "internal frames removed" banner
+        # (which is all the r05 threefry-row failure recorded).
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=1200,
+                             env=dict(os.environ,
+                                      JAX_TRACEBACK_FILTERING="off"))
     except subprocess.TimeoutExpired:
         return None, ["timeout after 1200s"]
     if out.returncode != 0:
-        return None, (out.stderr or out.stdout).strip().splitlines()[-1:]
+        return None, _failure_lines(out.stderr or out.stdout)
     line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
     return (json.loads(line[-1]) if line else None), None
+
+
+def _failure_lines(text: str, tail: int = 4, errs: int = 3):
+    """Compress a failed row's output into artifact-sized evidence: the
+    first `errs` lines naming an exception (ValueError: ..., RuntimeError:
+    ...) plus the last `tail` lines — enough to diagnose from the JSON
+    without rerunning the row."""
+    lines = [ln.rstrip() for ln in text.strip().splitlines() if ln.strip()]
+    named = [ln for ln in lines
+             if ln.lstrip() == ln and ": " in ln
+             and ln.split(":", 1)[0].endswith(("Error", "Exception",
+                                               "Interrupt", "Exit"))]
+    keep = named[:errs] + [ln for ln in lines[-tail:]
+                           if ln not in named[:errs]]
+    return keep
 
 
 def _backend_info() -> dict:
