@@ -1,10 +1,11 @@
 """The driver entry contract (__graft_entry__.py): entry() must hand back a
 jittable forward on the flagship model, and dryrun_multichip(n) must compile
 and run the SPMD training programs on an n-device mesh. Locked here so the
-contract can't rot between driver runs (conftest provides the 8-device CPU
-pool the dry run needs)."""
+contract can't rot between driver runs."""
 
 import os
+import pathlib
+import subprocess
 import sys
 
 import jax
@@ -13,6 +14,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import __graft_entry__ as graft  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
 def test_entry_forward_jits():
@@ -23,4 +26,14 @@ def test_entry_forward_jits():
 
 
 def test_dryrun_multichip_8():
-    graft.dryrun_multichip(8)  # asserts internally; must not raise
+    # In a SUBPROCESS, exactly like the driver runs it: dryrun_multichip
+    # re-provisions the host pool to mesh+1 devices (the simulator's spare
+    # worker) by restarting the backend with new XLA_FLAGS — done
+    # in-process, every later test in the suite would see a 9-device pool
+    # (this broke test_mesh/test_scan order-dependently when the spare
+    # landed).
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
