@@ -35,7 +35,12 @@ echo "hardware window opened $(date -u +%H:%M:%SZ) — automated measurement pas
 PDMT_WINDOW_WAIT=300 bash scripts/measure_hw.sh "$OUT" >> "$SWEEP" 2>&1
 rc=$?
 echo "measure_hw rc=$rc" >> "$SWEEP"
-git add "$OUT" bench_calibration.json "$SWEEP" 2>/dev/null
+# One pathspec per git-add: a single multi-file add aborts WHOLE on any
+# missing path (e.g. bench_calibration.json when the gate didn't promote),
+# which silently committed nothing in the r05 morning pass.
+for f in "$OUT" bench_calibration.json "$SWEEP"; do
+  git add -- "$f" 2>/dev/null || echo "hw_window: no $f to commit"
+done
 git commit -q -m "Hardware window: automated measurement pass ($OUT)" || true
 echo "=== hw_window done rc=$rc $(date -u +%H:%M:%SZ) ==="
 exit $rc
