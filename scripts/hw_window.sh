@@ -2,6 +2,10 @@
 # Unattended hardware-window runner: poll (forever, or $PDMT_WINDOW_POLL_MAX
 # probes) for the TPU backend from fresh hang-bounded subprocesses, then run
 # the full measurement queue (scripts/measure_hw.sh) and commit the results.
+# If the window closes mid-queue (the r05 morning pass lost its tunnel after
+# 10 of 12 matrix rows), the runner goes BACK to polling and reruns the queue
+# on the next window — up to $PDMT_WINDOW_MAX_PASSES passes or until one pass
+# completes with every phase green.
 #
 # This is the in-repo version of the /tmp watcher used in rounds 3-4 so the
 # pattern survives the machine: start it with nohup at the beginning of a
@@ -11,36 +15,51 @@
 # (docs/PERF.md outage log).
 #
 # Usage: nohup scripts/hw_window.sh [matrix_out.json] >> /tmp/hw_window.log 2>&1 &
-#   PDMT_WINDOW_POLL_MAX   max probes before giving up (default: unlimited)
+#   PDMT_WINDOW_POLL_MAX     max probes per pass before giving up (default:
+#                            unlimited)
+#   PDMT_WINDOW_MAX_PASSES   max measurement passes (default 3)
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-bench_matrix_hw.json}"
 MAX="${PDMT_WINDOW_POLL_MAX:-0}"
+PASSES="${PDMT_WINDOW_MAX_PASSES:-3}"
 
-echo "=== hw_window start $(date -u +%H:%M:%SZ) (out=$OUT) ==="
-n=0
-while true; do
-  if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "backend UP at $(date -u +%H:%M:%SZ)"; break
-  fi
-  n=$((n + 1))
-  if ((MAX > 0 && n >= MAX)); then
-    echo "backend still down after $n probes; giving up"; exit 1
-  fi
-  echo "backend still down $(date -u +%H:%M:%SZ)"; sleep 90
-done
+echo "=== hw_window start $(date -u +%H:%M:%SZ) (out=$OUT, passes<=$PASSES) ==="
+rc=1
+for ((pass = 1; pass <= PASSES; pass++)); do
+  n=0
+  while true; do
+    if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+      echo "backend UP at $(date -u +%H:%M:%SZ) (pass $pass)"; break
+    fi
+    n=$((n + 1))
+    if ((MAX > 0 && n >= MAX)); then
+      echo "backend still down after $n probes; giving up"; exit 1
+    fi
+    echo "backend still down $(date -u +%H:%M:%SZ)"; sleep 90
+  done
 
-SWEEP="${OUT%.json}_sweep.log"
-echo "hardware window opened $(date -u +%H:%M:%SZ) — automated measurement pass" > "$SWEEP"
-PDMT_WINDOW_WAIT=300 bash scripts/measure_hw.sh "$OUT" >> "$SWEEP" 2>&1
-rc=$?
-echo "measure_hw rc=$rc" >> "$SWEEP"
-# One pathspec per git-add: a single multi-file add aborts WHOLE on any
-# missing path (e.g. bench_calibration.json when the gate didn't promote),
-# which silently committed nothing in the r05 morning pass.
-for f in "$OUT" bench_calibration.json "$SWEEP"; do
-  git add -- "$f" 2>/dev/null || echo "hw_window: no $f to commit"
+  # Pass 1 writes $OUT; later passes get _p2/_p3 suffixes so a partial
+  # earlier artifact is never overwritten by a worse retry.
+  if ((pass == 1)); then PASS_OUT="$OUT"; else
+    PASS_OUT="${OUT%.json}_p${pass}.json"; fi
+  SWEEP="${PASS_OUT%.json}_sweep.log"
+  echo "hardware window opened $(date -u +%H:%M:%SZ) — measurement pass $pass" > "$SWEEP"
+  PDMT_WINDOW_WAIT=300 bash scripts/measure_hw.sh "$PASS_OUT" >> "$SWEEP" 2>&1
+  rc=$?
+  echo "measure_hw rc=$rc" >> "$SWEEP"
+  # One pathspec per git-add: a single multi-file add aborts WHOLE on any
+  # missing path (e.g. bench_calibration.json when the gate didn't promote),
+  # which silently committed nothing in the r05 morning pass.
+  for f in "$PASS_OUT" bench_calibration.json "$SWEEP"; do
+    git add -- "$f" 2>/dev/null || echo "hw_window: no $f to commit"
+  done
+  git commit -q -m "Hardware window: automated measurement pass $pass ($PASS_OUT)" || true
+  if ((rc == 0)); then
+    echo "=== hw_window done rc=0 after pass $pass $(date -u +%H:%M:%SZ) ==="
+    exit 0
+  fi
+  echo "pass $pass incomplete (rc=$rc); re-polling for the next window"
 done
-git commit -q -m "Hardware window: automated measurement pass ($OUT)" || true
-echo "=== hw_window done rc=$rc $(date -u +%H:%M:%SZ) ==="
+echo "=== hw_window done rc=$rc after $PASSES passes $(date -u +%H:%M:%SZ) ==="
 exit $rc
