@@ -96,8 +96,9 @@ def resolve_bench_config(dtype: str, superstep: int, kernel: str,
     through the committed hardware calibration -> (dtype, superstep).
 
     The calibration (bench_calibration.json) is written ONLY by
-    scripts/promote_epoch_dtype.py when one of the four single-chip
-    epoch-kernel matrix rows — {f32, bf16-matmul} x {K1, K8} — beats the
+    scripts/promote_epoch_dtype.py when one of the single-chip epoch-kernel
+    candidate matrix rows — bf16-matmul at K in {1, 8}, f32 superstep K in
+    {2, 4, 8} (promote_epoch_dtype.CANDIDATES) — beats the
     f32/K1 baseline in the SAME sweep (bf16 winners additionally pass a
     10-epoch accuracy-parity run; superstep alone is bitwise-equal math).
     That gate validates a single (dtype, K) PAIR, so the auto fields adopt

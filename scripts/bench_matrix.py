@@ -67,9 +67,16 @@ VARIANTS = [
     ("bf16-matmul / whole-epoch kernel, uint8 streaming",
      ["--kernel", "pallas_epoch", "--dtype", "bfloat16",
       "--superstep", "1"]),
-    # Grid super-stepping: K=8 SGD sub-steps per grid iteration (identical
-    # math; amortizes the fixed per-iteration cost). Composed with bf16
-    # matmuls this is the candidate fastest configuration.
+    # Grid super-stepping: K SGD sub-steps per grid iteration (identical
+    # math; amortizes the fixed per-iteration cost). K ascending — most of
+    # the amortization accrues by K=2/K=4, and K=8 (which coincided with
+    # the r05 outage and is wedge-suspect until cleared) stays last.
+    ("f32 / whole-epoch kernel / superstep 2",
+     ["--kernel", "pallas_epoch", "--dtype", "float32",
+      "--superstep", "2"]),
+    ("f32 / whole-epoch kernel / superstep 4",
+     ["--kernel", "pallas_epoch", "--dtype", "float32",
+      "--superstep", "4"]),
     ("f32 / whole-epoch kernel / superstep 8",
      ["--kernel", "pallas_epoch", "--dtype", "float32",
       "--superstep", "8"]),
@@ -178,6 +185,18 @@ def main(argv=None) -> int:
                         "r05 superstep-8 row ran into a backend outage "
                         "mid-row and could not be cleared of wedging the "
                         "chip) to a final risky phase instead of mid-matrix")
+    p.add_argument("--only", default=None, metavar="SUBSTR",
+                   help="measure ONLY variants whose label contains SUBSTR "
+                        "(case-insensitive); the rest become skipped rows "
+                        "(or are reused via --base)")
+    p.add_argument("--base", default=None, metavar="ARTIFACT",
+                   help="for rows not measured in THIS run (--only/--skip), "
+                        "reuse the measured row from this earlier artifact "
+                        "instead of recording a skip — marked with a "
+                        "reused_from field. Meant for SAME-WINDOW composition"
+                        " (measure_hw phase 5 merges fresh superstep rows "
+                        "with the phase-1 artifact so the promotion gate "
+                        "sees one complete same-chip sweep)")
     a = p.parse_args(argv)
     epochs = a.epochs if a.epochs is not None else (5 if a.quick else 50)
     if epochs < 1:
@@ -199,14 +218,29 @@ def main(argv=None) -> int:
                 "tflops": pf["tflops"],
                 "mfu_vs_197t_bf16": pf["mfu_pct_vs_bf16_peak"]}
 
+    base_rows = {}
+    if a.base:
+        with open(a.base) as f:
+            base_rows = {r["label"]: r
+                         for r in json.load(f)["variants"]
+                         if r.get("value") is not None}
+
     def skipped(label, extra):
-        print(f"  {label}: SKIPPED (--skip {a.skip!r})", file=sys.stderr)
+        why = (f"--only {a.only!r}" if a.only is not None
+               and a.only.lower() not in label.lower() else
+               f"--skip {a.skip!r}")
+        if label in base_rows:
+            print(f"  {label}: reused from {a.base}", file=sys.stderr)
+            return {**base_rows[label], "reused_from": a.base}
+        print(f"  {label}: SKIPPED ({why})", file=sys.stderr)
         return {"label": label, "argv": extra, "value": None,
                 "unit": None, "vs_baseline": None, "tflops": None,
                 "mfu_vs_197t_bf16": None,
-                "error": [f"skipped by --skip {a.skip!r}"]}
+                "error": [f"skipped by {why}"]}
 
     def wanted(label):
+        if a.only is not None and a.only.lower() not in label.lower():
+            return False
         return a.skip is None or a.skip.lower() not in label.lower()
 
     rows = [measure(label, extra) if wanted(label) else skipped(label, extra)
@@ -242,7 +276,7 @@ def main(argv=None) -> int:
     print("|---|---|---|---|")
     for r in rows:
         if r["value"] is None:
-            word = ("skipped" if any("skipped by --skip" in e
+            word = ("skipped" if any("skipped by --" in e
                                      for e in r.get("error") or [])
                     else "failed")
             print(f"| {r['label']} | ({word}) | — | — |")

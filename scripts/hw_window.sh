@@ -51,7 +51,8 @@ for ((pass = 1; pass <= PASSES; pass++)); do
   # One pathspec per git-add: a single multi-file add aborts WHOLE on any
   # missing path (e.g. bench_calibration.json when the gate didn't promote),
   # which silently committed nothing in the r05 morning pass.
-  for f in "$PASS_OUT" bench_calibration.json "$SWEEP"; do
+  for f in "$PASS_OUT" "${PASS_OUT%.json}_full.json" \
+           bench_calibration.json "$SWEEP"; do
     git add -- "$f" 2>/dev/null || echo "hw_window: no $f to commit"
   done
   git commit -q -m "Hardware window: automated measurement pass $pass ($PASS_OUT)" || true

@@ -11,10 +11,14 @@
 #   2. inference throughput (--mode eval) + 10-epoch accuracy parity
 #      (--mode accuracy, the north-star semantics check)
 #   3. the Mosaic hardware test suite  (PDMT_TPU_TESTS=1)
-#   4. LAST, the superstep / bf16 / batch-scaling sweep: the r05 window's
+#   4. the superstep / bf16 / batch-scaling sweep: the r05 window's
 #      outage began mid-superstep-8-row and the kernel could not be
 #      cleared of wedging the chip — everything wedge-suspect runs after
 #      the data we can't afford to lose.
+#   5. IF the sweep cleared every superstep config: measure JUST the
+#      superstep matrix rows, merge with phase 1's rows (same window/chip,
+#      bench_matrix --base) -> ${1%.json}_full.json + the gate on it, so
+#      an unattended window can still promote a superstep win.
 #
 # Every phase's exit status is tracked: the script exits nonzero with a
 # per-phase summary if ANY phase failed, so a caller keying on the exit
@@ -103,8 +107,43 @@ for ARGS in "--dtype float32 --superstep 1 --batch_size 256" \
     || status[sweep]=$?
 done
 
+# Promotion needs superstep rows IN a matrix artifact (one sweep, one
+# chip), but phase 1 skips them as wedge-suspect. Once the loose sweep
+# above has run every superstep config without wedging the chip, measuring
+# just the superstep rows is safe — merge them with phase 1's rows (same
+# window, same chip: --base) and re-run the gate, so an unattended window
+# can still promote a superstep win without re-measuring the 10 rows
+# phase 1 already has.
+echo "== phase 5: superstep matrix rows + gate (cleared by phase 4)" >&2
+status[fullmatrix]=0
+if ((status[sweep] == 0)); then
+  FULL="${OUT%.json}_full.json"
+  rm -f "$FULL"   # never let the gate read a previous window's artifact
+  python scripts/bench_matrix.py --epochs 400 --retries 1 \
+    --only superstep --base "$OUT" --out "$FULL"
+  status[fullmatrix]=$?
+  if ((status[fullmatrix] == 0)); then
+    timeout 900 python scripts/promote_epoch_dtype.py --matrix "$FULL"
+    full_rc=$?
+    if ((full_rc == 0)); then
+      echo "measure_hw: config PROMOTED from full matrix" >&2
+    elif ((full_rc == 1)); then
+      echo "measure_hw: full-matrix gate: not promoted" >&2
+    else
+      echo "measure_hw: full-matrix promotion gate FAILED rc=$full_rc" >&2
+      status[fullmatrix]=$full_rc
+    fi
+  else
+    echo "measure_hw: superstep matrix run failed rc=${status[fullmatrix]};" \
+         " gate not run" >&2
+  fi
+else
+  echo "measure_hw: skipping superstep matrix (sweep rc=${status[sweep]}" \
+       " did not clear the superstep rows)" >&2
+fi
+
 fail=0
-for phase in headline matrix promote eval accuracy mosaic sweep; do
+for phase in headline matrix promote eval accuracy mosaic sweep fullmatrix; do
   echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
   ((status[$phase] != 0)) && fail=1
 done

@@ -4,8 +4,9 @@ that promoted rbg in round 2 (docs/PERF.md):
 
   1. WIN: the candidate row must beat the f32/superstep-1 baseline row in
      the SAME variant-matrix sweep (one window, one chip — no cross-session
-     number mixing). Candidates = the four epoch-kernel matrix rows:
-     {f32, bf16-matmul} x {superstep 1, superstep 8}.
+     number mixing). Candidates = the epoch-kernel matrix rows: bf16-matmul
+     at K=1, f32 superstep K in {2, 4, 8}, and bf16-matmul at K=8 (see
+     CANDIDATES below).
   2. SEMANTICS: superstep is bitwise-identical math by construction (CI +
      Mosaic tests pin K==1 equality), so it needs no extra run. bf16
      matmuls change rounding, so a bf16 winner additionally needs a
@@ -36,13 +37,20 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 # baseline depend on artifact ordering.
 F32_LABEL = "f32 / whole-epoch kernel, uint8 streaming (single-chip headline)"
 BF16_LABEL = "bf16-matmul / whole-epoch kernel, uint8 streaming"
+SUP2_F32_LABEL = "f32 / whole-epoch kernel / superstep 2"
+SUP4_F32_LABEL = "f32 / whole-epoch kernel / superstep 4"
 SUP_F32_LABEL = "f32 / whole-epoch kernel / superstep 8"
 SUP_BF16_LABEL = "bf16-matmul / whole-epoch kernel / superstep 8"
 
-# (label, dtype, superstep); the first entry is the baseline.
+# (label, dtype, superstep); the first entry is the baseline. K=2/4 rows
+# joined after the r05 window left K=8 wedge-suspect: most of the grid
+# amortization accrues by small K, and a safe small-K win must be
+# promotable without waiting for K=8 to be cleared.
 CANDIDATES = (
     (F32_LABEL, "float32", 1),
     (BF16_LABEL, "bfloat16", 1),
+    (SUP2_F32_LABEL, "float32", 2),
+    (SUP4_F32_LABEL, "float32", 4),
     (SUP_F32_LABEL, "float32", 8),
     (SUP_BF16_LABEL, "bfloat16", 8),
 )

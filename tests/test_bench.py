@@ -627,6 +627,7 @@ def test_promote_epoch_config_gate_logic():
         return value
 
     f32, bf16 = mod.F32_LABEL, mod.BF16_LABEL
+    s2, s4 = mod.SUP2_F32_LABEL, mod.SUP4_F32_LABEL
     s8, s8b = mod.SUP_F32_LABEL, mod.SUP_BF16_LABEL
 
     # no baseline -> no promotion, no accuracy runs
@@ -651,14 +652,23 @@ def test_promote_epoch_config_gate_logic():
                    "epoch_kernel_superstep": 8,
                    "evidence": {"winner": s8, "value": 40e6,
                                 "baseline_value": 36e6,
-                                "unmeasured_candidates": [bf16, s8b]}}
+                                "unmeasured_candidates": [bf16, s2, s4,
+                                                          s8b]}}
     assert not acc_calls and "bitwise" in why and "unmeasured" in why
+
+    # a small-K superstep winner promotes the same way (K=2/4 joined the
+    # candidates when the r05 window left K=8 wedge-suspect)
+    cal, why = mod.decide([row(f32, 36e6), row(s2, 37e6), row(s4, 39e6)],
+                          0.01, acc)
+    assert cal["epoch_kernel_dtype"] == "float32"
+    assert cal["epoch_kernel_superstep"] == 4
+    assert not acc_calls and "bitwise" in why
 
     # bf16 winner: accuracy gate runs, parity passes -> promoted
     cal, why = mod.decide([row(f32, 36e6), row(bf16, 50e6)], 0.01, acc)
     assert cal["epoch_kernel_dtype"] == "bfloat16"
     assert cal["epoch_kernel_superstep"] == 1
-    assert cal["evidence"]["unmeasured_candidates"] == [s8, s8b]
+    assert cal["evidence"]["unmeasured_candidates"] == [s2, s4, s8, s8b]
     assert acc_calls == [("float32", 1), ("bfloat16", 1)]
     # bf16 x superstep-8 winner: the accuracy run uses the winning K
     acc_calls.clear()
@@ -694,8 +704,7 @@ def test_promote_gate_labels_and_matrix_explicitness():
 
     bm, gate = load("bench_matrix"), load("promote_epoch_dtype")
     labels = [label for label, _ in bm.VARIANTS]
-    for lbl in (gate.F32_LABEL, gate.BF16_LABEL, gate.SUP_F32_LABEL,
-                gate.SUP_BF16_LABEL):
+    for lbl, _d, _k in gate.CANDIDATES:
         assert lbl in labels, lbl
     for label, argv in bm.VARIANTS:
         assert "--dtype" in argv, (label, argv)

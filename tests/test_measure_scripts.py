@@ -170,7 +170,7 @@ def test_bench_matrix_skip_defers_rows_without_running_them(tmp_path):
         cwd=REPO, env=ENV, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     rows = json.loads(out_json.read_text())["variants"]
-    assert len(rows) == 12
+    assert len(rows) == 14
     assert all(row["value"] is None and
                "skipped by --skip" in row["error"][0] for row in rows)
     assert "retry pass" not in r.stderr       # skips are not failures
@@ -178,3 +178,50 @@ def test_bench_matrix_skip_defers_rows_without_running_them(tmp_path):
     cal = tmp_path / "cal.json"
     g = _run_gate(out_json, cal)
     assert g.returncode == 1 and not cal.exists()
+
+
+def test_promote_script_small_superstep_wins(tmp_path):
+    # K=2/K=4 joined the candidates after the r05 window left K=8
+    # wedge-suspect: a safe small-K win must promote even when the K=8
+    # rows never measured (deferred by --skip superstep), and superstep
+    # alone needs no accuracy run (bitwise-equal math).
+    _SUP4 = "f32 / whole-epoch kernel / superstep 4"
+    m = _matrix(tmp_path, [
+        _row(_F32, 36.9e6), _row(_BF16, 36.5e6),
+        _row("f32 / whole-epoch kernel / superstep 2", 38e6),
+        _row(_SUP4, 39.5e6), _row(_SUP8, None), _row(_SUP8B, None)])
+    out = tmp_path / "cal.json"
+    r = _run_gate(m, out)
+    assert r.returncode == 0, r.stderr
+    cal = json.loads(out.read_text())
+    assert cal["epoch_kernel_dtype"] == "float32"
+    assert cal["epoch_kernel_superstep"] == 4
+    assert cal["evidence"]["winner"] == _SUP4
+    assert sorted(cal["evidence"]["unmeasured_candidates"]) == [_SUP8B,
+                                                               _SUP8]
+    assert "no accuracy gate" in r.stderr
+
+
+def test_bench_matrix_base_reuses_prior_window_rows(tmp_path):
+    # measure_hw phase 5: rows excluded by --only are filled from the
+    # phase-1 artifact (--base) instead of skipped, marked reused_from —
+    # the gate then sees one complete same-window sweep. Rows in neither
+    # set stay explicit skips. --only "nothing-matches" keeps the run
+    # backend-free.
+    base = _matrix(tmp_path, [_row(_F32, 36.9e6), _row(_BF16, None)],
+                   "base.json")
+    out_json = tmp_path / "m.json"
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "bench_matrix.py"),
+         "--only", "no-such-label", "--base", str(base),
+         "--epochs", "5", "--out", str(out_json)],
+        cwd=REPO, env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    rows = {row["label"]: row
+            for row in json.loads(out_json.read_text())["variants"]}
+    assert rows[_F32]["value"] == 36.9e6
+    assert rows[_F32]["reused_from"] == str(base)
+    # base had _BF16 unmeasured (value null) -> NOT reusable, stays a skip
+    assert rows[_BF16]["value"] is None
+    assert "skipped by --only" in rows[_BF16]["error"][0]
+    assert rows[_SUP8]["value"] is None
