@@ -10,11 +10,13 @@ threefry epoch kernel, whose per-iteration (K, 2) SMEM key block was
 illegal (K=1 row: neither divisible by 8 nor equal to the S-row array) and
 which only surfaced in the round-5 hardware window's variant matrix.
 
-These tests pin "lowers for TPU" for every single-chip kernel variant the
-bench matrix measures, on a plain CPU host — no TPU needed, so CI catches
-the whole class. (The DP ring variants need a multi-device mesh inside
-shard_map; their hardware-semantics coverage is the TPU-semantics
-simulator suite in test_pallas_step.py.)
+These tests pin "lowers for TPU" for every kernel variant the bench
+matrix measures, on a plain CPU host — no TPU needed, so CI catches the
+whole class. The DP ring variants lower over a deviceless
+jax.sharding.AbstractMesh (remote DMAs, cross-chip semaphores and the
+entry barrier all go through the same client-side legality pipeline);
+their hardware-SEMANTICS coverage is the TPU-semantics simulator suite
+in test_pallas_step.py.
 
 Reference workload being lowered: the flagship trainer of
 /root/reference/ddp_tutorial_multi_gpu.py (118,272-param MLP, batch 128).
@@ -134,3 +136,58 @@ def test_per_step_kernel_ragged_batch_lowers():
     mask = dropout_mask(jax.random.PRNGKey(2), n)
     f = functools.partial(fused_loss_and_grads, scaled_mask=mask)
     _export_tpu(f, params, x, y)
+
+
+# ---------------------------------------------------------------------------
+# DP ring variants: an AbstractMesh lets the shard_map'd ring kernel —
+# remote DMAs, cross-chip semaphores, entry barrier — run the same
+# client-side Mosaic legality pipeline with no devices at all.
+# ---------------------------------------------------------------------------
+
+from jax.sharding import AbstractMesh, PartitionSpec as Pspec  # noqa: E402
+from jax import shard_map  # noqa: E402
+
+
+def _export_dp(n, *, ring="auto", bf16=False, rng_impl="core"):
+    mesh = AbstractMesh((n,), ("dp",))
+    params = init_mlp(jax.random.PRNGKey(0))
+    xp = jnp.zeros((n * S * B, 784), jnp.uint8)
+    yp = jnp.zeros((n * S * B,), jnp.int32)
+    if rng_impl == "threefry":
+        keys = jax.random.split(jax.random.PRNGKey(1), S)
+        seed = jnp.asarray(jax.vmap(jax.random.key_data)(keys), jnp.int32)
+    else:
+        seed = jnp.int32(7)
+
+    def f(params, xp, yp):
+        def shard(params, xp, yp):
+            return epoch_fused_sgd(params, xp, yp, seed, 0.01, B,
+                                   axis_name="dp", axis_size=n, ring=ring,
+                                   compute_bf16=bf16, rng_impl=rng_impl)
+        return shard_map(shard, mesh=mesh,
+                         in_specs=(Pspec(), Pspec("dp"), Pspec("dp")),
+                         out_specs=(Pspec(), Pspec("dp")),
+                         check_vma=False)(params, xp, yp)
+
+    _export_tpu(f, params, xp, yp)
+
+
+@pytest.mark.parametrize("n", [2, 8])
+def test_dp_ring_allgather_lowers(n):
+    # n=8 fills the all-gather ring's whole VMEM slot budget
+    _export_dp(n, ring="allgather")
+
+
+@pytest.mark.parametrize("n", [2, 16])
+def test_dp_ring_reduce_scatter_lowers(n):
+    # n=16 exceeds EPOCH_KERNEL_MAX_DEVICES: only the rs ring serves it
+    _export_dp(n, ring="reduce_scatter")
+
+
+def test_dp_ring_bf16_lowers():
+    _export_dp(4, bf16=True)
+
+
+def test_dp_ring_threefry_lowers():
+    # the fixed SMEM-resident key table, in the DP kernel
+    _export_dp(2, rng_impl="threefry")
