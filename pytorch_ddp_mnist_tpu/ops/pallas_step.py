@@ -447,9 +447,11 @@ def _make_epoch_kernel(block: int, lr: float, *, rng: str = "core",
       models/mlp.py's bernoulli draw for the same per-step keys, i.e. the
       REFERENCE RNG semantics at epoch-kernel speed (the dropout of
       /root/reference/ddp_tutorial_cpu.py:47, stream and all). Third input
-      = (K, 2) int32 per-step key words in SMEM. Pure jnp ops, so this
-      mode ALSO runs under the interpreter (CPU CI covers it end-to-end,
-      unlike "core").
+      = the WHOLE (padded_steps, 2) int32 key table, SMEM-resident and
+      indexed by global step (a streamed (K, 2) block would be an illegal
+      Mosaic block shape — the r05 hardware-window regression). Pure jnp
+      ops, so this mode ALSO runs under the interpreter (CPU CI covers it
+      end-to-end, unlike "core").
     - "masks": the third input is a streamed (K*block, HIDDEN1) pre-scaled
       mask block — the seeds->mask mapping abstracted to the caller
       (interpreter CI path of the wrapper plumbing).
