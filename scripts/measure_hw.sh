@@ -102,6 +102,16 @@ for ARGS in "--dtype float32 --superstep 1 --batch_size 256" \
             "--dtype bfloat16 --superstep 2" \
             "--dtype float32 --superstep 8" \
             "--dtype bfloat16 --superstep 8"; do
+  # Cheap 5-epoch probe first: if a config hangs, it hangs HERE (300 s,
+  # and the result says compile/launch, not scale — the r05 K=8 mystery);
+  # only a clean probe earns the 400-epoch timed row.
+  echo "pallas_epoch $ARGS (probe):" >&2
+  if ! timeout 300 python bench.py --backend_wait 120 --epochs 5 \
+       --kernel pallas_epoch $ARGS > /dev/null; then
+    echo "measure_hw: probe failed/hung for '$ARGS' — skipping its timed row" >&2
+    status[sweep]=1
+    continue
+  fi
   echo "pallas_epoch $ARGS:" >&2
   timeout 600 python bench.py --backend_wait 120 --kernel pallas_epoch $ARGS \
     || status[sweep]=$?
