@@ -11,9 +11,12 @@ ddp_tutorial_multi_gpu.py does per rank, with full DDP semantics
 Measured path = the framework's epoch-scanned trainer (train/scan.py) with
 MULTIPLE epochs fused into one device program: the dataset lives in HBM,
 batch gathers/dropout/fwd/bwd/allreduce/SGD all run under a nested lax.scan.
-Default variant on TPU = the fused Pallas train-step kernel + rbg (hardware)
-PRNG dropout stream — the fastest semantics-preserving configuration of the
-round-2 variant matrix (docs/PERF.md); --kernel/--impl select the others.
+Default variant on a single TPU chip = the WHOLE-EPOCH Pallas kernel
+(weights VMEM-resident across the epoch, uint8 input streaming) + the rbg
+(hardware) PRNG dropout stream — the fastest semantics-preserving
+configuration of every hardware variant matrix to date (docs/PERF.md;
+36.9-37.1M img/s/chip). Multi-chip meshes default to the fused per-step
+Pallas kernel; --kernel/--impl select the others.
 Fusing epochs removes host<->device round-trips from the measurement — on a
 tunneled/remote TPU a per-epoch sync costs ~70ms of RTT that says nothing
 about the hardware. Timing = full fetch of the loss curve (a guaranteed
