@@ -1216,6 +1216,39 @@ def test_threefry_kernel_rejects_legacy_threefry_config():
         _jax.config.update("jax_threefry_partitionable", prev)
 
 
+def test_epoch_kernel_threefry_simulator_at_real_epoch_scale():
+    """The fixed SMEM-resident threefry key table at the REAL flagship
+    epoch shape — S=469 steps (ragged-padded to 472 table rows), batch
+    128, uint8 input — executed by the TPU-semantics simulator and
+    bitwise equal to the masked-interpreter oracle. The r05 hardware
+    window failed this kernel at exactly this scale (the (K, 2) streamed
+    key block was Mosaic-illegal); tiny-shape tests keep the semantics
+    honest, this one keeps the full-scale SMEM-table shape honest."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import (dropout_mask,
+                                                       epoch_fused_sgd)
+
+    S, B = 469, 128
+    params = init_mlp(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (S * B, 784), dtype=np.uint8))
+    y = jnp.asarray(rng.integers(0, 10, (S * B,), dtype=np.int32))
+    subs = jax.random.split(jax.random.key(4), S)
+    keys = jax.random.key_data(subs).astype(jnp.int32)
+
+    p_sim, l_sim = epoch_fused_sgd(params, x, y, keys, 0.01, B,
+                                   rng_impl="threefry",
+                                   interpret=pltpu.InterpretParams())
+    masks = jax.vmap(lambda k: dropout_mask(k, B))(subs).reshape(S * B, -1)
+    p_mk, l_mk = epoch_fused_sgd(params, x, y, None, 0.01, B, masks=masks,
+                                 interpret=True)
+    np.testing.assert_array_equal(np.asarray(l_sim), np.asarray(l_mk))
+    for a, b in zip(jax.tree_util.tree_leaves(p_sim),
+                    jax.tree_util.tree_leaves(p_mk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_epoch_kernel_executes_under_tpu_semantics_simulator():
     """The REAL serial epoch kernel — SMEM key words, in-kernel threefry
     draw, loss tiling, resident weights — EXECUTED on CPU by the
