@@ -1249,6 +1249,36 @@ def test_epoch_kernel_threefry_simulator_at_real_epoch_scale():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_epoch_kernel_superstep8_simulator_at_real_epoch_scale():
+    """The wedge-suspect r05 configuration — superstep K=8 at the real
+    flagship epoch shape (S=469 ragged-padded to 472, grid 59, batch 128,
+    uint8 input, core-PRNG dropout) — EXECUTED by the TPU-semantics
+    simulator and bitwise K-invariant vs the K=1 run. With export
+    lowering also green (test_export_lowering), every client-side check
+    clears K=8: if the next hardware window still hangs it, the fault is
+    in the remote Mosaic compile or hardware-only runtime, not the
+    kernel's program."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from pytorch_ddp_mnist_tpu.ops.pallas_step import epoch_fused_sgd
+
+    S, B = 469, 128
+    params = init_mlp(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (S * B, 784), dtype=np.uint8))
+    y = jnp.asarray(rng.integers(0, 10, (S * B,), dtype=np.int32))
+    outs = {}
+    for K in (1, 8):
+        outs[K] = epoch_fused_sgd(params, x, y, jnp.int32(7), 0.01, B,
+                                  steps_per_iter=K,
+                                  interpret=pltpu.InterpretParams())
+    np.testing.assert_array_equal(np.asarray(outs[1][1]),
+                                  np.asarray(outs[8][1]))
+    for a, b in zip(jax.tree_util.tree_leaves(outs[1][0]),
+                    jax.tree_util.tree_leaves(outs[8][0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_epoch_kernel_executes_under_tpu_semantics_simulator():
     """The REAL serial epoch kernel — SMEM key words, in-kernel threefry
     draw, loss tiling, resident weights — EXECUTED on CPU by the
