@@ -1216,6 +1216,7 @@ def test_threefry_kernel_rejects_legacy_threefry_config():
         _jax.config.update("jax_threefry_partitionable", prev)
 
 
+@pytest.mark.integration
 def test_epoch_kernel_threefry_simulator_at_real_epoch_scale():
     """The fixed SMEM-resident threefry key table at the REAL flagship
     epoch shape — S=469 steps (ragged-padded to 472 table rows), batch
@@ -1249,6 +1250,7 @@ def test_epoch_kernel_threefry_simulator_at_real_epoch_scale():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.integration
 def test_epoch_kernel_superstep8_simulator_at_real_epoch_scale():
     """The wedge-suspect r05 configuration — superstep K=8 at the real
     flagship epoch shape (S=469 ragged-padded to 472, grid 59, batch 128,
