@@ -18,11 +18,15 @@
 #   PDMT_WINDOW_POLL_MAX     max probes per pass before giving up (default:
 #                            unlimited)
 #   PDMT_WINDOW_MAX_PASSES   max measurement passes (default 3)
+#   PDMT_MEASURE_CMD         the per-pass measurement script (default
+#                            scripts/measure_hw.sh; tests inject a stub to
+#                            pin the multi-pass/commit mechanics)
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-bench_matrix_hw.json}"
 MAX="${PDMT_WINDOW_POLL_MAX:-0}"
 PASSES="${PDMT_WINDOW_MAX_PASSES:-3}"
+MEASURE="${PDMT_MEASURE_CMD:-scripts/measure_hw.sh}"
 
 echo "=== hw_window start $(date -u +%H:%M:%SZ) (out=$OUT, passes<=$PASSES) ==="
 rc=1
@@ -45,7 +49,7 @@ for ((pass = 1; pass <= PASSES; pass++)); do
     PASS_OUT="${OUT%.json}_p${pass}.json"; fi
   SWEEP="${PASS_OUT%.json}_sweep.log"
   echo "hardware window opened $(date -u +%H:%M:%SZ) — measurement pass $pass" > "$SWEEP"
-  PDMT_WINDOW_WAIT=300 bash scripts/measure_hw.sh "$PASS_OUT" >> "$SWEEP" 2>&1
+  PDMT_WINDOW_WAIT=300 bash "$MEASURE" "$PASS_OUT" >> "$SWEEP" 2>&1
   rc=$?
   echo "measure_hw rc=$rc" >> "$SWEEP"
   # One pathspec per git-add: a single multi-file add aborts WHOLE on any
