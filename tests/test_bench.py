@@ -589,6 +589,18 @@ def test_resolve_bench_config_calibration(tmp_path):
     assert r("auto", 0, "xla", str(cal)) == ("float32", 1)
     assert r("auto", 0, "pallas_epoch", str(cal), n_chips=4) == \
         ("float32", 1)
+    # a small-K f32 calibration — the shape measure_hw phase 5's merged
+    # gate writes now that K=2/4 are candidates (superstep-only: no dtype
+    # change, bitwise-equal math)
+    cal4 = tmp_path / "cal4.json"
+    cal4.write_text('{"epoch_kernel_dtype": "float32", '
+                    '"epoch_kernel_superstep": 4}')
+    assert r("auto", 0, "pallas_epoch", str(cal4)) == ("float32", 4)
+    assert r("float32", 0, "pallas_epoch", str(cal4)) == ("float32", 4)
+    assert r("auto", 4, "pallas_epoch", str(cal4)) == ("float32", 4)
+    # an explicit K contradicting the validated pair passes through
+    # unpromoted (K=2 was never validated by this calibration)
+    assert r("auto", 2, "pallas_epoch", str(cal4)) == ("float32", 2)
     # junk calibrations never change behavior
     bad = tmp_path / "bad.json"
     bad.write_text("{not json")
