@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -17,6 +17,11 @@ test-integration:
 
 bench:
 	$(PY) bench.py
+
+# Full serve request path (admission -> micro-batcher -> bucketed AOT
+# engine) end-to-end on the host backend: one JSON line or a nonzero exit.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --mode serve --requests 300 --offered_rps 1500
 
 native:
 	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native

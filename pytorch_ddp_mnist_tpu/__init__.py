@@ -16,4 +16,9 @@ Layer map (mirrors reference SURVEY.md §1):
   L1 model/loop  -> pytorch_ddp_mnist_tpu.models, .ops, .train
 """
 
-__version__ = "0.4.0"
+__version__ = "0.6.0"
+
+# jax API-surface drift (shard_map spelling, threefry default) is absorbed
+# in ONE place; importing it here guarantees the alignment happens before
+# any framework RNG/SPMD use, whatever submodule the caller enters through.
+from . import compat  # noqa: E402,F401

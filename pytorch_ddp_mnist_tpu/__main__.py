@@ -3,6 +3,8 @@ framework's executables (each also runs standalone as its own module):
 
     train      the unified trainer CLI (cli/train.py; the reference's five
                entry scripts behind one config surface)
+    serve      micro-batching inference service from a checkpoint
+               (cli/serve.py; TCP JSON-lines server or --selftest)
     convert    IDX -> NetCDF converter (data/convert.py; the
                mnist_to_netcdf.ipynb workflow)
     download   mirrored, checksum-verified MNIST IDX fetch (data/download.py)
@@ -14,6 +16,8 @@ import sys
 
 _COMMANDS = {
     "train": ("pytorch_ddp_mnist_tpu.cli.train", "the unified trainer"),
+    "serve": ("pytorch_ddp_mnist_tpu.cli.serve",
+              "micro-batching inference service"),
     "convert": ("pytorch_ddp_mnist_tpu.data.convert",
                 "IDX -> NetCDF converter"),
     "download": ("pytorch_ddp_mnist_tpu.data.download", "MNIST IDX fetch"),

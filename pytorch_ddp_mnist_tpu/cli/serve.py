@@ -1,0 +1,171 @@
+"""Serving CLI — turn a training checkpoint into a running inference
+service.
+
+    python -m pytorch_ddp_mnist_tpu serve --checkpoint model.msgpack
+
+Two front doors over the same `serve.ServeService` request path:
+
+* default: a newline-delimited-JSON TCP server. One request per line,
+  `{"pixels": [784 numbers]}` -> `{"ok": true, "pred": k}`;
+  `{"op": "metrics"}` -> the metrics snapshot; backpressure rejections
+  answer `{"ok": false, "error": ..., "retry_after_ms": ...}` without
+  closing the connection. `--port 0` binds an ephemeral port and prints
+  `serving on HOST:PORT` (stderr) so a harness can connect. SIGINT/SIGTERM
+  triggers the graceful drain: in-flight requests finish, new ones are
+  refused, then the loop exits and the final metrics snapshot prints.
+* `--selftest N`: no socket — drive N open-loop Poisson requests through
+  the full admission/batcher/engine path in-process and print the metrics
+  snapshot as one JSON line. The smoke entry `make serve-smoke` and tests
+  use this.
+
+Without `--checkpoint` the engine serves freshly initialized params
+(`--seed`) — the full path exercisable anywhere, including under
+JAX_PLATFORMS=cpu where the whole subsystem behaves identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import numpy as np
+
+
+def build_engine(a):
+    import jax
+
+    from ..models import init_mlp
+    from ..parallel import data_parallel_mesh
+    from ..serve import InferenceEngine
+
+    mesh = None
+    if a.mesh:
+        mesh = data_parallel_mesh()
+        if mesh.devices.size == 1:
+            mesh = None  # 1-device mesh is the serial engine
+    if a.checkpoint:
+        return InferenceEngine.from_checkpoint(
+            a.checkpoint, max_batch=a.max_batch, mesh=mesh,
+            input_dtype=a.input_dtype)
+    return InferenceEngine(init_mlp(jax.random.key(a.seed)),
+                           max_batch=a.max_batch, mesh=mesh,
+                           input_dtype=a.input_dtype)
+
+
+async def _handle_conn(service, reader, writer):
+    from ..serve import Rejected
+    while True:
+        line = await reader.readline()
+        if not line:
+            break
+        try:
+            req = json.loads(line)
+            if req.get("op") == "metrics":
+                resp = {"ok": True, **service.metrics.snapshot()}
+            else:
+                pixels = np.asarray(req["pixels"])
+                resp = {"ok": True,
+                        "pred": await service.handle(pixels)}
+        except Rejected as e:
+            resp = {"ok": False, "error": e.reason,
+                    "retry_after_ms": round(e.retry_after_s * 1e3, 1)}
+        except Exception as e:  # malformed request: answer, don't die
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        writer.write((json.dumps(resp) + "\n").encode())
+        await writer.drain()
+    writer.close()
+
+
+async def _serve_tcp(service, host: str, port: int) -> None:
+    import signal
+
+    server = await asyncio.start_server(
+        lambda r, w: _handle_conn(service, r, w), host, port)
+    bound = server.sockets[0].getsockname()
+    print(f"serving on {bound[0]}:{bound[1]}", file=sys.stderr, flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix event loop
+            pass
+    await stop.wait()
+    print("drain: refusing new requests, finishing in-flight ones",
+          file=sys.stderr, flush=True)
+    await service.shutdown()
+    server.close()
+    await server.wait_closed()
+
+
+def main(argv=None) -> int:
+    from ..parallel.wireup import _honor_platform_env
+    _honor_platform_env()
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", default=None,
+                   help="params checkpoint to serve (.msgpack or the "
+                        "reference's .pt/.pth); default: fresh --seed init")
+    p.add_argument("--seed", type=int, default=0,
+                   help="init seed when no --checkpoint is given")
+    p.add_argument("--max_batch", type=int, default=64,
+                   help="largest coalesced batch = top compile bucket "
+                        "(powers of two up to it are precompiled)")
+    p.add_argument("--max_delay_ms", type=float, default=2.0,
+                   help="longest a request waits for coalescing partners "
+                        "before its batch flushes anyway")
+    p.add_argument("--queue_depth", type=int, default=256,
+                   help="admission budget: in-flight requests beyond this "
+                        "are rejected with a retry-after hint")
+    p.add_argument("--input_dtype", choices=("float32", "uint8"),
+                   default="float32",
+                   help="request payload dtype: pre-normalized float32 "
+                        "rows, or raw uint8 pixels normalized on device "
+                        "(the training path's exact op chain)")
+    p.add_argument("--mesh", action="store_true",
+                   help="replicate over every device of the data-parallel "
+                        "mesh (each batch's rows shard across chips); "
+                        "default single-device")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="TCP port (0 = ephemeral; the bound port prints "
+                        "to stderr)")
+    p.add_argument("--selftest", type=int, default=None, metavar="N",
+                   help="serve N open-loop Poisson requests in-process "
+                        "and print the metrics snapshot (no socket)")
+    p.add_argument("--offered_rps", type=float, default=500.0,
+                   help="--selftest arrival rate")
+    a = p.parse_args(argv)
+    for name in ("max_batch", "queue_depth"):
+        if getattr(a, name) < 1:
+            p.error(f"--{name} must be >= 1")
+    if a.max_delay_ms < 0:
+        p.error("--max_delay_ms must be >= 0")
+
+    from ..serve import ServeService
+    engine = build_engine(a)
+    service = ServeService(engine, max_delay_ms=a.max_delay_ms,
+                           max_depth=a.queue_depth)
+    print(f"engine warm: buckets={list(engine.buckets)} "
+          f"compiles={engine.compile_count} "
+          f"input_dtype={engine.input_dtype}", file=sys.stderr, flush=True)
+
+    if a.selftest is not None:
+        if a.selftest < 1:
+            p.error("--selftest must be >= 1")
+        from ..serve.loadgen import run_loadgen
+        out = run_loadgen(service, offered_rps=a.offered_rps,
+                          n_requests=a.selftest, seed=a.seed)
+        out.pop("predictions")          # counters, not payloads
+        print(json.dumps(out))
+        return 0
+
+    asyncio.run(_serve_tcp(service, a.host, a.port))
+    print(json.dumps(service.metrics.snapshot()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

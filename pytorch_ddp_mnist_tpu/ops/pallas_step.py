@@ -56,6 +56,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import tpu_compiler_params
+
 from ..data.mnist import MNIST_MEAN, MNIST_STD
 from ..models.mlp import MLP_DIMS, DROPOUT_RATE
 
@@ -168,6 +170,14 @@ EPOCH_COMM_ROWS = _COMM_LAYOUT[-1][0] + _COMM_LAYOUT[-1][1]   # 1042
 # blocks of VMEM plus an 8-rows-per-device tile-floor term — ~1.1 MB at n=8,
 # ~+8 KB per extra device: one flat grad buffer + n-1 chunk recv slots).
 EPOCH_KERNEL_MAX_DEVICES = 8
+
+# rng_impl='threefry' rides the WHOLE per-step key table SMEM-resident as a
+# (padded_steps, 2) int32 block (~4 KB for a real 469-step epoch). SMEM is
+# the kernel's scarcest memory — scalars and control flow only — so the
+# table gets an explicit steps cap like every other resource budget here:
+# 4096 steps = 32 KB, ~8x the reference epoch, far below the point where
+# Mosaic lowering would fail opaquely instead.
+EPOCH_KERNEL_MAX_RNG_STEPS = 4096
 
 
 def _rs_chunk_rows(n: int) -> int:
@@ -379,7 +389,7 @@ def _run_fused(params, x, y, mask_or_seed, *, in_kernel_rng, interpret):
         # The gradient outputs accumulate across grid steps, so the batch
         # grid MUST run sequentially — 'arbitrary' pins that down even on
         # megacore parts (v4/v5p) where 'parallel' dims split across cores.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",)),
         out_shape=out_shapes,
         in_specs=[
@@ -978,6 +988,15 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             f"number of steps present in xp)")
     grid_n = -(-nsteps // K)
     padded_steps = grid_n * K
+    if rng == "threefry" and padded_steps > EPOCH_KERNEL_MAX_RNG_STEPS:
+        raise ValueError(
+            f"rng_impl='threefry' keeps the whole (padded_steps, 2) int32 "
+            f"per-step key table SMEM-resident; {padded_steps} steps "
+            f"({padded_steps * 8} bytes) > {EPOCH_KERNEL_MAX_RNG_STEPS} "
+            f"exceeds the SMEM key-table budget "
+            f"({EPOCH_KERNEL_MAX_RNG_STEPS * 8 // 1024} KB). Split the run "
+            f"into shorter epochs, or use rng_impl='core' (one SMEM seed "
+            f"scalar) / pre-drawn masks")
     pad_steps = padded_steps - nsteps
     if pad_steps:
         # Fallback for direct ragged callers: zero-pad the tail to a whole
@@ -1043,7 +1062,7 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             pltpu.SemaphoreType.REGULAR,                 # left ready
             pltpu.SemaphoreType.REGULAR,                 # right ready
         ]
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             collective_id=7, has_side_effects=True)
     elif dp:
@@ -1054,12 +1073,12 @@ def epoch_fused_sgd(params, xp, yp, seed, lr: float, batch: int, *,
             pltpu.SemaphoreType.REGULAR,                         # left ready
             pltpu.SemaphoreType.REGULAR,                         # right ready
         ]
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             collective_id=7, has_side_effects=True)
     else:
         scratch_shapes = []
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = tpu_compiler_params(
             dimension_semantics=("arbitrary",))  # steps are sequential
     loss, w1, b1, w2, b2, w3 = pl.pallas_call(
         _make_epoch_kernel(block, lr, rng=rng,
@@ -1245,7 +1264,7 @@ def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False,
     grads, redundant SGD) with the Pallas kernel as the local compute.
     dtype='bfloat16' as in make_pallas_train_step."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..compat import shard_map
     from ..parallel.mesh import DATA_AXIS
     from .sgd import sgd_step
 

@@ -30,21 +30,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
-
+from ..compat import pvary as _pvary, shard_map  # noqa: F401 (_pvary re-exported)
 from ..models.mlp import mlp_apply
 from ..ops.loss import cross_entropy
 from ..ops.sgd import sgd_step
 from .mesh import DATA_AXIS, data_parallel_mesh
-
-
-def _pvary(tree, axis: str):
-    """Cast a replicated pytree to device-varying along `axis` (per-replica
-    copies). jax >= 0.9 spells this pcast; older spells it pvary."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.tree_util.tree_map(
-            lambda a: jax.lax.pcast(a, axis, to="varying"), tree)
-    return jax.tree_util.tree_map(lambda a: jax.lax.pvary(a, axis), tree)
 
 
 def dp_mesh(devices=None) -> Mesh:

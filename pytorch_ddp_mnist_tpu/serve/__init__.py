@@ -1,0 +1,89 @@
+"""serve/ — micro-batching TPU inference: checkpoint in, request service out.
+
+The training side of this framework ends at a params checkpoint; this
+package is the other half of the north star ("serves heavy traffic"): an
+engine that pre-compiles a bucketed ladder of batch shapes so no request
+ever pays a cold XLA compile (`engine.py`), an asyncio micro-batcher that
+coalesces requests up to a size/deadline knob (`batcher.py`), bounded-queue
+admission control with backpressure and graceful drain (`admission.py`),
+latency-percentile metrics (`metrics.py`), and an open-loop Poisson load
+generator (`loadgen.py`). `ServeService` wires them into the one request
+path every front door (cli/serve.py TCP server, bench.py --mode serve,
+tests) shares.
+
+Everything runs identically under JAX_PLATFORMS=cpu — the full request path
+is exercised by tier-1 tests without hardware.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .admission import AdmissionController, Rejected  # noqa: F401
+from .batcher import MicroBatcher  # noqa: F401
+from .engine import InferenceEngine, bucket_ladder  # noqa: F401
+from .metrics import LatencyHistogram, ServeMetrics  # noqa: F401
+
+
+class ServeService:
+    """admission -> batcher -> engine, with per-request latency metrics.
+
+    `handle(row)` is the whole request path: admit (or raise `Rejected`),
+    coalesce, run, scatter, record. Construction wires the metrics' queue-
+    depth gauge to the controller and the batcher's occupancy recorder to
+    the same metrics object, so a snapshot is always internally consistent.
+    """
+
+    def __init__(self, engine: InferenceEngine, *, max_batch=None,
+                 max_delay_ms: float = 2.0, max_depth: int = 256,
+                 retry_after_s: float = 0.05, clock=None):
+        import time
+        clock = clock or time.monotonic
+        self.engine = engine
+        self.admission = AdmissionController(max_depth,
+                                             retry_after_s=retry_after_s)
+        self.metrics = ServeMetrics(depth_fn=lambda: self.admission.depth,
+                                    clock=clock)
+        self.batcher = MicroBatcher(engine, max_batch=max_batch,
+                                    max_delay_ms=max_delay_ms,
+                                    metrics=self.metrics, clock=clock)
+        self.clock = clock
+
+    async def handle(self, row) -> int:
+        """Serve one request row -> predicted class. Raises `Rejected`
+        under backpressure or drain (metrics count it either way)."""
+        self.metrics.record_arrival()
+        try:
+            self.admission.admit()
+        except Rejected:
+            self.metrics.record_reject()
+            raise
+        t0 = self.clock()
+        try:
+            pred = await self.batcher.submit(row)
+        except Exception:
+            # admitted but errored (bad payload, engine failure): counted —
+            # a fault storm must not read as a healthy low-traffic interval
+            self.metrics.record_failure()
+            raise
+        finally:
+            self.admission.release()
+        self.metrics.record_done(self.clock() - t0)
+        return pred
+
+    async def shutdown(self) -> None:
+        """Graceful drain: refuse new work, serve everything admitted."""
+        self.admission.begin_drain()
+        await self.batcher.drain()
+        await self.admission.drained()
+
+
+def run_until_drained(service: ServeService, coro):
+    """Run `coro` on a fresh event loop, then drain the service — the
+    synchronous front doors' (bench, CLI selftest) shared harness."""
+    async def _main():
+        try:
+            return await coro
+        finally:
+            await service.shutdown()
+    return asyncio.run(_main())

@@ -1,0 +1,79 @@
+"""Admission control: a bounded request queue with backpressure and a
+graceful drain.
+
+An open-loop client population does not slow down because the server is
+busy — at overload the only choices are unbounded queue growth (every
+request eventually served, none within its deadline) or early rejection.
+The controller bounds in-flight depth at `max_depth`: past it, requests are
+refused IMMEDIATELY with a retry-after hint, keeping the latency of the
+admitted population flat while the reject rate absorbs the overload (the
+standard TPU-serving admission pattern — the queue protects the batcher,
+the batcher protects the MXU).
+
+Shutdown is a drain, not a drop: `begin_drain()` closes the door (new
+arrivals rejected as draining) while everything already admitted runs to
+completion; `await drained()` returns once in-flight work hits zero.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+
+class Rejected(Exception):
+    """Request refused by admission control; `retry_after_s` is the hint a
+    transport should surface (HTTP Retry-After analog)."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    def __init__(self, max_depth: int = 256, *, retry_after_s: float = 0.05):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1; got {max_depth}")
+        self.max_depth = int(max_depth)
+        self.retry_after_s = float(retry_after_s)
+        self.depth = 0          # admitted and not yet released
+        self.admitted = 0
+        self.rejected = 0
+        self.draining = False
+        self._empty: Optional[asyncio.Event] = None
+
+    def admit(self) -> None:
+        """Take one slot or raise Rejected. Pair with `release()`."""
+        if self.draining:
+            self.rejected += 1
+            raise Rejected("draining: server is shutting down",
+                           self.retry_after_s)
+        if self.depth >= self.max_depth:
+            self.rejected += 1
+            raise Rejected(
+                f"queue depth {self.depth} at budget {self.max_depth}",
+                self.retry_after_s)
+        self.depth += 1
+        self.admitted += 1
+
+    def release(self) -> None:
+        assert self.depth > 0, "release() without a matching admit()"
+        self.depth -= 1
+        if self.depth == 0 and self._empty is not None:
+            self._empty.set()
+
+    def begin_drain(self) -> None:
+        """Stop admitting; already-admitted requests run to completion."""
+        self.draining = True
+
+    async def drained(self) -> None:
+        """Resolve once draining AND no request is in flight."""
+        self.begin_drain()
+        if self.depth == 0:
+            return
+        if self._empty is None:
+            self._empty = asyncio.Event()
+        while self.depth > 0:
+            self._empty.clear()
+            await self._empty.wait()

@@ -1,0 +1,133 @@
+"""Async micro-batching queue: coalesce single-row requests into bucketed
+engine calls.
+
+The latency/throughput knob of every TPU serving stack: one request per
+forward pass wastes the MXU (a (1, 784) matmul is pure dispatch overhead),
+while unbounded coalescing holds early arrivals hostage to late ones. The
+batcher bounds both sides — a flush fires when `max_batch` rows are pending
+(throughput side) or when the OLDEST pending request has waited
+`max_delay_ms` (latency side), whichever comes first. Flushed rows are
+stacked, padded to the engine's nearest bucket, run as one executable call,
+and scattered back to each request's future.
+
+The deadline clock is injectable (`clock=`) and the flush decision is a pure
+function of (now, pending) — `flush_due(now)` — so tests drive coalescing
+deterministically under a fake clock instead of racing real timers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .engine import IN_DIM
+
+
+class MicroBatcher:
+    """Coalesces `submit`ted rows into engine calls.
+
+    Not thread-safe: like any asyncio building block it lives on one event
+    loop. The engine call itself is synchronous (JAX blocks until the
+    executable returns) — at MNIST-MLP scale a bucket forward is far cheaper
+    than a loop tick, so handing it to a thread pool would only add latency.
+    """
+
+    def __init__(self, engine, *, max_batch: Optional[int] = None,
+                 max_delay_ms: float = 2.0, metrics=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.max_batch = int(max_batch or engine.max_batch)
+        if not 1 <= self.max_batch <= engine.max_batch:
+            raise ValueError(
+                f"max_batch {self.max_batch} outside [1, {engine.max_batch}]"
+                f" (the engine's largest precompiled bucket)")
+        if max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms must be >= 0; got {max_delay_ms}")
+        self.max_delay_s = max_delay_ms / 1000.0
+        self.metrics = metrics
+        self.clock = clock
+        self.engine_in_dim = IN_DIM
+        # (row, future, t_enqueue) triples awaiting a flush
+        self._pending: List[Tuple[np.ndarray, asyncio.Future, float]] = []
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self.flushes = 0
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    def flush_due(self, now: float) -> bool:
+        """True when the pending set must flush at time `now`: full batch,
+        or the oldest request's deadline has arrived."""
+        if not self._pending:
+            return False
+        if len(self._pending) >= self.max_batch:
+            return True
+        return now - self._pending[0][2] >= self.max_delay_s
+
+    async def submit(self, row) -> int:
+        """Enqueue one request row; resolves to its predicted class.
+
+        A malformed row raises HERE, synchronously to its own caller — it
+        must never reach the flush, where one bad row would poison the
+        whole coalesced batch (np.stack of ragged rows raises after the
+        pending set was already swapped out, hanging every other waiter
+        and leaking their admission slots)."""
+        row = np.asarray(row).reshape(-1)   # (1, 784) and (784,) both fine
+        if row.shape != (self.engine_in_dim,):
+            raise ValueError(f"request row must have {self.engine_in_dim} "
+                             f"pixels; got shape {np.asarray(row).shape}")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((row, fut, self.clock()))
+        if len(self._pending) >= self.max_batch:
+            self.flush()
+        elif self._timer is None:
+            # one timer per oldest-pending request: it fires at that
+            # request's deadline and flush() re-arms for the next batch
+            self._timer = loop.call_later(self.max_delay_s, self._on_timer)
+        return await fut
+
+    def _on_timer(self) -> None:
+        self._timer = None
+        if self.flush_due(self.clock()):
+            self.flush()
+        elif self._pending:
+            # injected-clock drift (tests): re-arm for the remainder
+            remain = self.max_delay_s - (self.clock() - self._pending[0][2])
+            self._timer = asyncio.get_event_loop().call_later(
+                max(remain, 0.0), self._on_timer)
+
+    def flush(self) -> int:
+        """Run every pending row through the engine now; returns the number
+        of rows flushed. Fills each request's future (result or the
+        engine's exception)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        batch, self._pending = self._pending, []
+        if not batch:
+            return 0
+        try:
+            rows = np.stack([r for r, _, _ in batch])
+            _, preds, bucket = self.engine._run_bucket(
+                self.engine._as_rows(rows))
+        except Exception as e:  # scatter the failure — a waiter must never
+            for _, fut, _ in batch:                       # hang on a crash
+                if not fut.done():
+                    fut.set_exception(e)
+            return len(batch)
+        self.flushes += 1
+        if self.metrics is not None:
+            self.metrics.record_batch(len(batch), bucket)
+        for (_, fut, _), pred in zip(batch, preds):
+            if not fut.done():
+                fut.set_result(int(pred))
+        return len(batch)
+
+    async def drain(self) -> None:
+        """Flush whatever is pending and return once it is served."""
+        self.flush()

@@ -1,0 +1,186 @@
+"""Inference engine: a training checkpoint turned into a warm, bucketed
+forward pass that can never cold-compile mid-request.
+
+Serving on TPU is won or lost at the batching/compile-cache layer, not the
+model (PAPERS.md: the Gemma-on-TPU serving comparison): a request that
+arrives with a batch shape XLA has not seen pays a full compile — seconds of
+p99 latency on a path whose steady state is microseconds. The engine
+therefore AOT-compiles a fixed bucket ladder of batch shapes (powers of two
+up to `max_batch`) at startup via `jax.jit(...).lower(...).compile()` and
+serves every request from those executables. A compiled executable rejects
+any other shape by construction, so "no cold compile after warmup" is a
+structural guarantee, not a convention — `compile_count` instruments it for
+tests.
+
+Data-parallel replication is the same mesh story as training: pass a
+`parallel.mesh` Mesh and params replicate over it while each bucket's rows
+shard across `DATA_AXIS` (buckets are then multiples of the device count, so
+every replica always gets equal full rows). Single-device serving (the
+default, and the CPU/simulator path tier-1 exercises) skips the mesh
+entirely.
+
+Inputs are float32 rows already normalized by the client, or raw uint8
+pixels normalized on device with the training path's exact op chain
+(`train.scan.device_normalize`) — chosen once at construction
+(`input_dtype`), because each choice is its own compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.mlp import MLP_DIMS, init_mlp, mlp_apply
+from ..parallel.mesh import DATA_AXIS
+from ..train.checkpoint import load_checkpoint
+from ..train.scan import device_normalize
+
+IN_DIM = MLP_DIMS[0]
+
+
+def bucket_ladder(max_batch: int, multiple_of: int = 1) -> "tuple[int, ...]":
+    """Ascending power-of-two batch buckets up to `max_batch`, each a
+    multiple of `multiple_of` (the mesh device count — every replica must
+    receive equal full rows). `max_batch` itself is always the top rung so
+    the ladder covers the batcher's largest flush even when the cap is not
+    a power of two."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1; got {max_batch}")
+    if max_batch % multiple_of != 0:
+        raise ValueError(
+            f"max_batch {max_batch} must be a multiple of the mesh device "
+            f"count {multiple_of} (each bucket shards equal rows per "
+            f"replica)")
+    ladder = []
+    b = 1
+    while b < max_batch:
+        if b % multiple_of == 0:
+            ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return tuple(ladder)
+
+
+class InferenceEngine:
+    """Warm bucketed forward pass over a params pytree.
+
+    `predict(x)` / `forward(x)` pad the batch to the smallest bucket that
+    holds it and run the bucket's AOT-compiled executable; results come back
+    trimmed to the real rows. Two requests for the same rows are bitwise
+    identical whether they arrive alone or coalesced into a larger flush of
+    the SAME bucket — and the batcher pads exactly like `_run_bucket`, so
+    the served path reproduces a direct `forward` call bit-for-bit.
+    """
+
+    def __init__(self, params, *, max_batch: int = 128, mesh=None,
+                 input_dtype: str = "float32", donate: Optional[bool] = None,
+                 buckets: Optional[Sequence[int]] = None):
+        if input_dtype not in ("float32", "uint8"):
+            raise ValueError(f"input_dtype must be 'float32' or 'uint8'; "
+                             f"got {input_dtype!r}")
+        self.max_batch = int(max_batch)
+        self.input_dtype = input_dtype
+        self._np_dtype = (np.uint8 if input_dtype == "uint8"
+                          else np.float32)
+        self.mesh = mesh
+        n_dev = 1 if mesh is None else int(mesh.devices.size)
+        self.buckets = (tuple(sorted(set(int(b) for b in buckets)))
+                        if buckets is not None
+                        else bucket_ladder(self.max_batch, n_dev))
+        for b in self.buckets:
+            if b < 1 or b % n_dev != 0:
+                raise ValueError(f"bucket {b} must be a positive multiple "
+                                 f"of the {n_dev}-device mesh")
+        if mesh is None:
+            self._x_sharding = None
+            self._params = jax.device_put(params)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._x_sharding = NamedSharding(mesh, P(DATA_AXIS))
+            self._params = jax.device_put(params, NamedSharding(mesh, P()))
+        # Donating the padded input buffer saves one HBM alloc per request
+        # batch on accelerators; CPU has no donation support and would warn
+        # per call, so default it off there.
+        if donate is None:
+            donate = jax.default_backend() not in ("cpu",)
+        self._donate = bool(donate)
+        # bucket -> AOT executable; populated ONLY here at warmup. Serving
+        # looks executables up and never falls back to jit, so a missing
+        # shape is a loud KeyError, not a silent multi-second compile.
+        self._compiled = {}
+        self.compile_count = 0
+        for b in self.buckets:
+            self._compiled[b] = self._compile(b)
+            self.compile_count += 1
+
+    @classmethod
+    def from_checkpoint(cls, path: str, **kw) -> "InferenceEngine":
+        """Load params via the training checkpoint layer (msgpack or the
+        reference's torch `.pt` — both formats serve identically)."""
+        template = init_mlp(jax.random.key(0))
+        return cls(load_checkpoint(path, template), **kw)
+
+    # -- compilation ------------------------------------------------------
+
+    def _fn(self, params, x):
+        if x.dtype == jnp.uint8:
+            x = device_normalize(x)
+        logits = mlp_apply(params, x.astype(jnp.float32), train=False)
+        return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _compile(self, bucket: int):
+        dt = jnp.uint8 if self.input_dtype == "uint8" else jnp.float32
+        x_spec = jax.ShapeDtypeStruct((bucket, IN_DIM), dt,
+                                      sharding=self._x_sharding)
+        jitted = (jax.jit(self._fn, donate_argnums=(1,)) if self._donate
+                  else jax.jit(self._fn))
+        return jitted.lower(self._params, x_spec).compile()
+
+    # -- serving ----------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest precompiled bucket holding `n` rows."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} rows exceeds the largest bucket "
+                         f"{self.buckets[-1]} (max_batch {self.max_batch})")
+
+    def _run_bucket(self, x: np.ndarray):
+        """Pad `x` to its bucket and run the compiled executable. Returns
+        (logits, preds) for the REAL rows only."""
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        if n != bucket:
+            pad = np.zeros((bucket - n, IN_DIM), x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        xd = (jax.device_put(x, self._x_sharding)
+              if self._x_sharding is not None else jnp.asarray(x))
+        logits, preds = self._compiled[bucket](self._params, xd)
+        return np.asarray(logits)[:n], np.asarray(preds)[:n], bucket
+
+    def _as_rows(self, x) -> np.ndarray:
+        x = np.asarray(x, self._np_dtype)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2 or x.shape[1] != IN_DIM:
+            raise ValueError(f"expected (n, {IN_DIM}) rows; got {x.shape}")
+        return np.ascontiguousarray(x)
+
+    def forward(self, x) -> np.ndarray:
+        """Logits (n, 10) float32 for `x` (n, 784); chunks batches larger
+        than max_batch so direct callers never hit the bucket cap."""
+        x = self._as_rows(x)
+        outs = [self._run_bucket(x[i:i + self.max_batch])[0]
+                for i in range(0, len(x), self.max_batch)]
+        return np.concatenate(outs, axis=0)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax classes (n,) int32 for `x` (n, 784)."""
+        x = self._as_rows(x)
+        outs = [self._run_bucket(x[i:i + self.max_batch])[1]
+                for i in range(0, len(x), self.max_batch)]
+        return np.concatenate(outs, axis=0)

@@ -1,0 +1,95 @@
+"""Open-loop Poisson load generator for the serve path.
+
+Closed-loop benchmarks (N workers, each waiting for its response before the
+next request — bench.py's other modes) cannot see queueing collapse: the
+client slows down exactly when the server does, hiding the latency the real
+open-loop world (millions of independent users) would experience. This
+generator schedules arrivals on an ABSOLUTE Poisson timeline — exponential
+inter-arrival gaps at `offered_rps`, drawn from a seeded numpy Generator —
+and fires each request at its scheduled instant whether or not earlier ones
+have returned. Latency percentiles therefore include queueing delay, and
+offered vs achieved throughput (+ reject rate) exposes saturation honestly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+import numpy as np
+
+from . import Rejected, ServeService
+
+IN_DIM = 784
+
+
+def request_rows(n: int, dtype: str = "float32",
+                 seed: int = 0) -> np.ndarray:
+    """Deterministic synthetic request payloads: (n, 784) pixel rows in the
+    engine's input dtype (uint8 raw pixels or pre-normalized float32)."""
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 256, size=(n, IN_DIM), dtype=np.uint8)
+    if dtype == "uint8":
+        return raw
+    from ..data.mnist import normalize_images
+    return normalize_images(raw.reshape(n, 28, 28)).astype(np.float32)
+
+
+async def run_open_loop(service: ServeService, *, offered_rps: float,
+                        n_requests: int, seed: int = 0,
+                        rows: Optional[np.ndarray] = None) -> dict:
+    """Drive `n_requests` through the service at Poisson-`offered_rps`;
+    returns {offered_rps, duration_s, predictions, snapshot...}.
+
+    Arrival times are precomputed (t_i = cumsum of Exp(1/rate) draws) and
+    each request fires as its own task at its absolute slot — a slow
+    response never delays later arrivals (open loop). Rejects count in the
+    metrics and leave a None prediction."""
+    if offered_rps <= 0:
+        raise ValueError(f"offered_rps must be > 0; got {offered_rps}")
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1; got {n_requests}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / offered_rps, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    if rows is None:
+        rows = request_rows(n_requests, service.engine.input_dtype,
+                            seed=seed + 1)
+    elif len(rows) < n_requests:
+        rows = rows[np.arange(n_requests) % len(rows)]
+
+    preds: "list[Optional[int]]" = [None] * n_requests
+
+    async def one(i: int) -> None:
+        try:
+            preds[i] = await service.handle(rows[i])
+        except Rejected:
+            pass  # counted by service.metrics
+
+    t0 = time.monotonic()
+    tasks = []
+    for i in range(n_requests):
+        delay = arrivals[i] - (time.monotonic() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(asyncio.ensure_future(one(i)))
+    await asyncio.gather(*tasks)
+    duration = time.monotonic() - t0
+    return {
+        "offered_rps": round(float(offered_rps), 2),
+        "n_requests": int(n_requests),
+        "duration_s": round(duration, 4),
+        "predictions": preds,
+        **service.metrics.snapshot(),
+    }
+
+
+def run_loadgen(service: ServeService, *, offered_rps: float,
+                n_requests: int, seed: int = 0) -> dict:
+    """Synchronous wrapper: open-loop run + graceful drain on one fresh
+    event loop (the bench / CLI-selftest entry)."""
+    from . import run_until_drained
+    return run_until_drained(
+        service, run_open_loop(service, offered_rps=offered_rps,
+                               n_requests=n_requests, seed=seed))

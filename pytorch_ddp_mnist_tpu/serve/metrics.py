@@ -1,0 +1,158 @@
+"""Serving metrics: latency percentiles, queue depth, batch occupancy,
+request counters — one JSON-able snapshot.
+
+Latencies land in a log-spaced histogram (2 us .. ~90 s, 12 buckets/decade)
+rather than an unbounded sample list: constant memory at any request rate,
+and percentile error bounded by the bucket ratio (~21% of the value —
+narrower than the run-to-run noise of any real latency tail). A percentile
+reports the winning bucket's UPPER edge, clamped to the recorded max —
+deliberately pessimistic, never flattering. Counters follow the reference
+framework's conventions (utils/logging: machine-parseable one-line records,
+process-0 gating left to the caller).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Optional
+
+# 12 buckets per decade: ratio 10^(1/12) ~ 1.21 between edges.
+_BUCKETS_PER_DECADE = 12
+_FLOOR_S = 2e-6
+
+
+class LatencyHistogram:
+    """Log-bucketed latency recorder with percentile estimation."""
+
+    def __init__(self):
+        self.counts: "dict[int, int]" = {}
+        self.n = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def _index(self, seconds: float) -> int:
+        if seconds <= _FLOOR_S:
+            return 0
+        return 1 + int(_BUCKETS_PER_DECADE
+                       * math.log10(seconds / _FLOOR_S))
+
+    def _edge(self, index: int) -> float:
+        # upper edge of bucket `index` (bucket 0 = [0, _FLOOR_S])
+        return _FLOOR_S * 10 ** (index / _BUCKETS_PER_DECADE)
+
+    def record(self, seconds: float) -> None:
+        i = self._index(seconds)
+        self.counts[i] = self.counts.get(i, 0) + 1
+        self.n += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]) in seconds; 0.0 when empty.
+
+        Clamped to the recorded max so a sparse tail bucket cannot report a
+        latency larger than any request actually experienced."""
+        if self.n == 0:
+            return 0.0
+        rank = q * self.n
+        seen = 0
+        for i in sorted(self.counts):
+            seen += self.counts[i]
+            if seen >= rank:
+                return min(self._edge(i), self.max_s)
+        return self.max_s
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.n if self.n else 0.0
+
+
+class ServeMetrics:
+    """Aggregated serving counters + latency histogram.
+
+    `depth_fn` (optional) reads the live queue depth at snapshot time, so
+    the gauge reflects the instant, not an average. The requests/sec
+    counter is completed requests over the first-arrival..last-completion
+    wall span — the achieved (not offered) rate.
+    """
+
+    def __init__(self, depth_fn: Optional[Callable[[], int]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.latency = LatencyHistogram()
+        self.depth_fn = depth_fn
+        self.clock = clock
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.batches = 0
+        self.batched_rows = 0
+        self.bucket_rows = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- recording hooks --------------------------------------------------
+
+    def record_arrival(self) -> None:
+        if self._t_first is None:
+            self._t_first = self.clock()
+
+    def record_done(self, latency_s: float) -> None:
+        self.latency.record(latency_s)
+        self.completed += 1
+        self._t_last = self.clock()
+
+    def record_reject(self) -> None:
+        self.rejected += 1
+        if self._t_first is None:
+            self._t_first = self.clock()
+        self._t_last = self.clock()
+
+    def record_failure(self) -> None:
+        """A request that was admitted but errored (bad payload, engine
+        exception) — neither completed nor rejected, but it DID arrive:
+        dropping it from the counters would make a fault storm read as a
+        healthy low-traffic interval."""
+        self.failed += 1
+        self._t_last = self.clock()
+
+    def record_batch(self, real_rows: int, bucket: int) -> None:
+        """One batcher flush: `real_rows` requests padded into `bucket`."""
+        self.batches += 1
+        self.batched_rows += real_rows
+        self.bucket_rows += bucket
+
+    # -- snapshot ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able state: the serving dashboard in one dict."""
+        arrived = self.completed + self.rejected + self.failed
+        span = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else 0.0)
+        lat = self.latency
+        return {
+            "requests": arrived,
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "failed": self.failed,
+            "reject_rate": round(self.rejected / arrived, 4) if arrived
+                           else 0.0,
+            "achieved_rps": round(self.completed / span, 2) if span > 0
+                            else None,
+            "latency_ms": {
+                "p50": round(lat.percentile(0.50) * 1e3, 3),
+                "p95": round(lat.percentile(0.95) * 1e3, 3),
+                "p99": round(lat.percentile(0.99) * 1e3, 3),
+                "mean": round(lat.mean_s * 1e3, 3),
+                "max": round(lat.max_s * 1e3, 3),
+            },
+            "batches": self.batches,
+            # real rows per flush / bucket rows actually computed: 1.0 means
+            # every padded slot carried a request (perfect coalescing)
+            "batch_occupancy": round(self.batched_rows / self.bucket_rows, 4)
+                               if self.bucket_rows else None,
+            "mean_batch_size": round(self.batched_rows / self.batches, 2)
+                               if self.batches else None,
+            "queue_depth": self.depth_fn() if self.depth_fn else None,
+        }

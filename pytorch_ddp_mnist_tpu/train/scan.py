@@ -35,7 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..compat import shard_map
 
 from ..data.mnist import MNIST_MEAN, MNIST_STD
 from ..models.mlp import mlp_apply

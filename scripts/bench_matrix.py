@@ -218,12 +218,26 @@ def main(argv=None) -> int:
                 "tflops": pf["tflops"],
                 "mfu_vs_197t_bf16": pf["mfu_pct_vs_bf16_peak"]}
 
-    base_rows = {}
+    base_rows, base_provenance = {}, {}
     if a.base:
         with open(a.base) as f:
-            base_rows = {r["label"]: r
-                         for r in json.load(f)["variants"]
-                         if r.get("value") is not None}
+            base_artifact = json.load(f)
+        base_rows = {r["label"]: r
+                     for r in base_artifact["variants"]
+                     if r.get("value") is not None}
+        # Reused rows carry the BASE run's identity inline (ADVICE r5 #3):
+        # the merged artifact's top-level timestamp/backend describe THIS
+        # run, while a reused row was measured under the base's — an
+        # hour-plus gap inside one hardware window. Stamping both onto the
+        # row keeps the promotion gate's "one window, one chip" premise
+        # auditable from the artifact alone, without chasing reused_from.
+        base_provenance = {
+            "reused_from": a.base,
+            "base_timestamp": base_artifact.get("timestamp"),
+            "base_backend": base_artifact.get("backend"),
+            "base_device_kind": base_artifact.get("device_kind"),
+            "base_jax_version": base_artifact.get("jax_version"),
+        }
 
     def skipped(label, extra):
         why = (f"--only {a.only!r}" if a.only is not None
@@ -231,7 +245,7 @@ def main(argv=None) -> int:
                f"--skip {a.skip!r}")
         if label in base_rows:
             print(f"  {label}: reused from {a.base}", file=sys.stderr)
-            return {**base_rows[label], "reused_from": a.base}
+            return {**base_rows[label], **base_provenance}
         print(f"  {label}: SKIPPED ({why})", file=sys.stderr)
         return {"label": label, "argv": extra, "value": None,
                 "unit": None, "vs_baseline": None, "tflops": None,

@@ -148,13 +148,21 @@ if ((status[sweep] == 0)); then
          " gate not run" >&2
   fi
 else
+  # Distinct nonzero rc: a skipped phase must never read as green in the
+  # per-phase summary (rc=0 here would let a committed sweep log claim the
+  # merged-matrix gate ran when it never did). 75 = EX_TEMPFAIL: rerunnable.
+  status[fullmatrix]=75
   echo "measure_hw: skipping superstep matrix (sweep rc=${status[sweep]}" \
        " did not clear the superstep rows)" >&2
 fi
 
 fail=0
 for phase in headline matrix promote eval accuracy mosaic sweep fullmatrix; do
-  echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
+  if ((status[$phase] == 75)); then
+    echo "measure_hw: phase $phase rc=75 (skipped: prerequisite failed)" >&2
+  else
+    echo "measure_hw: phase $phase rc=${status[$phase]}" >&2
+  fi
   ((status[$phase] != 0)) && fail=1
 done
 echo "measure_hw: done at $(date -u +%H:%M:%S) (fail=$fail)" >&2

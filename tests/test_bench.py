@@ -64,6 +64,24 @@ def test_eval_mode_contract():
         100 * flops / bench.V5E_PEAK_FLOPS_BF16, 2)
 
 
+def test_serve_mode_contract():
+    """--mode serve: open-loop latency-percentile bench of the serve/
+    request path. One JSON line with percentiles, achieved rate, occupancy,
+    reject rate, and the compile-count evidence that serving never
+    compiled past the bucket-ladder warmup."""
+    rec = _run(["--mode", "serve", "--requests", "200",
+                "--offered_rps", "2000", "--max_batch", "16"])
+    assert rec["metric"] == "mnist_serve_requests_per_sec"
+    assert rec["unit"] == "requests/sec"
+    assert rec["value"] > 0 and rec["vs_baseline"] > 0
+    assert rec["offered_rps"] == 2000.0
+    assert 0 < rec["p50_ms"] <= rec["p95_ms"] <= rec["p99_ms"]
+    assert 0 <= rec["reject_rate"] <= 1
+    assert 0 < rec["batch_occupancy"] <= 1
+    # bucket ladder 1..16 -> exactly 5 warmup compiles, none at serve time
+    assert rec["compile_count"] == 5
+
+
 def test_mode_knob_compat_rejected_by_name():
     """Variant knobs the selected mode never reads are rejected, not
     silently accepted as a mislabeled measurement."""
@@ -76,6 +94,20 @@ def test_mode_knob_compat_rejected_by_name():
          "--num_workers", "2"],
         env=ENV, capture_output=True, text=True, timeout=120)
     assert out.returncode != 0 and "--num_workers" in out.stderr
+    # serve knobs are rejected outside serve mode, and vice versa
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "train", "--epochs", "1",
+         "--offered_rps", "100"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--offered_rps" in out.stderr
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "serve", "--kernel", "xla"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--kernel" in out.stderr
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "serve", "--epochs", "2"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--epochs" in out.stderr
 
 
 def test_eval_program_uint8_matches_f32():

@@ -63,7 +63,7 @@ def test_dp_grad_mean_matches_serial_no_dropout(mesh):
 
     # DP step via shard_map psum-mean (eval-mode forward to drop RNG noise).
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from pytorch_ddp_mnist_tpu.compat import shard_map
     from pytorch_ddp_mnist_tpu.parallel.ddp import _pvary
 
     def shard_fn(p, x, y):
@@ -88,7 +88,7 @@ def test_dropout_masks_differ_across_replicas(mesh):
     SAME example to all 8 replicas; train-mode outputs must differ between
     replicas (shared mask would make them identical)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from pytorch_ddp_mnist_tpu.compat import shard_map
 
     params = init_mlp(jax.random.key(0))
     x_one = np.random.default_rng(5).normal(size=(1, 784)).astype(np.float32)

@@ -144,12 +144,14 @@ def test_per_step_kernel_ragged_batch_lowers():
 # client-side Mosaic legality pipeline with no devices at all.
 # ---------------------------------------------------------------------------
 
-from jax.sharding import AbstractMesh, PartitionSpec as Pspec  # noqa: E402
-from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as Pspec  # noqa: E402
+
+from pytorch_ddp_mnist_tpu.compat import abstract_mesh  # noqa: E402
+from pytorch_ddp_mnist_tpu.compat import shard_map  # noqa: E402
 
 
 def _export_dp(n, *, ring="auto", bf16=False, rng_impl="core"):
-    mesh = AbstractMesh((n,), ("dp",))
+    mesh = abstract_mesh((n,), ("dp",))
     params = init_mlp(jax.random.PRNGKey(0))
     xp = jnp.zeros((n * S * B, 784), jnp.uint8)
     yp = jnp.zeros((n * S * B,), jnp.int32)

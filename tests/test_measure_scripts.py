@@ -221,6 +221,14 @@ def test_bench_matrix_base_reuses_prior_window_rows(tmp_path):
             for row in json.loads(out_json.read_text())["variants"]}
     assert rows[_F32]["value"] == 36.9e6
     assert rows[_F32]["reused_from"] == str(base)
+    # reused rows carry the BASE run's timestamp + backend identity inline
+    # (ADVICE r5 #3) so merged-matrix provenance audits from the artifact
+    # alone — the top-level fields describe the phase-5 run, not this row
+    assert rows[_F32]["base_timestamp"] == "2026-08-01T00:00:00+00:00"
+    assert rows[_F32]["base_backend"] == "tpu"
+    assert rows[_F32]["base_device_kind"] == "TPU v5e"
+    assert rows[_F32]["base_jax_version"] == "0.9.0"
+    assert "base_timestamp" not in rows[_SUP8]  # plain skips: no base stamp
     # base had _BF16 unmeasured (value null) -> NOT reusable, stays a skip
     assert rows[_BF16]["value"] is None
     assert "skipped by --only" in rows[_BF16]["error"][0]

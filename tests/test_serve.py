@@ -1,0 +1,394 @@
+"""serve/ request path on the virtual CPU mesh: bucketing/padding
+correctness, batcher coalescing under a fake clock, admission backpressure
+and graceful drain, metrics snapshot schema, and the two acceptance
+invariants — served results bitwise-equal to a direct engine forward, and
+zero compiles after warmup (structural: serving only ever calls the AOT
+executables compiled at construction)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+import jax
+
+from pytorch_ddp_mnist_tpu.models import init_mlp
+from pytorch_ddp_mnist_tpu.serve import (AdmissionController, InferenceEngine,
+                                         MicroBatcher, Rejected, ServeMetrics,
+                                         ServeService, bucket_ladder)
+from pytorch_ddp_mnist_tpu.serve.loadgen import request_rows, run_loadgen
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_mlp(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    return InferenceEngine(params, max_batch=16)
+
+
+# ---------------------------------------------------------------------------
+# engine: bucket ladder, padding, compile accounting
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder():
+    assert bucket_ladder(16) == (1, 2, 4, 8, 16)
+    assert bucket_ladder(1) == (1,)
+    # a non-power-of-two cap is always its own top rung
+    assert bucket_ladder(12) == (1, 2, 4, 8, 12)
+    # mesh-constrained ladders only hold multiples of the device count
+    assert bucket_ladder(32, 8) == (8, 16, 32)
+    with pytest.raises(ValueError, match="multiple"):
+        bucket_ladder(12, 8)
+    with pytest.raises(ValueError, match="max_batch"):
+        bucket_ladder(0)
+
+
+def test_bucket_for_smallest_fit(engine):
+    assert engine.bucket_for(1) == 1
+    assert engine.bucket_for(3) == 4
+    assert engine.bucket_for(8) == 8
+    assert engine.bucket_for(9) == 16
+    with pytest.raises(ValueError, match="largest bucket"):
+        engine.bucket_for(17)
+
+
+def test_warmup_compiles_once_per_bucket_then_never(engine):
+    assert engine.compile_count == len(engine.buckets) == 5
+    x = request_rows(23, seed=3)          # chunks: 16 + 7 -> buckets 16, 8
+    for _ in range(3):
+        engine.predict(x)
+        engine.forward(x[:5])
+    # serving touched several shapes and sizes beyond the ladder; the
+    # engine still holds exactly the warmup executables — a shape that
+    # missed the ladder would have raised, not compiled
+    assert engine.compile_count == len(engine.buckets)
+
+
+def test_padding_is_inert(engine):
+    """Rows answered identically whether padded a little (bucket 4) or
+    arriving at exactly their own bucket — for the same bucket the padded
+    program IS the unpadded program, bitwise."""
+    x = request_rows(4, seed=1)
+    whole = engine.forward(x)
+    # 3 rows pad into bucket 4: the same executable, same leading rows
+    np.testing.assert_array_equal(engine.forward(x[:3]), whole[:3])
+
+
+def test_forward_chunks_large_batches(engine):
+    x = request_rows(40, seed=2)          # > max_batch=16: 3 chunks
+    out = engine.forward(x)
+    assert out.shape == (40, 10)
+    np.testing.assert_array_equal(out[:16], engine.forward(x[:16]))
+
+
+def test_input_validation(engine):
+    with pytest.raises(ValueError, match="784"):
+        engine.forward(np.zeros((2, 100), np.float32))
+    with pytest.raises(ValueError, match="input_dtype"):
+        InferenceEngine(init_mlp(jax.random.key(0)), max_batch=1,
+                        input_dtype="int64")
+
+
+def test_uint8_engine_normalizes_on_device(params):
+    """A uint8 engine's logits match the f32 engine fed host-normalized
+    pixels (same device_normalize chain as training/eval)."""
+    from pytorch_ddp_mnist_tpu.data import normalize_images
+    eng8 = InferenceEngine(params, max_batch=4, input_dtype="uint8")
+    engf = InferenceEngine(params, max_batch=4)
+    raw = request_rows(4, dtype="uint8", seed=5)
+    normed = normalize_images(raw.reshape(4, 28, 28)).astype(np.float32)
+    np.testing.assert_allclose(eng8.forward(raw), engf.forward(normed),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_mesh_replicated_engine_matches_serial(params):
+    """8-virtual-device data-parallel engine: sharded buckets, identical
+    logits to the single-device engine."""
+    from pytorch_ddp_mnist_tpu.parallel import data_parallel_mesh
+    mesh = data_parallel_mesh()
+    assert mesh.devices.size == 8     # conftest's virtual CPU mesh
+    dp = InferenceEngine(params, max_batch=32, mesh=mesh)
+    assert dp.buckets == (8, 16, 32)
+    serial = InferenceEngine(params, max_batch=32)
+    x = request_rows(20, seed=7)
+    np.testing.assert_allclose(dp.forward(x), serial.forward(x),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_checkpoint_round_trip(tmp_path, params):
+    from pytorch_ddp_mnist_tpu.train.checkpoint import save_checkpoint
+    path = str(tmp_path / "m.msgpack")
+    save_checkpoint(path, params)
+    eng = InferenceEngine.from_checkpoint(path, max_batch=4)
+    ref = InferenceEngine(params, max_batch=4)
+    x = request_rows(4, seed=9)
+    np.testing.assert_array_equal(eng.forward(x), ref.forward(x))
+
+
+# ---------------------------------------------------------------------------
+# batcher: coalescing under a fake clock
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _RecordingEngine:
+    """Engine wrapper that records every flush's real-row count."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.max_batch = engine.max_batch
+        self.calls = []
+
+    def _as_rows(self, x):
+        return self._engine._as_rows(x)
+
+    def _run_bucket(self, x):
+        self.calls.append(x.shape[0])
+        return self._engine._run_bucket(x)
+
+
+def test_batcher_coalesces_to_one_engine_call(engine):
+    clock = _FakeClock()
+    rec = _RecordingEngine(engine)
+
+    async def scenario():
+        b = MicroBatcher(rec, max_batch=8, max_delay_ms=1000.0, clock=clock)
+        subs = [asyncio.ensure_future(b.submit(row))
+                for row in request_rows(3, seed=11)]
+        await asyncio.sleep(0)            # let submits enqueue
+        assert b.depth == 3 and rec.calls == []   # deadline far: no flush
+        assert b.flush() == 3
+        return await asyncio.gather(*subs)
+
+    preds = asyncio.run(scenario())
+    assert rec.calls == [3]               # ONE engine call for 3 requests
+    assert all(isinstance(p, int) for p in preds)
+
+
+def test_batcher_deadline_decision_is_pure(engine):
+    clock = _FakeClock()
+
+    async def scenario():
+        b = MicroBatcher(engine, max_batch=8, max_delay_ms=5.0, clock=clock)
+        assert not b.flush_due(clock())           # empty: never due
+        fut = asyncio.ensure_future(b.submit(request_rows(1, seed=12)[0]))
+        await asyncio.sleep(0)
+        assert not b.flush_due(clock())           # fresh: not due yet
+        clock.t += 0.0049
+        assert not b.flush_due(clock())
+        clock.t += 0.0002                         # past the 5 ms deadline
+        assert b.flush_due(clock())
+        b.flush()
+        return await fut
+
+    assert isinstance(asyncio.run(scenario()), int)
+
+
+def test_batcher_full_batch_flushes_immediately(engine):
+    rec = _RecordingEngine(engine)
+
+    async def scenario():
+        b = MicroBatcher(rec, max_batch=4, max_delay_ms=1000.0)
+        subs = [asyncio.ensure_future(b.submit(row))
+                for row in request_rows(4, seed=13)]
+        await asyncio.sleep(0)            # 4th submit hits max_batch
+        assert rec.calls == [4] and b.depth == 0
+        return await asyncio.gather(*subs)
+
+    asyncio.run(scenario())
+
+
+def test_served_batch_bitwise_equals_direct_forward(engine):
+    """Acceptance: predictions through the coalescing path == a direct
+    engine pass on the same stacked inputs, bitwise."""
+    rows = request_rows(6, seed=14)
+
+    async def scenario():
+        b = MicroBatcher(engine, max_batch=8, max_delay_ms=1000.0)
+        subs = [asyncio.ensure_future(b.submit(r)) for r in rows]
+        await asyncio.sleep(0)
+        b.flush()                         # one coalesced bucket-8 call
+        return await asyncio.gather(*subs)
+
+    served = np.asarray(asyncio.run(scenario()), np.int32)
+    direct = engine.predict(rows)         # same rows -> same bucket 8
+    np.testing.assert_array_equal(served, direct)
+
+
+# ---------------------------------------------------------------------------
+# admission: backpressure + drain
+# ---------------------------------------------------------------------------
+
+def test_admission_rejects_past_budget_with_retry_after():
+    adm = AdmissionController(2, retry_after_s=0.25)
+    adm.admit()
+    adm.admit()
+    with pytest.raises(Rejected) as e:
+        adm.admit()
+    assert e.value.retry_after_s == 0.25
+    assert adm.rejected == 1 and adm.depth == 2
+    adm.release()
+    adm.admit()                           # slot freed: admitted again
+    assert adm.admitted == 3
+
+
+def test_admission_graceful_drain():
+    async def scenario():
+        adm = AdmissionController(8)
+        adm.admit()
+        adm.admit()
+        waiter = asyncio.ensure_future(adm.drained())
+        await asyncio.sleep(0)
+        assert not waiter.done()          # two in flight: still draining
+        with pytest.raises(Rejected, match="draining"):
+            adm.admit()                   # door closed during drain
+        adm.release()
+        adm.release()
+        await asyncio.wait_for(waiter, 1.0)
+
+    asyncio.run(scenario())
+
+
+def test_service_backpressure_and_drain(engine):
+    """Full path under overload: a tiny queue budget forces rejects while
+    admitted requests all complete through the drain."""
+    svc = ServeService(engine, max_delay_ms=50.0, max_depth=2)
+    rows = request_rows(6, seed=15)
+
+    async def scenario():
+        results = await asyncio.gather(
+            *[svc.handle(r) for r in rows], return_exceptions=True)
+        await svc.shutdown()
+        return results
+
+    results = asyncio.run(scenario())
+    served = [r for r in results if isinstance(r, int)]
+    rejected = [r for r in results if isinstance(r, Rejected)]
+    assert len(served) == 2 and len(rejected) == 4
+    snap = svc.metrics.snapshot()
+    assert snap["completed"] == 2 and snap["rejected"] == 4
+    assert snap["reject_rate"] == pytest.approx(4 / 6, abs=1e-4)
+    with pytest.raises(Rejected, match="draining"):
+        asyncio.run(svc.handle(rows[0]))  # drained service stays closed
+
+
+def test_malformed_row_rejected_at_submit_not_poisoning_batch(engine):
+    """A ragged row raises synchronously to ITS caller; pending well-formed
+    requests in the same flush window still serve, and no admission slot
+    leaks (the review-found hang: np.stack of ragged rows after the pending
+    swap stranded every waiter)."""
+    svc = ServeService(engine, max_delay_ms=1000.0, max_depth=8)
+    good = request_rows(2, seed=21)
+
+    async def scenario():
+        tasks = [asyncio.ensure_future(svc.handle(r)) for r in good]
+        bad = asyncio.ensure_future(svc.handle(np.zeros(783, np.float32)))
+        await asyncio.sleep(0)
+        svc.batcher.flush()
+        results = await asyncio.gather(*tasks, bad, return_exceptions=True)
+        await svc.shutdown()            # must not deadlock on leaked slots
+        return results
+
+    r0, r1, rbad = asyncio.run(scenario())
+    assert isinstance(r0, int) and isinstance(r1, int)
+    assert isinstance(rbad, ValueError) and "783" in str(rbad)
+    snap = svc.metrics.snapshot()
+    assert snap["completed"] == 2 and snap["failed"] == 1
+    assert snap["requests"] == 3        # the errored request still counted
+    assert snap["queue_depth"] == 0     # its admission slot was released
+
+
+# ---------------------------------------------------------------------------
+# metrics: snapshot schema
+# ---------------------------------------------------------------------------
+
+def test_metrics_snapshot_schema():
+    m = ServeMetrics(depth_fn=lambda: 3)
+    for ms in (1.0, 2.0, 5.0, 100.0):
+        m.record_arrival()
+        m.record_done(ms / 1e3)
+    m.record_reject()
+    m.record_batch(3, 4)
+    snap = m.snapshot()
+    assert set(snap) == {"requests", "completed", "rejected", "failed",
+                         "reject_rate", "achieved_rps", "latency_ms",
+                         "batches", "batch_occupancy", "mean_batch_size",
+                         "queue_depth"}
+    assert snap["requests"] == 5 and snap["completed"] == 4
+    assert snap["reject_rate"] == 0.2
+    assert snap["queue_depth"] == 3
+    assert snap["batch_occupancy"] == 0.75
+    lat = snap["latency_ms"]
+    assert set(lat) == {"p50", "p95", "p99", "mean", "max"}
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    # log-bucket estimate stays within the bucket ratio of the true value
+    assert lat["p50"] == pytest.approx(2.0, rel=0.25)
+    assert lat["max"] == pytest.approx(100.0, rel=1e-6)
+    import json
+    json.dumps(snap)                      # snapshot is JSON-able verbatim
+
+
+def test_histogram_percentiles_clamped_to_max():
+    from pytorch_ddp_mnist_tpu.serve.metrics import LatencyHistogram
+    h = LatencyHistogram()
+    assert h.percentile(0.99) == 0.0      # empty
+    h.record(0.010)
+    # single sample: every percentile is that sample, not a bucket edge
+    assert h.percentile(0.5) == h.percentile(0.99) == 0.010
+
+
+# ---------------------------------------------------------------------------
+# loadgen + end-to-end
+# ---------------------------------------------------------------------------
+
+def test_loadgen_deterministic_and_complete(engine):
+    svc = ServeService(engine, max_delay_ms=2.0, max_depth=64)
+    out = run_loadgen(svc, offered_rps=2000.0, n_requests=50, seed=42)
+    assert out["n_requests"] == 50
+    assert out["completed"] + out["rejected"] == 50
+    assert all(p is None or 0 <= p <= 9 for p in out["predictions"])
+    # engine never compiled past warmup under open-loop load
+    assert engine.compile_count == len(engine.buckets)
+
+
+@pytest.mark.slow
+def test_loadgen_soak_overload_saturates_not_collapses():
+    """Soak: offered load far past capacity must saturate into rejects
+    with bounded admitted-latency, not queue without bound."""
+    eng = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=32)
+    svc = ServeService(eng, max_delay_ms=1.0, max_depth=64,
+                       retry_after_s=0.01)
+    out = run_loadgen(svc, offered_rps=20000.0, n_requests=4000, seed=1)
+    assert out["completed"] + out["rejected"] == 4000
+    assert out["queue_depth"] == 0                 # drained clean
+    assert eng.compile_count == len(eng.buckets)   # soak never compiled
+
+
+def test_cli_serve_selftest_subprocess(tmp_path):
+    """The `python -m pytorch_ddp_mnist_tpu serve --selftest` front door:
+    full path in a fresh interpreter, one JSON metrics line on stdout."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu", "serve",
+         "--selftest", "80", "--offered_rps", "2000", "--max_batch", "16"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    snap = json.loads(lines[0])
+    assert snap["completed"] + snap["rejected"] == 80
+    assert {"p50", "p95", "p99"} <= set(snap["latency_ms"])
+    assert "compiles=5" in out.stderr     # bucket ladder 1..16 warmed once
