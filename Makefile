@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke obs-smoke native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -22,6 +22,15 @@ bench:
 # engine) end-to-end on the host backend: one JSON line or a nonzero exit.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --mode serve --requests 300 --offered_rps 1500
+
+# Observability smoke: 1 CPU epoch with --telemetry, then schema-validate
+# the emitted JSONL trace (nonzero exit on malformed/unordered records).
+obs-smoke:
+	rm -rf /tmp/pdmt_obs_smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu train --epochs 1 \
+		--limit 512 --batch_size 64 --checkpoint "" \
+		--telemetry /tmp/pdmt_obs_smoke
+	$(PY) scripts/check_telemetry.py /tmp/pdmt_obs_smoke
 
 native:
 	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native

@@ -86,6 +86,25 @@ CALIBRATION_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "bench_calibration.json")
 
 
+def registry_stamp(registry=None) -> dict:
+    """Compile-count and memory fields for a bench JSON line, read from the
+    telemetry registry (main() arms the jax.monitoring compile listener
+    before any jit, so `xla_compiles` covers the whole process). A reader
+    of a BENCH_r0X.json sees recompilation storms and memory pressure
+    without re-running the bench."""
+    from pytorch_ddp_mnist_tpu import telemetry
+    reg = registry or telemetry.get_registry()
+    telemetry.collect_memory(reg)
+    snap = reg.snapshot()
+    out = {"xla_compiles": snap["counters"].get("xla.compiles")}
+    rss = snap["gauges"].get("host.rss_bytes")
+    out["host_rss_mb"] = round(rss / 2**20, 1) if rss else None
+    dev = snap["gauges"].get("device.peak_bytes_in_use")
+    if dev is not None:  # absent off-accelerator (CPU has no memory_stats)
+        out["device_peak_bytes"] = dev
+    return out
+
+
 def _load_calibration(calibration_path: str = None) -> dict:
     """The committed calibration as a dict; {} for absent/invalid/non-object
     files (the documented fall-back-to-defaults contract)."""
@@ -259,6 +278,7 @@ def _eval_bench(a) -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / NOMINAL_BASELINE_EVAL_IMGS_PER_SEC, 4),
         **perf_fields(per_chip, fwd_only=True),
+        **registry_stamp(),
     }))
 
 
@@ -271,22 +291,29 @@ def _serve_bench(a) -> None:
     reject rate. Offered vs achieved (+ rejects) is the saturation story a
     closed-loop sweep cannot tell. Runs identically on CPU/simulator: the
     engine precompiles its bucket ladder on whatever backend is up."""
+    from pytorch_ddp_mnist_tpu import telemetry
     from pytorch_ddp_mnist_tpu.models import init_mlp
     from pytorch_ddp_mnist_tpu.serve import InferenceEngine, ServeService
     from pytorch_ddp_mnist_tpu.serve.loadgen import run_loadgen
 
+    # A fresh registry per bench (not the process-wide one): the artifact
+    # must report THIS run's serve counters, not whatever else the process
+    # accumulated.
+    reg = telemetry.MetricsRegistry()
     engine = InferenceEngine(init_mlp(jax.random.key(0)),
                              max_batch=a.max_batch)
     # Bucket executables compiled at construction; one dispatch per bucket
     # seats runtime first-call overhead outside the measured percentiles.
     for b in engine.buckets:
         engine.predict(np.zeros((b, 784), np.float32))
+    telemetry.record_engine_compiles(reg, engine.compile_count)
     service = ServeService(engine, max_delay_ms=a.max_delay_ms,
-                           max_depth=a.queue_depth)
+                           max_depth=a.queue_depth, registry=reg)
     out = run_loadgen(service, offered_rps=a.offered_rps,
                       n_requests=a.requests, seed=0)
     lat = out["latency_ms"]
     rps = out["achieved_rps"]
+    counters = reg.snapshot()["counters"]
     print(json.dumps({
         "metric": "mnist_serve_requests_per_sec",
         "value": rps,
@@ -296,10 +323,15 @@ def _serve_bench(a) -> None:
         "offered_rps": out["offered_rps"],
         "p50_ms": lat["p50"], "p95_ms": lat["p95"], "p99_ms": lat["p99"],
         "reject_rate": out["reject_rate"],
+        # the absolute queue-rejection count (reject_rate alone cannot
+        # distinguish 1/10 from 100/1000): overload behavior is auditable
+        # from the artifact alone
+        "rejected": counters["serve.rejected"],
         "batch_occupancy": out["batch_occupancy"],
         # structural no-cold-compile evidence: the bucket ladder's warmup
         # compiles are the ONLY compiles the engine can ever perform
-        "compile_count": engine.compile_count,
+        "compile_count": counters["serve.engine_compiles"],
+        **registry_stamp(),  # global registry: xla.compiles + memory
     }))
 
 
@@ -553,6 +585,12 @@ def main(argv=None) -> None:
         wait_for_backend)
     _honor_platform_env()
 
+    # Compile accounting armed before ANY jit (pure jax.monitoring plumbing,
+    # no backend touch): every device mode's artifact line carries the
+    # process's true compile count via registry_stamp().
+    from pytorch_ddp_mnist_tpu import telemetry
+    telemetry.install_compile_listener()
+
     # Bounded backend retry: the tunneled TPU drops and recovers (BENCH_r02
     # died on a single un-retried probe); poll before the first real backend
     # query so a transient outage inside the window doesn't kill the bench.
@@ -725,6 +763,7 @@ def main(argv=None) -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(imgs_per_sec / NOMINAL_BASELINE_IMGS_PER_SEC, 4),
         **perf_fields(per_chip),
+        **registry_stamp(),
     }))
 
 

@@ -7,7 +7,10 @@ Two front doors over the same `serve.ServeService` request path:
 
 * default: a newline-delimited-JSON TCP server. One request per line,
   `{"pixels": [784 numbers]}` -> `{"ok": true, "pred": k}`;
-  `{"op": "metrics"}` -> the metrics snapshot; backpressure rejections
+  `{"op": "metrics"}` -> the serving dashboard snapshot; `{"op": "stats"}`
+  -> the unified telemetry registry snapshot (serve counters + latency
+  histogram, XLA compile counter, memory gauges — docs/OBSERVABILITY.md)
+  alongside the dashboard; backpressure rejections
   answer `{"ok": false, "error": ..., "retry_after_ms": ...}` without
   closing the connection. `--port 0` binds an ephemeral port and prints
   `serving on HOST:PORT` (stderr) so a harness can connect. SIGINT/SIGTERM
@@ -54,6 +57,30 @@ def build_engine(a):
                            input_dtype=a.input_dtype)
 
 
+async def handle_request(service, req: dict) -> dict:
+    """One JSON request -> one JSON response dict (the protocol core,
+    transport-free so tests drive it without a socket):
+
+      {"pixels": [...784...]}  -> {"ok": true, "pred": k}
+      {"op": "metrics"}        -> the serving dashboard snapshot (legacy)
+      {"op": "stats"}          -> {"registry": <telemetry registry
+                                   snapshot — serve.* counters/histograms,
+                                   compile counter, memory gauges>,
+                                   "serve": <dashboard snapshot>}
+    """
+    op = req.get("op")
+    if op == "metrics":
+        return {"ok": True, **service.metrics.snapshot()}
+    if op == "stats":
+        from ..telemetry import collect_memory
+        reg = service.metrics.registry
+        collect_memory(reg)  # stats reads the instant, not construction time
+        return {"ok": True, "registry": reg.snapshot(),
+                "serve": service.metrics.snapshot()}
+    pixels = np.asarray(req["pixels"])
+    return {"ok": True, "pred": await service.handle(pixels)}
+
+
 async def _handle_conn(service, reader, writer):
     from ..serve import Rejected
     while True:
@@ -61,13 +88,7 @@ async def _handle_conn(service, reader, writer):
         if not line:
             break
         try:
-            req = json.loads(line)
-            if req.get("op") == "metrics":
-                resp = {"ok": True, **service.metrics.snapshot()}
-            else:
-                pixels = np.asarray(req["pixels"])
-                resp = {"ok": True,
-                        "pred": await service.handle(pixels)}
+            resp = await handle_request(service, json.loads(line))
         except Rejected as e:
             resp = {"ok": False, "error": e.reason,
                     "retry_after_ms": round(e.retry_after_s * 1e3, 1)}
@@ -145,9 +166,18 @@ def main(argv=None) -> int:
         p.error("--max_delay_ms must be >= 0")
 
     from ..serve import ServeService
+    from .. import telemetry
+    # Serve metrics publish into the process-wide registry so the
+    # {"op": "stats"} endpoint answers one unified snapshot; the compile
+    # listener is armed BEFORE the engine warms its bucket ladder so the
+    # warmup compiles are on the record (and anything after warmup would
+    # be visible evidence of a cold compile).
+    telemetry.install_compile_listener()
+    reg = telemetry.get_registry()
     engine = build_engine(a)
+    telemetry.record_engine_compiles(reg, engine.compile_count)
     service = ServeService(engine, max_delay_ms=a.max_delay_ms,
-                           max_depth=a.queue_depth)
+                           max_depth=a.queue_depth, registry=reg)
     print(f"engine warm: buckets={list(engine.buckets)} "
           f"compiles={engine.compile_count} "
           f"input_dtype={engine.input_dtype}", file=sys.stderr, flush=True)

@@ -187,6 +187,22 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
+    # --telemetry DIR: arm the compile listener BEFORE the first jit (it is
+    # pure jax.monitoring plumbing — no backend touch), and open the JSONL
+    # trace now for serial runs. PARALLEL runs defer the trace open until
+    # after wireup: stamping records with the process index queries the
+    # backend, which must not initialize before jax.distributed's
+    # rendezvous (same constraint as the probe ordering below).
+    from .. import telemetry
+    if tcfg["telemetry"]:
+        telemetry.install_compile_listener()
+        if not tcfg["parallel"]:
+            # process_index=0 explicitly: a serial run IS process 0, and
+            # resolving it via jax.process_index() here would be the first
+            # backend query — ahead of the PDMT_BACKEND_WAIT outage guard
+            # below, which must own that first touch.
+            telemetry.enable(tcfg["telemetry"], process_index=0)
+
     # Opt-in bounded backend retry (PDMT_BACKEND_WAIT=<seconds>): a serial
     # training job launched into a transient accelerator outage polls
     # instead of dying at its first device query — same machinery as
@@ -347,6 +363,8 @@ def main(argv=None) -> int:
                                     global_batch_from_local, replicate_state)
         runtime = initialize_runtime(tcfg["wireup_method"])
         process_index, num_processes = jax.process_index(), jax.process_count()
+        if tcfg["telemetry"]:  # post-rendezvous: the real rank is known now
+            telemetry.enable(tcfg["telemetry"], process_index=process_index)
         use_pallas = _resolve_kernel()
         mesh = dp_mesh()  # global: all devices of all processes
         if not tcfg["cached"]:  # the cached path builds its own step fns
@@ -596,6 +614,23 @@ def main(argv=None) -> int:
                        eval_perm=eval_perm)
     state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
                                      argv, process_index=process_index)
+
+    if tcfg["telemetry"]:
+        # End of run: stamp the memory gauges, write the final registry
+        # snapshot as the trace's last record, close the file, and print
+        # the rank-0 one-line summary the flag promises.
+        reg = telemetry.get_registry()
+        telemetry.collect_memory(reg)
+        snap = reg.snapshot()
+        telemetry.get_tracer().snapshot(reg)
+        telemetry.disable()
+        rss = snap["gauges"].get("host.rss_bytes")
+        dev = snap["gauges"].get("device.peak_bytes_in_use")
+        log(f"[telemetry] epochs={tcfg['n_epochs']} "
+            f"xla_compiles={snap['counters'].get('xla.compiles', 0)} "
+            f"host_rss_mb={rss // 2**20 if rss else None} "
+            f"device_peak_mb={dev // 2**20 if dev is not None else None} "
+            f"trace={tcfg['telemetry']}")
 
     if process_index == 0 and tcfg["checkpoint"]:
         save_checkpoint(tcfg["checkpoint"], state.params)

@@ -36,14 +36,17 @@ class ServeService:
 
     def __init__(self, engine: InferenceEngine, *, max_batch=None,
                  max_delay_ms: float = 2.0, max_depth: int = 256,
-                 retry_after_s: float = 0.05, clock=None):
+                 retry_after_s: float = 0.05, clock=None, registry=None):
         import time
         clock = clock or time.monotonic
         self.engine = engine
         self.admission = AdmissionController(max_depth,
                                              retry_after_s=retry_after_s)
+        # registry=None keeps the service hermetic (its own private
+        # registry); the CLI/bench front doors pass telemetry.get_registry()
+        # so serve.* metrics publish into the process-wide snapshot.
         self.metrics = ServeMetrics(depth_fn=lambda: self.admission.depth,
-                                    clock=clock)
+                                    clock=clock, registry=registry)
         self.batcher = MicroBatcher(engine, max_batch=max_batch,
                                     max_delay_ms=max_delay_ms,
                                     metrics=self.metrics, clock=clock)

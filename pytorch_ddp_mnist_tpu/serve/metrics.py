@@ -1,71 +1,44 @@
 """Serving metrics: latency percentiles, queue depth, batch occupancy,
-request counters — one JSON-able snapshot.
+request counters — one JSON-able snapshot, backed by the shared telemetry
+registry.
 
-Latencies land in a log-spaced histogram (2 us .. ~90 s, 12 buckets/decade)
-rather than an unbounded sample list: constant memory at any request rate,
-and percentile error bounded by the bucket ratio (~21% of the value —
-narrower than the run-to-run noise of any real latency tail). A percentile
-reports the winning bucket's UPPER edge, clamped to the recorded max —
-deliberately pessimistic, never flattering. Counters follow the reference
-framework's conventions (utils/logging: machine-parseable one-line records,
-process-0 gating left to the caller).
+Since the telemetry/ PR this module owns no metric TYPES: latencies land in
+a `telemetry.registry.Histogram` (the log-spaced 2us-floor, 12-bucket/decade
+design first built here — constant memory at any request rate, percentile
+error bounded by the ~21% bucket ratio, always pessimistic), and the
+counters/gauge are registry `Counter`/`Gauge` objects under `serve.*` names.
+A `ServeMetrics` constructed with the process-wide registry (what
+`cli/serve.py` and `bench.py --mode serve` do) is therefore visible in the
+unified `{"op": "stats"}` / artifact snapshot alongside compile counts and
+memory gauges; the default is a PRIVATE registry so tests and embedded
+services stay hermetic. `snapshot()` keeps its original shape — the serving
+dashboard in one dict — unchanged.
 """
 
 from __future__ import annotations
 
-import math
 import time
 from typing import Callable, Optional
 
-# 12 buckets per decade: ratio 10^(1/12) ~ 1.21 between edges.
-_BUCKETS_PER_DECADE = 12
-_FLOOR_S = 2e-6
+from ..telemetry.registry import Histogram, MetricsRegistry
 
 
-class LatencyHistogram:
-    """Log-bucketed latency recorder with percentile estimation."""
+class LatencyHistogram(Histogram):
+    """DEPRECATED thin alias of `telemetry.registry.Histogram` — import
+    that instead. Kept so existing callers (and their tests) run unchanged;
+    the seconds-unit property spellings survive here."""
 
-    def __init__(self):
-        self.counts: "dict[int, int]" = {}
-        self.n = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
+    @property
+    def total_s(self) -> float:
+        return self.total
 
-    def _index(self, seconds: float) -> int:
-        if seconds <= _FLOOR_S:
-            return 0
-        return 1 + int(_BUCKETS_PER_DECADE
-                       * math.log10(seconds / _FLOOR_S))
-
-    def _edge(self, index: int) -> float:
-        # upper edge of bucket `index` (bucket 0 = [0, _FLOOR_S])
-        return _FLOOR_S * 10 ** (index / _BUCKETS_PER_DECADE)
-
-    def record(self, seconds: float) -> None:
-        i = self._index(seconds)
-        self.counts[i] = self.counts.get(i, 0) + 1
-        self.n += 1
-        self.total_s += seconds
-        self.max_s = max(self.max_s, seconds)
-
-    def percentile(self, q: float) -> float:
-        """Estimated q-quantile (q in [0, 1]) in seconds; 0.0 when empty.
-
-        Clamped to the recorded max so a sparse tail bucket cannot report a
-        latency larger than any request actually experienced."""
-        if self.n == 0:
-            return 0.0
-        rank = q * self.n
-        seen = 0
-        for i in sorted(self.counts):
-            seen += self.counts[i]
-            if seen >= rank:
-                return min(self._edge(i), self.max_s)
-        return self.max_s
+    @property
+    def max_s(self) -> float:
+        return self.max
 
     @property
     def mean_s(self) -> float:
-        return self.total_s / self.n if self.n else 0.0
+        return self.mean
 
 
 class ServeMetrics:
@@ -74,22 +47,68 @@ class ServeMetrics:
     `depth_fn` (optional) reads the live queue depth at snapshot time, so
     the gauge reflects the instant, not an average. The requests/sec
     counter is completed requests over the first-arrival..last-completion
-    wall span — the achieved (not offered) rate.
+    wall span — the achieved (not offered) rate. `registry` (optional)
+    selects where the `serve.*` metrics live; pass
+    `telemetry.get_registry()` to publish into the process-wide snapshot.
     """
 
     def __init__(self, depth_fn: Optional[Callable[[], int]] = None,
-                 clock: Callable[[], float] = time.monotonic):
-        self.latency = LatencyHistogram()
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        # the deprecated subclass keeps .latency's *_s spellings working
+        # for external readers of the old private type; a SECOND metrics
+        # instance on the same registry adopts the live histogram instead
+        # (get-or-adopt — the same merge semantics the counters below get
+        # from the registry's get-or-create)
+        try:
+            self.latency = LatencyHistogram("serve.latency_s")
+            self.registry.register("serve.latency_s", self.latency)
+        except ValueError:
+            adopted = self.registry.histogram("serve.latency_s")
+            if not isinstance(adopted, LatencyHistogram):
+                # property-only subclass, no extra state: reclassing keeps
+                # the *_s compat spellings working regardless of which
+                # owner created the live histogram first
+                adopted.__class__ = LatencyHistogram
+            self.latency = adopted
+        self._completed = self.registry.counter("serve.completed")
+        self._rejected = self.registry.counter("serve.rejected")
+        self._failed = self.registry.counter("serve.failed")
+        self._batches = self.registry.counter("serve.batches")
+        self._batched_rows = self.registry.counter("serve.batched_rows")
+        self._bucket_rows = self.registry.counter("serve.bucket_rows")
         self.depth_fn = depth_fn
+        if depth_fn is not None:
+            self.registry.gauge("serve.queue_depth").set_fn(depth_fn)
         self.clock = clock
-        self.completed = 0
-        self.rejected = 0
-        self.failed = 0
-        self.batches = 0
-        self.batched_rows = 0
-        self.bucket_rows = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+
+    # counter values under their historical attribute names
+    @property
+    def completed(self) -> int:
+        return self._completed.value
+
+    @property
+    def rejected(self) -> int:
+        return self._rejected.value
+
+    @property
+    def failed(self) -> int:
+        return self._failed.value
+
+    @property
+    def batches(self) -> int:
+        return self._batches.value
+
+    @property
+    def batched_rows(self) -> int:
+        return self._batched_rows.value
+
+    @property
+    def bucket_rows(self) -> int:
+        return self._bucket_rows.value
 
     # -- recording hooks --------------------------------------------------
 
@@ -99,11 +118,11 @@ class ServeMetrics:
 
     def record_done(self, latency_s: float) -> None:
         self.latency.record(latency_s)
-        self.completed += 1
+        self._completed.inc()
         self._t_last = self.clock()
 
     def record_reject(self) -> None:
-        self.rejected += 1
+        self._rejected.inc()
         if self._t_first is None:
             self._t_first = self.clock()
         self._t_last = self.clock()
@@ -113,14 +132,14 @@ class ServeMetrics:
         exception) — neither completed nor rejected, but it DID arrive:
         dropping it from the counters would make a fault storm read as a
         healthy low-traffic interval."""
-        self.failed += 1
+        self._failed.inc()
         self._t_last = self.clock()
 
     def record_batch(self, real_rows: int, bucket: int) -> None:
         """One batcher flush: `real_rows` requests padded into `bucket`."""
-        self.batches += 1
-        self.batched_rows += real_rows
-        self.bucket_rows += bucket
+        self._batches.inc()
+        self._batched_rows.inc(real_rows)
+        self._bucket_rows.inc(bucket)
 
     # -- snapshot ---------------------------------------------------------
 
@@ -144,8 +163,8 @@ class ServeMetrics:
                 "p50": round(lat.percentile(0.50) * 1e3, 3),
                 "p95": round(lat.percentile(0.95) * 1e3, 3),
                 "p99": round(lat.percentile(0.99) * 1e3, 3),
-                "mean": round(lat.mean_s * 1e3, 3),
-                "max": round(lat.max_s * 1e3, 3),
+                "mean": round(lat.mean * 1e3, 3),
+                "max": round(lat.max * 1e3, 3),
             },
             "batches": self.batches,
             # real rows per flush / bucket rows actually computed: 1.0 means

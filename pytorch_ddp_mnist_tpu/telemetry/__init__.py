@@ -1,0 +1,35 @@
+"""telemetry/ — process-wide observability: metrics registry, JSONL event
+trace, runtime collectors.
+
+The north star ("as fast as the hardware allows") is unreachable without
+knowing where time and memory actually go; the reference repo descends from
+an I/O-cost-evaluation harness whose timing code was lost (SURVEY.md §5.1).
+This package is the measurement substrate every later perf PR builds on,
+shared by train, serve, and bench alike:
+
+  * `registry.py`  — counters / gauges / histograms, get-or-create by name,
+    the whole process snapshot-able as ONE JSON dict. Absorbs what was
+    `serve.metrics.LatencyHistogram` (now a thin alias of `Histogram`).
+  * `events.py`    — schema-versioned JSONL event trace with nestable,
+    async-dispatch-aware `span()` context managers (opt-in
+    `block_until_ready` at exit, the `utils.profiling.Timer` contract); a
+    process-wide `NullTracer` until `enable()` so instrumented call sites
+    never branch and disabled telemetry costs nothing.
+  * `runtime.py`   — collectors: cached process index (shared with
+    `utils.logging.rank_zero_log`), XLA compile counts via `jax.monitoring`
+    (engine-probe fallback), device `memory_stats()` guarded for CPU, host
+    RSS.
+
+Front doors: `cli/train.py --telemetry DIR` (JSONL + rank-0 end-of-run
+summary), `cli/serve.py`'s `{"op": "stats"}` TCP op (live registry
+snapshot), `bench.py` artifact stamps, `make obs-smoke` +
+`scripts/check_telemetry.py` (schema validation). See docs/OBSERVABILITY.md.
+"""
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                       get_registry)
+from .events import (SCHEMA_VERSION, EventTrace, NullTracer,  # noqa: F401
+                     disable, enable, get_tracer)
+from .runtime import (collect_memory, device_memory_stats,  # noqa: F401
+                      host_rss_bytes, install_compile_listener,
+                      process_index_cached, record_engine_compiles)

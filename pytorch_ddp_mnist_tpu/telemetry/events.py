@@ -1,0 +1,253 @@
+"""Structured JSONL event trace: schema-versioned records, nestable spans.
+
+One line per record, append-only, line-buffered — a trace survives a crash
+up to its last completed record, and any line-oriented tool (jq, the
+`scripts/check_telemetry.py` validator) consumes it without a reader
+library. Every record carries:
+
+    v        schema version (SCHEMA_VERSION; checker rejects unknown)
+    kind     "meta" | "span" | "point" | "snapshot"
+    name     what the record describes ("epoch", "data_wait", "registry")
+    t_wall   wall-clock seconds (time.time — correlate across hosts/logs)
+    t_mono   monotonic seconds (time.perf_counter — order/duration truth;
+             non-decreasing within one run segment, checked). Files open
+             in APPEND mode so an outage-resume re-exec or a repeat run
+             adds a new segment (fresh `trace_start`, fresh ids/clock)
+             rather than losing the earlier trace.
+    proc     jax process index (telemetry.runtime.process_index_cached)
+
+Span records additionally carry `span` (id), `parent` (enclosing span's id
+or null) and `dur_s`; `attrs` holds free-form per-record payload. A span is
+ONE record emitted at exit (not a begin/end pair): the trace cannot hold a
+dangling begin, and ordering validation stays a single pass.
+
+Spans are async-dispatch aware exactly like `utils.profiling.Timer`: on
+device work a naive wall pair measures only enqueue time, so
+`span.sync(tree)` registers a pytree to `jax.block_until_ready` at exit —
+strictly OPT-IN, so an instrumented loop that never calls sync adds zero
+host syncs (the acceptance invariant tests pin). Aggregate child spans
+(`complete_span`) publish durations measured elsewhere (e.g. a
+CumulativeTimer total) under the currently open span without re-timing.
+
+The process-wide tracer is a no-op `NullTracer` until `telemetry.enable()`
+swaps in a real `EventTrace` — call sites never branch on "is telemetry
+on".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+SCHEMA_VERSION = 1
+KINDS = ("meta", "span", "point", "snapshot")
+
+
+class _Span:
+    """Context manager for one live span; emitted as a single record at
+    exit. `sync(tree)` opts into blocking on `tree` first (returns the tree
+    unchanged, the Timer.sync idiom)."""
+
+    def __init__(self, trace: "EventTrace", name: str, attrs: dict):
+        self._trace = trace
+        self.name = name
+        self.attrs = attrs
+        self.span_id: Optional[int] = None
+        self.parent_id: Optional[int] = None
+        self._sync_tree: Any = None
+
+    def sync(self, tree: Any) -> Any:
+        self._sync_tree = tree
+        return tree
+
+    def __enter__(self) -> "_Span":
+        self.span_id = self._trace._next_id()
+        self.parent_id = self._trace._current_span_id()
+        self._trace._stack.append(self.span_id)
+        self._t0_mono = time.perf_counter()
+        self._t0_wall = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            if self._sync_tree is not None:
+                import jax
+                jax.block_until_ready(self._sync_tree)
+        finally:
+            # pop + emit even when the drain raises (device failure): an
+            # unpopped id would corrupt every later span's parent, and the
+            # recorded (enqueue-side) duration of the failed span is still
+            # evidence
+            self._finish()
+
+    def _finish(self) -> None:
+        dur = time.perf_counter() - self._t0_mono
+        self._trace._stack.pop()
+        attrs = dict(self.attrs)
+        # span START stamps travel in attrs; the record's own t_mono/t_wall
+        # are EMISSION time like every other record, keeping the whole file
+        # non-decreasing in t_mono (a parent span's record is written after
+        # its children even though it started first)
+        attrs["t0_mono"] = self._t0_mono
+        attrs["t0_wall"] = self._t0_wall
+        self._trace._emit("span", self.name, span_id=self.span_id,
+                          parent_id=self.parent_id, dur_s=dur,
+                          attrs=attrs)
+
+
+class EventTrace:
+    """JSONL trace writer bound to one file. Not thread-safe by design —
+    one trace per process (the module-level tracer), written from the train
+    or serve loop's thread, exactly like the print-based epoch line."""
+
+    def __init__(self, path: str, *, process_index: Optional[int] = None):
+        self.path = str(path)
+        if process_index is None:
+            from .runtime import process_index_cached
+            process_index = process_index_cached()
+        self.process_index = int(process_index)
+        self._f = open(self.path, "a", buffering=1)  # line-buffered
+        self._ids = 0
+        self._stack: "list[int]" = []
+        self._emit("meta", "trace_start",
+                   attrs={"schema": SCHEMA_VERSION, "pid": os.getpid()})
+
+    # -- record plumbing ---------------------------------------------------
+
+    def _next_id(self) -> int:
+        self._ids += 1
+        return self._ids
+
+    def _current_span_id(self) -> Optional[int]:
+        return self._stack[-1] if self._stack else None
+
+    def _emit(self, kind: str, name: str, *, span_id=None, parent_id=None,
+              dur_s=None, attrs=None) -> None:
+        if kind not in KINDS:  # writer-side guard, mirrored by the checker
+            raise ValueError(f"unknown record kind {kind!r}")
+        rec = {
+            "v": SCHEMA_VERSION,
+            "kind": kind,
+            "name": name,
+            "t_wall": time.time(),
+            "t_mono": time.perf_counter(),
+            "proc": self.process_index,
+        }
+        if span_id is not None:
+            rec["span"] = span_id
+            rec["parent"] = parent_id
+            rec["dur_s"] = round(float(dur_s), 9)
+        if attrs:
+            rec["attrs"] = attrs
+        if self._f.closed:
+            return
+        self._f.write(json.dumps(rec) + "\n")
+
+    # -- public surface ----------------------------------------------------
+
+    def span(self, name: str, **attrs) -> _Span:
+        """Open a nestable span: `with trace.span("epoch", epoch=3) as s:`.
+        Emits one record at exit; `s.sync(tree)` opts into a device drain
+        first."""
+        return _Span(self, name, attrs)
+
+    def complete_span(self, name: str, dur_s: float, **attrs) -> None:
+        """Emit an already-measured span (e.g. a CumulativeTimer total)
+        under the currently open span — the per-phase aggregate pattern:
+        data-wait/step-compute totals are accumulated per step but emitted
+        once per epoch, so the trace grows per epoch, not per step."""
+        self._emit("span", name, span_id=self._next_id(),
+                   parent_id=self._current_span_id(), dur_s=dur_s,
+                   attrs=attrs or None)
+
+    def point(self, name: str, **attrs) -> None:
+        """One instantaneous event record."""
+        self._emit("point", name, attrs=attrs or None)
+
+    def snapshot(self, registry) -> None:
+        """Stamp a full registry snapshot into the trace — the record a
+        completed `--telemetry` train run closes with (a crashed run's
+        trace legitimately lacks it; the checker validates schema, not
+        run completeness)."""
+        self._emit("snapshot", "registry", attrs=registry.snapshot())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+class NullTracer:
+    """The disabled-telemetry tracer: every call is a no-op, and span()
+    returns a no-op context manager whose sync() forwards its tree
+    untouched — so instrumented call sites cost nothing and never force a
+    host sync when telemetry is off."""
+
+    class _NullSpan:
+        name = None
+        span_id = parent_id = None
+
+        def sync(self, tree):
+            return tree
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return None
+
+    _SPAN = _NullSpan()
+
+    def span(self, name: str, **attrs) -> "_NullSpan":
+        return self._SPAN
+
+    def complete_span(self, name: str, dur_s: float, **attrs) -> None:
+        pass
+
+    def point(self, name: str, **attrs) -> None:
+        pass
+
+    def snapshot(self, registry) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+_NULL = NullTracer()
+_tracer = _NULL
+
+
+def get_tracer():
+    """The process-wide tracer: a real EventTrace after `enable()`, the
+    shared NullTracer otherwise."""
+    return _tracer
+
+
+def enable(out_dir: str, *, process_index: Optional[int] = None) -> EventTrace:
+    """Switch the process-wide tracer to a real JSONL trace under
+    `out_dir` (created if needed). Process 0 writes `events.jsonl`; other
+    ranks write `events.rank{N}.jsonl` beside it — multi-host ranks cannot
+    share a file, and the checker validates every `events*.jsonl` in the
+    directory."""
+    global _tracer
+    if process_index is None:
+        from .runtime import process_index_cached
+        process_index = process_index_cached()
+    os.makedirs(out_dir, exist_ok=True)
+    name = ("events.jsonl" if process_index == 0
+            else f"events.rank{process_index}.jsonl")
+    if isinstance(_tracer, EventTrace):
+        _tracer.close()
+    _tracer = EventTrace(os.path.join(out_dir, name),
+                         process_index=process_index)
+    return _tracer
+
+
+def disable() -> None:
+    """Close any active trace and restore the no-op tracer."""
+    global _tracer
+    if isinstance(_tracer, EventTrace):
+        _tracer.close()
+    _tracer = _NULL

@@ -1,0 +1,138 @@
+"""Runtime collectors: process identity, XLA compile counts, memory.
+
+Everything here is observation of state other subsystems already produce —
+no collector forces device work, and every probe degrades to None/no-op on
+backends that do not expose it (CPU has no `memory_stats`; old jax builds
+may lack `jax.monitoring`), so telemetry can be enabled unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_process_index: Optional[int] = None
+
+
+def process_index_cached() -> int:
+    """`jax.process_index()` resolved once per process and cached.
+
+    The uncached spelling imports jax and queries the backend on every
+    call — `utils.logging.rank_zero_log` used to pay that on each factory
+    invocation, and the event trace would pay it per record. Failure
+    (jax absent, backend not up yet) is reported as rank 0 and NOT cached:
+    the pre-`jax.distributed`-init behavior stays "treat as process 0", and
+    the first post-init call still resolves the real rank."""
+    global _process_index
+    if _process_index is None:
+        try:
+            import jax
+            _process_index = int(jax.process_index())
+        except Exception:
+            return 0
+    return _process_index
+
+
+# -- XLA compile counting ----------------------------------------------------
+
+_compile_counter = None  # the one counter the process listener feeds
+
+
+def install_compile_listener(registry=None,
+                             counter_name: str = "xla.compiles") -> bool:
+    """Count backend compiles into `registry.counter(counter_name)` via
+    `jax.monitoring`'s duration events (one
+    `/jax/core/compile/backend_compile_duration` event per XLA compile —
+    jit cache hits fire nothing, so the counter reads true compile work,
+    the cold-compile signal serve/'s bucket ladder exists to eliminate).
+
+    Returns True when the listener feeds the REQUESTED counter.
+    jax.monitoring listeners cannot be unregistered individually, so
+    exactly one counter per process can be fed: a repeat install for the
+    same target is a no-op True, while a different registry/counter gets
+    False (not armed there — no silent zero-reading counter), and the
+    caller keeps the engine-probe pattern (`record_engine_compiles`) as
+    the portable source. False likewise where jax.monitoring is
+    unavailable."""
+    global _compile_counter
+    from .registry import get_registry
+    reg = registry or get_registry()
+    if _compile_counter is not None:
+        # peek, don't create: a mismatched re-install must not leave a
+        # zero-reading counter behind in the unfed registry
+        return reg._counters.get(counter_name) is _compile_counter
+    try:
+        from jax import monitoring
+    except Exception:
+        return False  # no counter created: the stamp reads absent, not 0
+    counter = reg.counter(counter_name)
+
+    def _on_duration(key: str, duration: float, **kw) -> None:
+        if "backend_compile" in key:
+            counter.inc()
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _compile_counter = counter
+    return True
+
+
+def record_engine_compiles(registry, compile_count: int,
+                           counter_name: str = "serve.engine_compiles") -> None:
+    """The compile-cache probe fallback: adopt an engine's own
+    `compile_count` (serve/engine.py's structural no-cold-compile
+    instrument) into the registry, portable to builds without
+    jax.monitoring."""
+    registry.counter(counter_name).set_total(compile_count)
+
+
+# -- memory ------------------------------------------------------------------
+
+def device_memory_stats() -> Optional[dict]:
+    """`memory_stats()` of the first local device, or None where the
+    backend does not implement it (CPU, some simulators)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        return dict(stats) if stats else None
+    except Exception:
+        return None
+
+
+def host_rss_bytes() -> Optional[int]:
+    """This process's resident set size in bytes (Linux /proc, with a
+    getrusage fallback for other unixes); None when neither source
+    exists."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports KiB, macOS bytes; this branch only runs off-Linux
+        return int(rss) if os.uname().sysname == "Darwin" else int(rss) * 1024
+    except Exception:
+        return None
+
+
+def collect_memory(registry=None) -> dict:
+    """Stamp the current memory picture into registry gauges and return it:
+    `host.rss_bytes` always, `device.bytes_in_use` / `device.peak_bytes_in_use`
+    when the backend reports them."""
+    from .registry import get_registry
+    reg = registry or get_registry()
+    out = {}
+    rss = host_rss_bytes()
+    if rss is not None:
+        reg.gauge("host.rss_bytes").set(rss)
+        out["host.rss_bytes"] = rss
+    stats = device_memory_stats()
+    if stats:
+        for key in ("bytes_in_use", "peak_bytes_in_use"):
+            if key in stats:
+                reg.gauge(f"device.{key}").set(int(stats[key]))
+                out[f"device.{key}"] = int(stats[key])
+    return out

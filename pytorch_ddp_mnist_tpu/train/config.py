@@ -45,7 +45,10 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                     "pytorch_ddp_mnist; see SURVEY.md)")
     t = p.add_argument_group("trainer")
     t.add_argument("--batch_size", type=int, default=128)
-    t.add_argument("--n_epochs", type=int, default=1)
+    t.add_argument("--n_epochs", "--epochs", type=int, default=1,
+                   help="epochs to train; --n_epochs is the reference "
+                        "spelling (mnist_cpu_mp.py:213), --epochs the "
+                        "common one")
     t.add_argument("--lr", type=float, default=0.01)
     t.add_argument("--seed", type=int, default=0)
     t.add_argument("--parallel", action="store_true",
@@ -113,6 +116,15 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "With --parallel: per-step DDP grad-mean via an "
                         "in-kernel ICI ring allreduce — EXPERIMENTAL, "
                         "multi-chip ring not yet hardware-verified)")
+    t.add_argument("--telemetry", type=str, default=None, metavar="DIR",
+                   help="write a structured JSONL event trace into DIR "
+                        "(telemetry/events.py schema: per-epoch spans with "
+                        "data-wait/step-compute/eval children, XLA compile "
+                        "counter, end-of-run registry snapshot) and print a "
+                        "rank-0 summary line; validate with "
+                        "scripts/check_telemetry.py DIR. Off by default — "
+                        "disabled telemetry adds no per-step host sync. See "
+                        "docs/OBSERVABILITY.md")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "
@@ -194,6 +206,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "dtype": a.dtype, "impl": a.impl,
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,
+            "telemetry": a.telemetry,
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,

@@ -38,6 +38,7 @@ from ..ops.sgd import sgd_step
 from ..data.loader import BatchLoader, device_prefetch
 from ..utils.logging import progress
 from ..utils.profiling import CumulativeTimer
+from ..telemetry.events import get_tracer
 
 
 @dataclass
@@ -310,32 +311,53 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     # MNIST per epoch for no reason.
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
+    tracer = get_tracer()  # NullTracer unless --telemetry enabled it
     for epoch in range(start_epoch, epochs):
-        t0 = time.perf_counter()
-        io_timer = CumulativeTimer("loader-wait")
-        train_loader.sampler.set_epoch(epoch)
-        losses = []
-        batches = progress(
-            device_prefetch(train_loader, sharding=sharding, put=put),
-            desc=f"epoch {epoch}")
-        live = _LiveLoss(batches)
-        it = iter(batches)
-        while True:
-            with io_timer:   # host time blocked on the data pipeline
-                batch = next(it, None)
-            if batch is None:
-                break
-            x, y = batch
-            params, key, loss = step(params, key, x, y)
-            losses.append(loss)
-            live.poll(losses)  # async bar update; never waits on the device
-        losses = np.asarray(jnp.stack(losses))  # single host fetch per epoch
-        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size,
-                       perm=eval_perm(epoch) if eval_perm else None)
-        log(epoch_summary(epoch, losses, batch_size, val,
-                          time.perf_counter() - t0,
-                          io_seconds=io_timer.total))
-        state = TrainState(params, key)
-        if epoch_hook is not None:
-            epoch_hook(epoch, state)
+        # Per-epoch trace span with the phase split the reference's
+        # ancestral I/O harness existed to report (SURVEY.md §5.1):
+        # data_wait (host blocked on the loader), step_compute (step
+        # dispatch + the end-of-epoch loss fetch, which blocks until every
+        # step's device work is done), eval. All child durations come from
+        # timers the loop already pays for — the tracer itself never forces
+        # a device sync, so enabling telemetry adds no per-step host sync
+        # (pinned by tests/test_telemetry.py).
+        with tracer.span("epoch", epoch=epoch):
+            t0 = time.perf_counter()
+            io_timer = CumulativeTimer("loader-wait")
+            step_timer = CumulativeTimer("step-dispatch")
+            train_loader.sampler.set_epoch(epoch)
+            losses = []
+            batches = progress(
+                device_prefetch(train_loader, sharding=sharding, put=put),
+                desc=f"epoch {epoch}")
+            live = _LiveLoss(batches)
+            it = iter(batches)
+            while True:
+                with io_timer:   # host time blocked on the data pipeline
+                    batch = next(it, None)
+                if batch is None:
+                    break
+                x, y = batch
+                with step_timer:
+                    params, key, loss = step(params, key, x, y)
+                losses.append(loss)
+                live.poll(losses)  # async bar update; never waits on device
+            t_fetch = time.perf_counter()
+            losses = np.asarray(jnp.stack(losses))  # single fetch per epoch
+            fetch_s = time.perf_counter() - t_fetch
+            tracer.complete_span("data_wait", io_timer.total,
+                                 batches=io_timer.count)
+            tracer.complete_span("step_compute", step_timer.total + fetch_s,
+                                 steps=step_timer.count, fetch_s=fetch_s)
+            t_eval = time.perf_counter()
+            val = evaluate(eval_step, params, x_test_dev, y_test_dev,
+                           batch_size,
+                           perm=eval_perm(epoch) if eval_perm else None)
+            tracer.complete_span("eval", time.perf_counter() - t_eval)
+            log(epoch_summary(epoch, losses, batch_size, val,
+                              time.perf_counter() - t0,
+                              io_seconds=io_timer.total))
+            state = TrainState(params, key)
+            if epoch_hook is not None:
+                epoch_hook(epoch, state)
     return state

@@ -43,6 +43,7 @@ from ..ops.loss import cross_entropy
 from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
+from ..telemetry.events import get_tracer
 from .loop import (TrainState, epoch_summary, evaluate, make_eval_step,
                    make_snapshot_eval_step, val_summary)
 
@@ -628,6 +629,11 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             params, key, x_all, y_all, idxs)
         losses = np.asarray(losses)                      # sync: run finished
         per_epoch_dt = (time.perf_counter() - t0) / len(run_epochs)
+        # one span for the whole fused program — there is no per-epoch
+        # phase split inside a single device program to report
+        get_tracer().complete_span("fused_run", time.perf_counter() - t0,
+                                   epochs=len(run_epochs),
+                                   steps=int(losses.size))
         # Replay ALL epochs' val lines from one vmapped eval program + one
         # fetch — per-epoch evaluate() calls here would cost E dispatch
         # round-trips (a full tunnel RTT each on a remote TPU).
@@ -647,21 +653,31 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 epoch_hook(epoch, TrainState(p_e, k_snaps[i]))
         return TrainState(params, key)
 
+    tracer = get_tracer()
     eval_step = make_eval_step()
     for epoch in range(start_epoch, epochs):
-        t0 = time.perf_counter()
-        sampler.set_epoch(epoch)
-        idx = epoch_batch_indices(sampler, batch_size)
-        if idx_sharding is not None:
-            idx = jax.make_array_from_callback(
-                idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
-        params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
-        losses = np.asarray(losses)                 # one host fetch per epoch
-        val = evaluate(eval_step, params, x_test_dev, y_test_dev, batch_size,
-                       perm=eval_perm(epoch) if eval_perm else None)
-        log(epoch_summary(epoch, losses, batch_size, val,
-                          time.perf_counter() - t0))
-        state = TrainState(params, key)
-        if epoch_hook is not None:
-            epoch_hook(epoch, state)
+        with tracer.span("epoch", epoch=epoch):
+            t0 = time.perf_counter()
+            sampler.set_epoch(epoch)
+            idx = epoch_batch_indices(sampler, batch_size)
+            if idx_sharding is not None:
+                idx = jax.make_array_from_callback(
+                    idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
+            params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
+            losses = np.asarray(losses)             # one host fetch per epoch
+            # the fetch above blocks until the epoch program finished, so
+            # this is the whole device phase — the cached path has no
+            # separate data wait (the dataset lives in HBM)
+            tracer.complete_span("step_compute", time.perf_counter() - t0,
+                                 steps=int(losses.size))
+            t_eval = time.perf_counter()
+            val = evaluate(eval_step, params, x_test_dev, y_test_dev,
+                           batch_size,
+                           perm=eval_perm(epoch) if eval_perm else None)
+            tracer.complete_span("eval", time.perf_counter() - t_eval)
+            log(epoch_summary(epoch, losses, batch_size, val,
+                              time.perf_counter() - t0))
+            state = TrainState(params, key)
+            if epoch_hook is not None:
+                epoch_hook(epoch, state)
     return state

@@ -38,13 +38,15 @@ DISABLE_TQDM = _env_flag("DISABLE_TQDM")
 
 def rank_zero_log(log: Callable[[str], None] = print) -> Callable[[str], None]:
     """Return `log` on process 0, a no-op elsewhere. Safe before
-    jax.distributed init (treats that as single-process)."""
-    try:
-        import jax
-        is_zero = jax.process_index() == 0
-    except Exception:
-        is_zero = True
-    if is_zero:
+    jax.distributed init (treats that as single-process).
+
+    Process identity comes from the cached telemetry helper — the previous
+    spelling imported jax and queried the backend on EVERY factory call;
+    the cached resolve is shared with the event trace's per-record `proc`
+    tag, and a pre-init failure still reads as rank 0 without being
+    cached."""
+    from ..telemetry.runtime import process_index_cached
+    if process_index_cached() == 0:
         return log
     return lambda _msg: None
 
@@ -60,11 +62,8 @@ def progress(iterable: Iterable[T], desc: str = "", *,
     if disable is None:
         disable = DISABLE_TQDM or not sys.stderr.isatty()
         if not disable:
-            try:
-                import jax
-                disable = jax.process_index() != 0
-            except Exception:
-                disable = False
+            from ..telemetry.runtime import process_index_cached
+            disable = process_index_cached() != 0
     if disable:
         return iter(iterable)
     try:

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Validate a telemetry JSONL event trace against the v1 schema.
+
+    python scripts/check_telemetry.py /tmp/obs            # a --telemetry dir
+    python scripts/check_telemetry.py events.jsonl        # or one file
+
+Exit 0 when every `events*.jsonl` is schema-valid; nonzero (with one line
+per violation on stderr) on malformed JSON, unknown schema version or kind,
+missing required fields, OUT-OF-ORDER records (t_mono must be
+non-decreasing within a run segment — the writer stamps emission time
+exactly so this holds; an appended file holds one segment per
+`trace_start` record), negative span durations, or span parent references
+that never appear in their segment. Pure stdlib, no jax import: the
+checker must run anywhere the trace lands, including hosts without the
+framework installed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+SCHEMA_VERSION = 1
+KINDS = ("meta", "span", "point", "snapshot")
+REQUIRED = ("v", "kind", "name", "t_wall", "t_mono", "proc")
+
+
+def check_file(path: str, errors: list) -> int:
+    """Validate one JSONL file; appends "path:line: why" strings to
+    `errors` and returns the number of records read.
+
+    The writer opens in APPEND mode (crash/outage-resume friendly), so one
+    file may hold several run segments, each beginning with a
+    `trace_start` meta record. Ordering and span-id scope reset per
+    segment: t_mono is monotonic within a segment (perf_counter restarts
+    across processes/reboots), and a span's parent must resolve within its
+    own segment (ids restart at 1 each run)."""
+    span_ids = set()
+    parent_refs = []  # (line_no, parent_id)
+    last_mono = None
+    n = 0
+
+    def flush_segment():
+        for line_no, parent in parent_refs:
+            # parents close AFTER their children, so the id resolves
+            # against the whole segment, not just the lines above
+            if parent not in span_ids:
+                errors.append(f"{path}:{line_no}: parent span {parent} "
+                              f"never recorded")
+        span_ids.clear()
+        parent_refs.clear()
+
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            where = f"{path}:{line_no}"
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"{where}: malformed JSON ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"{where}: record is not an object")
+                continue
+            missing = [k for k in REQUIRED if k not in rec]
+            if missing:
+                errors.append(f"{where}: missing fields {missing}")
+                continue
+            if rec["v"] != SCHEMA_VERSION:
+                errors.append(f"{where}: unknown schema version {rec['v']!r}")
+                continue
+            if rec["kind"] not in KINDS:
+                errors.append(f"{where}: unknown kind {rec['kind']!r}")
+                continue
+            if rec["kind"] == "meta" and rec["name"] == "trace_start":
+                flush_segment()     # a new appended run: fresh id scope
+                last_mono = None    # and a fresh monotonic clock
+            if not isinstance(rec["t_mono"], (int, float)):
+                errors.append(f"{where}: t_mono is not a number")
+                continue
+            if last_mono is not None and rec["t_mono"] < last_mono:
+                errors.append(f"{where}: out of order (t_mono "
+                              f"{rec['t_mono']} < previous {last_mono})")
+            last_mono = rec["t_mono"]
+            if rec["kind"] == "span":
+                for k in ("span", "dur_s"):
+                    if k not in rec:
+                        errors.append(f"{where}: span record missing {k!r}")
+                        break
+                else:
+                    if not isinstance(rec["dur_s"], (int, float)):
+                        errors.append(f"{where}: dur_s is not a number")
+                    elif rec["dur_s"] < 0:
+                        errors.append(f"{where}: negative dur_s "
+                                      f"{rec['dur_s']}")
+                    span_ids.add(rec["span"])
+                    if rec.get("parent") is not None:
+                        parent_refs.append((line_no, rec["parent"]))
+    flush_segment()
+    return n
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    target = argv[0]
+    if os.path.isdir(target):
+        files = sorted(glob.glob(os.path.join(target, "events*.jsonl")))
+        if not files:
+            print(f"check_telemetry: no events*.jsonl under {target}",
+                  file=sys.stderr)
+            return 1
+    elif os.path.exists(target):
+        files = [target]
+    else:
+        print(f"check_telemetry: {target} does not exist", file=sys.stderr)
+        return 1
+    errors: "list[str]" = []
+    total = 0
+    for path in files:
+        got = check_file(path, errors)
+        if got == 0:
+            errors.append(f"{path}: empty trace")
+        total += got
+    if errors:
+        for e in errors:
+            print(f"check_telemetry: {e}", file=sys.stderr)
+        print(f"check_telemetry: FAIL — {len(errors)} violation(s) across "
+              f"{len(files)} file(s)", file=sys.stderr)
+        return 1
+    print(f"check_telemetry: OK — {total} record(s) across {len(files)} "
+          f"file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

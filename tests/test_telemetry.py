@@ -1,0 +1,526 @@
+"""telemetry/: the unified metrics registry, the JSONL event trace and its
+schema checker, the runtime collectors, and the acceptance invariants —
+`--telemetry` emits a schema-valid trace with per-epoch phase spans and a
+registry snapshot, the serve `{"op": "stats"}` op answers the same registry
+shape, and DISABLED telemetry adds zero `block_until_ready`-forcing calls
+to the training hot loop."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from pytorch_ddp_mnist_tpu import telemetry
+from pytorch_ddp_mnist_tpu.telemetry import (Counter, EventTrace, Gauge,
+                                             Histogram, MetricsRegistry,
+                                             NullTracer)
+from pytorch_ddp_mnist_tpu.telemetry import events as events_mod
+from pytorch_ddp_mnist_tpu.telemetry import runtime as runtime_mod
+
+# the checker is a repo-root script, not a package module (the repo idiom,
+# see test_bench's bench_matrix loads)
+import importlib.util
+import pathlib
+
+_spec = importlib.util.spec_from_file_location(
+    "check_telemetry",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts"
+    / "check_telemetry.py")
+_checker = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_checker)
+check_main = _checker.main
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_get_or_create_and_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("train.steps")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("train.steps") is c          # same live instance
+    reg.gauge("queue.depth").set(7)
+    reg.histogram("lat").record(0.010)
+    snap = reg.snapshot()
+    assert snap["counters"]["train.steps"] == 5
+    assert snap["gauges"]["queue.depth"] == 7
+    assert snap["histograms"]["lat"]["n"] == 1
+    json.dumps(snap)                                # JSON-able verbatim
+
+
+def test_registry_type_conflict_raises():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="different type"):
+        reg.gauge("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.register("x", Histogram("x"))
+    with pytest.raises(TypeError):
+        reg.register("y", object())
+
+
+def test_counter_is_monotonic():
+    c = Counter("n")
+    with pytest.raises(ValueError, match="decrease"):
+        c.inc(-1)
+    c.set_total(10)
+    c.set_total(3)                                  # never moves down
+    assert c.value == 10
+
+
+def test_gauge_callable_reads_the_instant():
+    box = {"v": 1}
+    g = Gauge("depth")
+    g.set_fn(lambda: box["v"])
+    assert g.value == 1
+    box["v"] = 9
+    assert g.value == 9
+    g.set_fn(lambda: 1 / 0)                         # dead provider
+    assert g.value is None                          # must not kill snapshot
+
+
+def test_histogram_percentiles_pessimistic_and_clamped():
+    h = Histogram("lat")
+    assert h.percentile(0.99) == 0.0                # empty
+    for v in (0.001, 0.002, 0.005, 0.100):
+        h.record(v)
+    assert h.percentile(0.50) == pytest.approx(0.002, rel=0.25)
+    assert h.percentile(0.99) == pytest.approx(0.100, rel=1e-6)  # clamp
+    snap = h.snapshot()
+    assert set(snap) == {"n", "mean", "max", "p50", "p95", "p99"}
+    assert snap["n"] == 4 and snap["max"] == 0.100
+
+
+def test_serve_latency_histogram_is_registry_alias():
+    """The old private serve type survives as a thin alias of the shared
+    Histogram, seconds-unit spellings intact."""
+    from pytorch_ddp_mnist_tpu.serve.metrics import LatencyHistogram
+    h = LatencyHistogram()
+    assert isinstance(h, Histogram)
+    h.record(0.004)
+    assert h.mean_s == h.mean and h.max_s == h.max and h.total_s == h.total
+
+
+def test_serve_metrics_publish_into_registry():
+    from pytorch_ddp_mnist_tpu.serve.metrics import ServeMetrics
+    reg = MetricsRegistry()
+    m = ServeMetrics(depth_fn=lambda: 2, registry=reg)
+    m.record_arrival()
+    m.record_done(0.003)
+    m.record_reject()
+    m.record_batch(3, 4)
+    snap = reg.snapshot()
+    assert snap["counters"]["serve.completed"] == 1
+    assert snap["counters"]["serve.rejected"] == 1
+    assert snap["counters"]["serve.bucket_rows"] == 4
+    assert snap["gauges"]["serve.queue_depth"] == 2
+    assert snap["histograms"]["serve.latency_s"]["n"] == 1
+    # the dashboard snapshot keeps its original shape on top
+    assert m.snapshot()["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# events: JSONL trace
+# ---------------------------------------------------------------------------
+
+def test_event_trace_spans_nest_and_validate(tmp_path):
+    trace = telemetry.enable(str(tmp_path))
+    try:
+        with trace.span("epoch", epoch=0) as ep:
+            trace.complete_span("data_wait", 0.25, batches=3)
+            with trace.span("eval") as ev:
+                pass
+        trace.point("checkpoint", path="m.msgpack")
+        reg = MetricsRegistry()
+        reg.counter("xla.compiles").inc(2)
+        trace.snapshot(reg)
+    finally:
+        telemetry.disable()
+    assert check_main([str(tmp_path)]) == 0
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "events.jsonl").read().splitlines()]
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["trace_start"]["kind"] == "meta"
+    # children carry the epoch span's id as parent; the epoch span itself
+    # is top-level, and nesting state unwound cleanly
+    assert by_name["data_wait"]["parent"] == by_name["epoch"]["span"]
+    assert by_name["eval"]["parent"] == by_name["epoch"]["span"]
+    assert by_name["epoch"]["parent"] is None
+    assert by_name["data_wait"]["dur_s"] == 0.25
+    assert by_name["checkpoint"]["kind"] == "point"
+    assert by_name["registry"]["attrs"]["counters"]["xla.compiles"] == 2
+    assert all(r["v"] == 1 and "proc" in r for r in recs)
+    # ordering invariant the checker enforces: emission-stamped t_mono
+    monos = [r["t_mono"] for r in recs]
+    assert monos == sorted(monos)
+    assert ep.parent_id is None and ev.parent_id == ep.span_id
+
+
+def test_event_trace_span_sync_blocks_at_exit(tmp_path, monkeypatch):
+    """span.sync(tree) is the Timer.sync contract: nothing blocks at the
+    sync() call, the registered tree drains once at span exit."""
+    calls = []
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda t: calls.append(t) or t)
+    trace = EventTrace(str(tmp_path / "t.jsonl"), process_index=0)
+    fake_tree = {"loss": object()}
+    with trace.span("epoch") as s:
+        assert s.sync(fake_tree) is fake_tree
+        assert calls == []                          # deferred
+    assert calls == [fake_tree]                     # exactly one drain
+    trace.close()
+
+
+def test_span_sync_failure_still_emits_and_unwinds(tmp_path, monkeypatch):
+    """A failing device drain (XlaRuntimeError at block_until_ready) must
+    not corrupt the tracer: the span still pops off the parent stack and
+    its record is still written, then the exception propagates."""
+    def boom(_t):
+        raise RuntimeError("device lost")
+    monkeypatch.setattr(jax, "block_until_ready", boom)
+    trace = EventTrace(str(tmp_path / "t.jsonl"), process_index=0)
+    with pytest.raises(RuntimeError, match="device lost"):
+        with trace.span("epoch") as s:
+            s.sync({"x": 1})
+    with trace.span("next"):            # stack unwound: top-level again
+        pass
+    trace.close()
+    spans = {r["name"]: r for r in
+             (json.loads(ln) for ln in open(tmp_path / "t.jsonl"))
+             if r["kind"] == "span"}
+    assert spans["epoch"]["dur_s"] >= 0     # failed span still recorded
+    assert spans["next"]["parent"] is None  # not parented to the dead span
+
+
+def test_null_tracer_is_default_and_free():
+    assert isinstance(events_mod.get_tracer(), NullTracer)
+    t = events_mod.get_tracer()
+    with t.span("anything", epoch=1) as s:
+        tree = {"a": 1}
+        assert s.sync(tree) is tree                 # forwards untouched
+    t.complete_span("x", 1.0)
+    t.point("y")
+    t.snapshot(MetricsRegistry())
+    t.close()                                       # all no-ops
+
+
+def test_enable_disable_swaps_process_tracer(tmp_path):
+    tr = telemetry.enable(str(tmp_path), process_index=3)
+    try:
+        assert events_mod.get_tracer() is tr
+        assert tr.path.endswith("events.rank3.jsonl")  # rank-gated file
+    finally:
+        telemetry.disable()
+    assert isinstance(events_mod.get_tracer(), NullTracer)
+
+
+# ---------------------------------------------------------------------------
+# checker: reject the broken streams
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, lines, name="events.jsonl"):
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(tmp_path)
+
+
+def _rec(**kw):
+    base = {"v": 1, "kind": "point", "name": "x", "t_wall": 1.0,
+            "t_mono": 1.0, "proc": 0}
+    base.update(kw)
+    return json.dumps(base)
+
+
+def test_checker_accepts_synthetic_good_stream(tmp_path, capsys):
+    good = [
+        _rec(kind="meta", name="trace_start", t_mono=1.0),
+        _rec(kind="span", name="child", t_mono=2.0, span=2, parent=1,
+             dur_s=0.5),
+        _rec(kind="span", name="parent", t_mono=3.0, span=1, parent=None,
+             dur_s=1.0),
+        _rec(kind="snapshot", name="registry", t_mono=4.0),
+    ]
+    assert check_main([_write(tmp_path, good)]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("bad,why", [
+    (["{not json"], "malformed"),
+    ([_rec(v=99)], "schema version"),
+    ([_rec(kind="mystery")], "unknown kind"),
+    ([json.dumps({"v": 1, "kind": "point"})], "missing fields"),
+    ([_rec(t_mono=5.0), _rec(t_mono=1.0)], "out of order"),
+    ([_rec(kind="span", span=1, dur_s=-0.1)], "negative"),
+    ([_rec(kind="span", span=1, dur_s="0.5")], "not a number"),
+    ([_rec(kind="span", span=2, parent=77, dur_s=0.1)], "never recorded"),
+    ([_rec(kind="span", dur_s=0.5)], "missing 'span'"),
+])
+def test_checker_rejects_broken_streams(tmp_path, capsys, bad, why):
+    assert check_main([_write(tmp_path, bad)]) == 1
+    assert why in capsys.readouterr().err
+
+
+def test_checker_resets_scope_per_appended_segment(tmp_path):
+    """The writer appends, so two runs share one file: the second segment's
+    restarted t_mono clock and reused span ids must validate, while a
+    cross-segment parent reference must not resolve."""
+    two_runs = [
+        _rec(kind="meta", name="trace_start", t_mono=100.0),
+        _rec(kind="span", name="epoch", t_mono=101.0, span=1, parent=None,
+             dur_s=1.0),
+        # appended second run: clock restarted (reboot/new process), same ids
+        _rec(kind="meta", name="trace_start", t_mono=5.0),
+        _rec(kind="span", name="epoch", t_mono=6.0, span=1, parent=None,
+             dur_s=1.0),
+    ]
+    assert check_main([_write(tmp_path, two_runs)]) == 0
+    leaky = two_runs[:3] + [
+        _rec(kind="span", name="child", t_mono=6.0, span=2, parent=1,
+             dur_s=0.5),   # parent 1 lives in the PREVIOUS segment only
+    ]
+    assert check_main([_write(tmp_path, leaky)]) == 1
+
+
+def test_checker_empty_and_missing_targets(tmp_path, capsys):
+    assert check_main([str(tmp_path)]) == 1         # no events*.jsonl
+    assert check_main([str(tmp_path / "nope")]) == 1
+    (tmp_path / "events.jsonl").write_text("")
+    assert check_main([str(tmp_path)]) == 1         # empty trace
+    assert check_main([]) == 2                      # usage
+
+
+# ---------------------------------------------------------------------------
+# runtime collectors
+# ---------------------------------------------------------------------------
+
+def test_process_index_cached_resolves_once(monkeypatch):
+    monkeypatch.setattr(runtime_mod, "_process_index", None)
+    assert runtime_mod.process_index_cached() == 0  # single process
+    # resolved value is cached: a later backend failure cannot change it
+    monkeypatch.setattr(jax, "process_index",
+                        lambda: (_ for _ in ()).throw(RuntimeError("down")))
+    assert runtime_mod.process_index_cached() == 0
+
+
+def test_process_index_failure_reads_rank0_uncached(monkeypatch):
+    """Pre-`jax.distributed`-init behavior: a failing resolve reports 0
+    but is NOT cached, so the first post-init call still lands the real
+    rank."""
+    monkeypatch.setattr(runtime_mod, "_process_index", None)
+    monkeypatch.setattr(jax, "process_index",
+                        lambda: (_ for _ in ()).throw(RuntimeError("not up")))
+    assert runtime_mod.process_index_cached() == 0
+    assert runtime_mod._process_index is None       # failure not cached
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert runtime_mod.process_index_cached() == 2
+
+
+def test_rank_zero_log_uses_cached_index(monkeypatch):
+    from pytorch_ddp_mnist_tpu.utils import rank_zero_log
+    lines = []
+    assert rank_zero_log(lines.append)("hi") is None and lines == ["hi"]
+    monkeypatch.setattr(runtime_mod, "_process_index", 3)
+    silent = rank_zero_log(lines.append)
+    silent("dropped")
+    assert lines == ["hi"]                          # non-zero rank: no-op
+
+
+def test_compile_listener_counts_fresh_compiles():
+    armed = telemetry.install_compile_listener()
+    counter = telemetry.get_registry().counter("xla.compiles")
+    if not armed:                                   # old jax: fallback path
+        pytest.skip("jax.monitoring unavailable")
+    before = counter.value
+    # a shape this process has never jitted: guaranteed fresh backend compile
+    fn = jax.jit(lambda x: x * 3 + 1)
+    fn(jnp.ones((7, 13, 3)))
+    assert counter.value > before
+    # cache hit (same jitted callable, same shape): no new compile counted
+    mid = counter.value
+    fn(jnp.ones((7, 13, 3)))
+    assert counter.value == mid
+
+
+def test_compile_listener_single_target_per_process():
+    """One counter per process: a repeat install for the same target is a
+    no-op True; a different registry gets an honest False (never a
+    silently zero-reading counter) and keeps the engine-probe fallback."""
+    if not telemetry.install_compile_listener():
+        pytest.skip("jax.monitoring unavailable")
+    assert telemetry.install_compile_listener() is True      # same target
+    other = MetricsRegistry()
+    assert telemetry.install_compile_listener(other) is False
+    # the refusal left no zero-reading counter behind: the artifact stamp
+    # reads absent (None), never a false 0
+    assert "xla.compiles" not in other.snapshot()["counters"]
+
+
+def test_serve_metrics_reconstruct_on_shared_registry():
+    """A second ServeMetrics on the same registry (service rebuilt against
+    the process-wide registry) adopts the live metrics instead of raising —
+    merge semantics, same as the counters' get-or-create."""
+    from pytorch_ddp_mnist_tpu.serve.metrics import ServeMetrics
+    reg = MetricsRegistry()
+    m1 = ServeMetrics(registry=reg)
+    m1.record_arrival()
+    m1.record_done(0.001)
+    m2 = ServeMetrics(registry=reg)
+    m2.record_arrival()
+    m2.record_done(0.002)
+    assert reg.snapshot()["histograms"]["serve.latency_s"]["n"] == 2
+    assert m2.snapshot()["completed"] == 2
+    # the adopted instance keeps the deprecated *_s compat spellings
+    assert m2.latency.mean_s == m2.latency.mean
+    assert m2.latency is m1.latency
+
+
+def test_engine_compile_probe_fallback():
+    reg = MetricsRegistry()
+    telemetry.record_engine_compiles(reg, 5)
+    assert reg.snapshot()["counters"]["serve.engine_compiles"] == 5
+
+
+def test_memory_collectors_guarded_for_cpu():
+    assert telemetry.device_memory_stats() is None or \
+        isinstance(telemetry.device_memory_stats(), dict)   # CPU: None
+    rss = telemetry.host_rss_bytes()
+    assert rss is None or rss > 0
+    reg = MetricsRegistry()
+    out = telemetry.collect_memory(reg)
+    if rss is not None:
+        assert out["host.rss_bytes"] > 0
+        assert reg.snapshot()["gauges"]["host.rss_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serve {"op": "stats"}
+# ---------------------------------------------------------------------------
+
+def test_serve_stats_op_answers_registry_snapshot():
+    from pytorch_ddp_mnist_tpu.cli.serve import handle_request
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.serve import InferenceEngine, ServeService
+
+    eng = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=4)
+    reg = MetricsRegistry()
+    telemetry.record_engine_compiles(reg, eng.compile_count)
+    svc = ServeService(eng, max_delay_ms=1.0, registry=reg)
+
+    async def scenario():
+        pred = await handle_request(svc, {"pixels": [0.1] * 784})
+        stats = await handle_request(svc, {"op": "stats"})
+        legacy = await handle_request(svc, {"op": "metrics"})
+        return pred, stats, legacy
+
+    pred, stats, legacy = asyncio.run(scenario())
+    assert pred["ok"] and 0 <= pred["pred"] <= 9
+    # the registry snapshot shape, same as the JSONL final record's attrs
+    assert set(stats["registry"]) == {"counters", "gauges", "histograms"}
+    assert stats["registry"]["counters"]["serve.completed"] == 1
+    assert stats["registry"]["counters"]["serve.engine_compiles"] == \
+        eng.compile_count
+    assert stats["registry"]["histograms"]["serve.latency_s"]["n"] == 1
+    # the percentile dashboard rides along, identical to the legacy op
+    assert stats["serve"]["completed"] == legacy["completed"] == 1
+    json.dumps(stats)
+
+
+# ---------------------------------------------------------------------------
+# train loop wiring + the no-sync acceptance invariant
+# ---------------------------------------------------------------------------
+
+def _tiny_fit(tracer_dir=None):
+    from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
+                                            synthetic_mnist)
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    train = synthetic_mnist(128, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(train.images), train.labels,
+                         sampler, batch_size=32)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    return fit(state, loader, normalize_images(test.images),
+               test.labels.astype(np.int32), epochs=2, batch_size=32,
+               lr=0.1, log=lambda _m: None)
+
+
+def test_hot_loop_never_forces_block_until_ready(monkeypatch):
+    """Acceptance: telemetry DISABLED (the default) adds no per-step host
+    sync — the streaming train loop performs ZERO block_until_ready-forcing
+    calls (its one sync per epoch is the loss-curve fetch, not a drain);
+    and ENABLING telemetry keeps it at zero (spans never sync unless a
+    call site opts in)."""
+    calls = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda t: calls.append(1) or real(t))
+    _tiny_fit()
+    assert calls == []                              # disabled: none
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        telemetry.enable(td)
+        try:
+            _tiny_fit()
+        finally:
+            telemetry.disable()
+    assert calls == []                              # enabled: still none
+
+
+def test_fit_emits_epoch_phase_spans(tmp_path):
+    telemetry.enable(str(tmp_path))
+    try:
+        _tiny_fit()
+    finally:
+        telemetry.disable()
+    assert check_main([str(tmp_path)]) == 0
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "events.jsonl").read().splitlines()]
+    epochs = [r for r in recs if r["name"] == "epoch"]
+    assert [r["attrs"]["epoch"] for r in epochs] == [0, 1]
+    for ep in epochs:
+        kids = {r["name"]: r for r in recs
+                if r.get("parent") == ep["span"]}
+        assert {"data_wait", "step_compute", "eval"} <= set(kids)
+        assert kids["step_compute"]["attrs"]["steps"] == 4   # 128/32
+        # the phase split can never exceed the epoch wall time
+        assert (kids["data_wait"]["dur_s"] + kids["step_compute"]["dur_s"]
+                <= ep["dur_s"] + 1e-6)
+
+
+# ---------------------------------------------------------------------------
+# CLI front door (in-process): the acceptance command's contract
+# ---------------------------------------------------------------------------
+
+def test_cli_train_telemetry_end_to_end(tmp_path, capsys):
+    from pytorch_ddp_mnist_tpu.cli.train import main
+    obs = tmp_path / "obs"
+    assert main(["--epochs", "1", "--limit", "256", "--batch_size", "64",
+                 "--path", str(tmp_path / "nodata"), "--checkpoint", "",
+                 "--telemetry", str(obs)]) == 0
+    out = capsys.readouterr().out
+    assert "[telemetry]" in out and "xla_compiles=" in out  # rank-0 summary
+    assert check_main([str(obs)]) == 0
+    recs = [json.loads(ln) for ln in
+            open(obs / "events.jsonl").read().splitlines()]
+    names = [r["name"] for r in recs]
+    assert {"epoch", "data_wait", "step_compute", "eval"} <= set(names)
+    final = recs[-1]
+    assert final["kind"] == "snapshot"              # last record = registry
+    assert final["attrs"]["counters"]["xla.compiles"] > 0
+    assert final["attrs"]["gauges"].get("host.rss_bytes", 0) > 0
+
+
+def test_epochs_alias_for_n_epochs():
+    from pytorch_ddp_mnist_tpu.train.config import configure
+    assert configure(["--epochs", "3"])["trainer"]["n_epochs"] == 3
+    assert configure(["--n_epochs", "2"])["trainer"]["n_epochs"] == 2
+    assert configure([])["trainer"]["telemetry"] is None    # off by default

@@ -25,6 +25,43 @@ def test_timer_sync_blocks_on_device_work():
     assert out.shape == (256, 256)
 
 
+def test_timer_sync_defers_blocking_to_exit(monkeypatch):
+    """Timer.sync registers (and forwards) a pytree without blocking; the
+    one block_until_ready happens at EXIT, on exactly that tree — the
+    async-dispatch contract telemetry spans inherit. A fake pytree (never a
+    device array) proves the timer itself does the draining."""
+    calls = []
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda t: calls.append(t) or t)
+    fake_tree = {"loss": object()}
+    with Timer("t") as t:
+        assert t.sync(fake_tree) is fake_tree       # returned unchanged
+        assert calls == []                          # no block at sync()
+    assert calls == [fake_tree]                     # one drain, at exit
+    assert t.seconds is not None and t.seconds >= 0
+
+
+def test_timer_without_sync_never_blocks(monkeypatch):
+    monkeypatch.setattr(
+        jax, "block_until_ready",
+        lambda t: (_ for _ in ()).throw(AssertionError("unexpected drain")))
+    with Timer("t"):
+        pass
+
+
+def test_cumulative_timer_mean_count_arithmetic():
+    """mean == total/count exactly, and the empty timer reads 0.0 (not a
+    ZeroDivisionError) — the denominators telemetry's per-epoch aggregate
+    spans divide by."""
+    t = CumulativeTimer("x")
+    assert t.count == 0 and t.total == 0.0 and t.mean == 0.0
+    for _ in range(4):
+        with t:
+            pass
+    assert t.count == 4
+    assert t.mean == pytest.approx(t.total / 4, rel=0, abs=1e-15)
+
+
 def test_cumulative_timer_accumulates():
     t = CumulativeTimer("io")
     for _ in range(3):
