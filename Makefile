@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke obs-smoke native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -31,6 +31,25 @@ obs-smoke:
 		--limit 512 --batch_size 64 --checkpoint "" \
 		--telemetry /tmp/pdmt_obs_smoke
 	$(PY) scripts/check_telemetry.py /tmp/pdmt_obs_smoke
+
+# Trace-analysis round trip: emit a real trace (1 CPU epoch), validate the
+# schema + span structure, render the phase report, self-gate it against
+# its own baseline (a run never regresses against itself), and export the
+# Perfetto-loadable Chrome trace. Nonzero exit on any failure.
+trace-smoke:
+	rm -rf /tmp/pdmt_trace_smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu train --epochs 2 \
+		--limit 512 --batch_size 64 --checkpoint "" \
+		--telemetry /tmp/pdmt_trace_smoke
+	$(PY) scripts/check_telemetry.py /tmp/pdmt_trace_smoke
+	$(PY) -m pytorch_ddp_mnist_tpu trace report /tmp/pdmt_trace_smoke
+	$(PY) -m pytorch_ddp_mnist_tpu trace report /tmp/pdmt_trace_smoke \
+		--baseline /tmp/pdmt_trace_smoke
+	$(PY) -m pytorch_ddp_mnist_tpu trace export /tmp/pdmt_trace_smoke \
+		-o /tmp/pdmt_trace_smoke/trace.chrome.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/pdmt_trace_smoke/trace.chrome.json')); \
+		assert d['traceEvents'], 'empty chrome trace'"
 
 native:
 	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native

@@ -410,13 +410,23 @@ def _emit_backend_error(e: Exception, tag: str = "backend_unavailable") -> None:
     """One machine-readable JSON line for a backend that never came up —
     the driver records it instead of a traceback (VERDICT r2 #1). `tag`
     distinguishes a hard outage from a wedged-client state (where the
-    backend is healthy and a plain rerun would succeed)."""
+    backend is healthy and a plain rerun would succeed).
+
+    The line also stamps `flight_recorder`: the path of the flight-recorder
+    dump (wireup's probe/retry loop records every probe outcome into the
+    bounded ring) — a failed hardware round is diagnosable from the JSON
+    alone instead of the opaque tails of BENCH_r01-r05. Null when nothing
+    was recorded (the failure predates the first probe) or the dump could
+    not be written."""
+    from pytorch_ddp_mnist_tpu.telemetry import flight
+    dump_path = flight.dump(reason=f"bench {tag}: {str(e)[:200]}")
     print(json.dumps({
         "metric": "mnist_train_images_per_sec_per_chip",
         "value": None,
         "unit": "images/sec/chip",
         "vs_baseline": None,
         "error": f"{tag}: {e}",
+        "flight_recorder": dump_path,
     }))
 
 

@@ -5,6 +5,8 @@ framework's executables (each also runs standalone as its own module):
                entry scripts behind one config surface)
     serve      micro-batching inference service from a checkpoint
                (cli/serve.py; TCP JSON-lines server or --selftest)
+    trace      analyze / regression-gate / Perfetto-export the JSONL
+               telemetry traces a --telemetry run emits (cli/trace.py)
     convert    IDX -> NetCDF converter (data/convert.py; the
                mnist_to_netcdf.ipynb workflow)
     download   mirrored, checksum-verified MNIST IDX fetch (data/download.py)
@@ -18,6 +20,8 @@ _COMMANDS = {
     "train": ("pytorch_ddp_mnist_tpu.cli.train", "the unified trainer"),
     "serve": ("pytorch_ddp_mnist_tpu.cli.serve",
               "micro-batching inference service"),
+    "trace": ("pytorch_ddp_mnist_tpu.cli.trace",
+              "telemetry trace report / regression gate / Perfetto export"),
     "convert": ("pytorch_ddp_mnist_tpu.data.convert",
                 "IDX -> NetCDF converter"),
     "download": ("pytorch_ddp_mnist_tpu.data.download", "MNIST IDX fetch"),

@@ -119,6 +119,14 @@ async def _serve_tcp(service, host: str, port: int) -> None:
     await service.shutdown()
     server.close()
     await server.wait_closed()
+    # Post-mortem: the admission reject ring (telemetry/flight.py) is only
+    # non-empty when this server refused requests — flush it so an
+    # overloaded-then-killed server leaves WHO it turned away, not just the
+    # aggregate counter in the final metrics snapshot.
+    from ..telemetry import flight
+    dump = flight.dump(reason="serve drain")
+    if dump:
+        print(f"flight recorder: {dump}", file=sys.stderr, flush=True)
 
 
 def main(argv=None) -> int:

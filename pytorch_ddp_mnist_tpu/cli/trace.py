@@ -1,0 +1,163 @@
+"""Trace CLI — read side of `--telemetry`: analyze, gate, and export the
+JSONL event traces training and serving emit.
+
+    python -m pytorch_ddp_mnist_tpu trace report /tmp/obs
+    python -m pytorch_ddp_mnist_tpu trace report /tmp/obs --json > new.json
+    python -m pytorch_ddp_mnist_tpu trace report /tmp/obs \
+        --baseline old_run/ --threshold 1.5      # exit 3 past threshold
+    python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
+                                                 # load in Perfetto
+
+`report` merges every per-process `events*.jsonl` under the target (a
+--telemetry dir, a single file, or several), reconstructs the span tree,
+and prints per-phase step-time statistics (data_wait / step_compute / eval /
+fused_run: p50/p95/max), the per-epoch trend, and cross-process straggler
+skew. `--baseline OLD` diffs against another run — OLD may be a trace
+dir/file or a saved `--json` report — and exits 3 when any phase's p50/p95
+regresses past `--threshold`x: the step-time regression gate bench.py and
+CI hang off (`make trace-smoke`).
+
+`export` renders the merged trace as Chrome trace-event JSON, loadable in
+Perfetto (https://ui.perfetto.dev) or `chrome://tracing`: one track per
+process, aggregate phase durations on their own thread, counter tracks from
+registry snapshots.
+
+Exit codes: 0 ok, 1 unreadable/empty target, 2 usage, 3 regression gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_report(target: str):
+    """A report dict from `target`: either a saved `trace report --json`
+    file (recognized by its "report" tag; the combined --baseline shape
+    `{"report": {...}, "comparison": ...}` unwraps to its report) or a
+    trace dir/file to analyze. Returns (report, error_message)."""
+    import os
+
+    from ..telemetry import analysis
+
+    paths = analysis.trace_files(target)
+    if os.path.isfile(target) and not target.endswith(".jsonl"):
+        # An explicitly named non-trace FILE may be a saved report (saved
+        # reports are small; never sniffed for dir targets, whose
+        # events*.jsonl can be large JSONL streams).
+        try:
+            with open(target) as f:
+                head = json.load(f)
+        except ValueError:
+            head = None  # not one JSON document: treat as a JSONL trace
+        if isinstance(head, dict):
+            if head.get("report") == "trace_phase_stats":
+                return head, None
+            nested = head.get("report")
+            if isinstance(nested, dict) \
+                    and nested.get("report") == "trace_phase_stats":
+                return nested, None  # a saved --baseline --json document
+    if not paths:
+        return None, f"{target}: no events*.jsonl found"
+    report = analysis.analyze(paths)
+    if report["records"] == 0:
+        return None, f"{target}: empty trace"
+    return report, None
+
+
+def _cmd_report(a) -> int:
+    from ..telemetry import analysis
+
+    report, err = _load_report(a.target)
+    if err:
+        print(f"trace report: {err}", file=sys.stderr)
+        return 1
+    if a.baseline:
+        baseline, err = _load_report(a.baseline)
+        if err:
+            print(f"trace report: baseline {err}", file=sys.stderr)
+            return 1
+        diff = analysis.compare(report, baseline, threshold=a.threshold)
+        if a.json:
+            print(json.dumps({"report": report, "comparison": diff},
+                             indent=2 if sys.stdout.isatty() else None))
+        else:
+            print(analysis.format_report(report))
+            print(analysis.format_compare(diff))
+        if not diff["rows"]:
+            # ZERO overlapping (phase, stat) rows means the gate compared
+            # nothing — renamed/dropped spans or a fused run against a
+            # non-fused baseline. A silent PASS here would let a real
+            # regression in the missing phase sail through CI.
+            print("trace report: no phase overlaps the baseline — the "
+                  "gate checked nothing (renamed spans? fused vs "
+                  "non-fused run?)", file=sys.stderr)
+            return 1
+        return 3 if diff["regressions"] else 0
+    if a.json:
+        print(json.dumps(report,
+                         indent=2 if sys.stdout.isatty() else None))
+    else:
+        print(analysis.format_report(report))
+    return 0
+
+
+def _cmd_export(a) -> int:
+    from ..telemetry import analysis, export
+
+    paths = analysis.trace_files(a.target)
+    if not paths:
+        print(f"trace export: {a.target}: no events*.jsonl found",
+              file=sys.stderr)
+        return 1
+    n = export.write_chrome_trace(paths, a.out)
+    if n == 0:
+        print(f"trace export: {a.target}: no timeline records",
+              file=sys.stderr)
+        return 1
+    print(f"trace export: wrote {n} event(s) from {len(paths)} file(s) to "
+          f"{a.out} (load in Perfetto or chrome://tracing)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="analyze / gate / export telemetry JSONL traces "
+                    "(see docs/OBSERVABILITY.md)")
+    sub = p.add_subparsers(dest="cmd", required=True, metavar="report|export")
+
+    r = sub.add_parser(
+        "report", help="per-phase p50/p95/max, epoch trend, straggler "
+                       "skew; --baseline gates step-time regressions")
+    r.add_argument("target",
+                   help="a --telemetry dir (merges every events*.jsonl), "
+                        "one trace file, or a saved --json report")
+    r.add_argument("--baseline", metavar="OLD", default=None,
+                   help="diff against another run (trace dir/file or saved "
+                        "--json report); exit 3 when any phase p50/p95 "
+                        "ratio exceeds --threshold")
+    r.add_argument("--threshold", type=float, default=1.5,
+                   help="regression gate ratio (default 1.5; the injected-"
+                        "2x acceptance trips it with margin)")
+    r.add_argument("--json", action="store_true",
+                   help="print the machine-readable report instead of the "
+                        "table (feed a saved copy back as --baseline)")
+    r.set_defaults(run=_cmd_report)
+
+    e = sub.add_parser(
+        "export", help="merged trace -> Chrome trace-event JSON "
+                       "(Perfetto / chrome://tracing)")
+    e.add_argument("target", help="a --telemetry dir or one trace file")
+    e.add_argument("-o", "--out", default="trace.chrome.json",
+                   help="output path (default ./trace.chrome.json)")
+    e.set_defaults(run=_cmd_export)
+
+    a = p.parse_args(argv)
+    if a.cmd == "report" and a.threshold <= 0:
+        p.error("--threshold must be > 0")
+    return a.run(a)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,10 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv,
                     _subprocess_backend_healthy(30.0):
                 raise
             attempt += 1
+            from ..telemetry import flight
+            flight.record("train_outage", attempt=attempt, retries=retries,
+                          epoch_stash=stash.get("epoch"),
+                          error=str(e)[:500])
             print(f"[outage] training interrupted mid-run: {e}; waiting for "
                   f"the backend (retry {attempt}/{retries})",
                   file=sys.stderr, flush=True)
@@ -153,6 +157,8 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv,
                 deadline = time.monotonic() + backend_wait_env(3600.0)
                 while not _subprocess_backend_healthy(45.0):
                     if time.monotonic() > deadline:
+                        flight.dump(reason="parallel train outage: backend "
+                                           "never recovered")
                         raise SystemExit(
                             "[outage] backend did not recover within the "
                             "wait budget after a mid-run interruption of "
@@ -171,6 +177,7 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv,
                     "backend recovered but this process's jax client is "
                     "wedged")
             except BackendUnavailableError as be:
+                flight.dump(reason="train outage: backend never recovered")
                 raise SystemExit(
                     f"[outage] backend did not recover within the wait "
                     f"budget after a mid-run interruption: {be}") from e
@@ -196,6 +203,14 @@ def main(argv=None) -> int:
     from .. import telemetry
     if tcfg["telemetry"]:
         telemetry.install_compile_listener()
+        # Post-mortems land beside the JSONL trace: the flight recorder
+        # (wireup probe/retry + serve reject ring) dumps into the telemetry
+        # dir on a fatal backend outage or a caller's SIGTERM, so a killed
+        # run leaves structured evidence next to its trace.
+        os.makedirs(tcfg["telemetry"], exist_ok=True)
+        telemetry.flight.set_dump_dir(tcfg["telemetry"])
+        if argv is None:  # CLI context: signal dispositions are ours to set
+            telemetry.flight.install_sigterm_flush()
         if not tcfg["parallel"]:
             # process_index=0 explicitly: a serial run IS process 0, and
             # resolving it via jax.process_index() here would be the first
@@ -552,7 +567,10 @@ def main(argv=None) -> int:
         eval_perm = lambda e: torch_randperm(n_test, tcfg["seed"] + e)  # noqa: E731
 
     from ..utils.logging import rank_zero_log
-    from ..utils.profiling import trace
+    # --profile: op-level jax.profiler capture, entered through the
+    # telemetry package's export surface (one front door from phase stats
+    # down to XPlane protos; same no-op-when-falsy contract as before).
+    from ..telemetry.export import profiler_trace as trace
     log = rank_zero_log(print)
     if tcfg["cached"]:
         # Epoch-scanned fast path: dataset resident in HBM, one jitted

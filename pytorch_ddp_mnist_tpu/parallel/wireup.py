@@ -364,8 +364,17 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
     import sys
     import time
 
+    # The flight recorder is the structured counterpart of the stderr
+    # progress lines below: every probe outcome lands in the bounded ring,
+    # so a terminal failure (or a caller's SIGTERM) can dump an exact
+    # post-mortem of what the retry loop saw — the evidence the opaque
+    # BENCH_r01-r05 `backend_unavailable` tails never carried.
+    from ..telemetry import flight
+
     if hang_timeout_s is None:
         hang_timeout_s = env_seconds("PDMT_HANG_TIMEOUT", 75.0)
+    flight.record("backend_wait_start", max_wait_s=max_wait_s,
+                  poll_s=poll_s, hang_timeout_s=hang_timeout_s)
     deadline = time.monotonic() + max_wait_s
     attempt = 0
     waiter = None  # wait_fn of an abandoned (possibly just slow) probe
@@ -377,14 +386,23 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
         else:
             status, payload = waiter(0.0)  # re-check the in-flight probe
         if status == "ok":
+            if attempt:  # only noteworthy when the backend was ever down
+                flight.record("backend_recovered", attempts=attempt,
+                              devices=len(payload))
             return payload
         if status == "fatal":
+            flight.record("backend_probe_fatal", error=str(payload)[:500])
             raise payload
         if status == "error":
             waiter = None
             attempt += 1
             remaining = deadline - time.monotonic()
+            flight.record("backend_probe_error", attempt=attempt,
+                          remaining_s=round(max(remaining, 0.0), 1),
+                          error=str(payload)[:500])
             if remaining <= 0:
+                flight.record("backend_unavailable", attempts=attempt,
+                              budget_s=max_wait_s)
                 raise BackendUnavailableError(
                     f"backend unavailable after {attempt} attempts over "
                     f"{max_wait_s:.0f}s: {payload}") from payload
@@ -405,25 +423,35 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
         if waiter is None:
             waiter = payload
             attempt += 1
+            flight.record("backend_probe_hang", attempt=attempt,
+                          hang_timeout_s=hang_timeout_s)
             print(f"wireup: backend probe hung for {hang_timeout_s:.0f}s "
                   f"(no error to retry on); polling health out-of-process",
                   file=sys.stderr, flush=True)
         remaining = deadline - time.monotonic()
         if remaining <= 0:
+            flight.record("backend_unavailable", attempts=attempt,
+                          budget_s=max_wait_s, mode="hang")
             raise BackendUnavailableError(
                 f"backend probe hung (> {hang_timeout_s:.0f}s without "
                 f"returning or raising) and out-of-process probes stayed "
                 f"unhealthy for the rest of the {max_wait_s:.0f}s budget")
-        if _subprocess_backend_healthy(min(hang_timeout_s, remaining)):
+        healthy = _subprocess_backend_healthy(min(hang_timeout_s, remaining))
+        flight.record("backend_health_poll", healthy=healthy,
+                      remaining_s=round(max(remaining, 0.0), 1))
+        if healthy:
             # Backend answers from a fresh process. Give the in-flight init
             # one more bounded join — a slow-but-healthy init lands here.
             status, payload = waiter(
                 min(hang_timeout_s, max(deadline - time.monotonic(), 1.0)))
             if status == "ok":
+                flight.record("backend_recovered", attempts=attempt,
+                              devices=len(payload), mode="late_init")
                 return payload
             if status in ("error", "fatal"):
                 waiter = None  # init failed late; lock released — re-probe
                 continue
+            flight.record("backend_wedged", attempts=attempt)
             raise BackendWedgedError(
                 "backend is healthy again but this process's jax client is "
                 "wedged: an earlier jax.devices() probe hung inside backend "

@@ -20,6 +20,8 @@ from __future__ import annotations
 import asyncio
 from typing import Optional
 
+from ..telemetry import flight
+
 
 class Rejected(Exception):
     """Request refused by admission control; `retry_after_s` is the hint a
@@ -44,13 +46,24 @@ class AdmissionController:
         self._empty: Optional[asyncio.Event] = None
 
     def admit(self) -> None:
-        """Take one slot or raise Rejected. Pair with `release()`."""
+        """Take one slot or raise Rejected. Pair with `release()`.
+
+        Both reject branches feed the flight recorder (bounded ring, no
+        I/O): a drained or overloaded server that later dies leaves WHICH
+        requests it was refusing, and why, in the post-mortem dump —
+        aggregate reject counts live in the metrics registry, the recorder
+        keeps the most recent individual refusals."""
         if self.draining:
             self.rejected += 1
+            flight.record("serve_reject", reason="draining",
+                          depth=self.depth, rejected_total=self.rejected)
             raise Rejected("draining: server is shutting down",
                            self.retry_after_s)
         if self.depth >= self.max_depth:
             self.rejected += 1
+            flight.record("serve_reject", reason="queue_full",
+                          depth=self.depth, max_depth=self.max_depth,
+                          rejected_total=self.rejected)
             raise Rejected(
                 f"queue depth {self.depth} at budget {self.max_depth}",
                 self.retry_after_s)

@@ -19,11 +19,24 @@ shared by train, serve, and bench alike:
     `utils.logging.rank_zero_log`), XLA compile counts via `jax.monitoring`
     (engine-probe fallback), device `memory_stats()` guarded for CPU, host
     RSS.
+  * `analysis.py`  — the READ side: load one or many per-process JSONL
+    traces, reconstruct the span tree (structural validation shared with
+    `scripts/check_telemetry.py`), per-phase p50/p95/max, per-epoch trend,
+    cross-process straggler skew, and the baseline-diff regression gate.
+  * `export.py`    — merged trace -> Chrome trace-event JSON (Perfetto /
+    `chrome://tracing`: one track per process, counter tracks from registry
+    snapshots); `profiler_trace` is the op-level jax.profiler hatch.
+  * `flight.py`    — bounded ring-buffer flight recorder fed by
+    `parallel/wireup.py`'s probe/retry loop and `serve/admission.py`'s
+    reject path; dumped to disk on failure/SIGTERM, stamped into bench
+    `backend_unavailable` artifacts.
 
 Front doors: `cli/train.py --telemetry DIR` (JSONL + rank-0 end-of-run
-summary), `cli/serve.py`'s `{"op": "stats"}` TCP op (live registry
-snapshot), `bench.py` artifact stamps, `make obs-smoke` +
-`scripts/check_telemetry.py` (schema validation). See docs/OBSERVABILITY.md.
+summary), `python -m pytorch_ddp_mnist_tpu trace report|export` (analysis +
+Perfetto export + regression gate), `cli/serve.py`'s `{"op": "stats"}` TCP
+op (live registry snapshot), `bench.py` artifact stamps, `make obs-smoke` /
+`make trace-smoke` + `scripts/check_telemetry.py` (schema + span-structure
+validation). See docs/OBSERVABILITY.md.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
@@ -33,3 +46,8 @@ from .events import (SCHEMA_VERSION, EventTrace, NullTracer,  # noqa: F401
 from .runtime import (collect_memory, device_memory_stats,  # noqa: F401
                       host_rss_bytes, install_compile_listener,
                       process_index_cached, record_engine_compiles)
+from .analysis import (analyze, compare, load_trace,  # noqa: F401
+                       span_structure_errors, trace_files)
+from .export import chrome_trace, profiler_trace, write_chrome_trace  # noqa: F401
+from .flight import (FlightRecorder, get_flight_recorder)  # noqa: F401
+from . import flight  # noqa: F401

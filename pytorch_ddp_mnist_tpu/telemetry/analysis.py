@@ -1,0 +1,455 @@
+"""Trace analysis: span-tree reconstruction, per-phase statistics, and the
+step-time regression gate over schema-v1 JSONL traces (telemetry/events.py).
+
+PR 2 made every train epoch and serve request emit spans; this module is the
+read side that turns those write-only files into a signal:
+
+  * `load_trace` / `load_traces` — parse one or many `events*.jsonl` files
+    (one per process index: a real N-process run writes `events.jsonl` +
+    `events.rank{N}.jsonl` siblings).
+  * `split_segments` / `span_structure_errors` — the span-tree
+    reconstructor, shared with `scripts/check_telemetry.py`: one segment
+    per `trace_start` record, spans resolved by id, with structural
+    validation (orphaned parents, duplicate ids, enter/exit stamp
+    consistency, child intervals crossing their parent's).
+  * `analyze` — the machine-readable report: per-phase step-time
+    statistics (data_wait / step_compute / eval / fused_run: p50/p95/max),
+    per-epoch trend, and straggler skew across processes, aligned on wall
+    clock (each record carries both t_wall and t_mono, so the per-process
+    offset is observable from the file alone).
+  * `compare` — diff two reports' phase statistics; `cli/trace.py` turns a
+    past-threshold ratio into a nonzero exit, giving bench.py and CI a
+    step-time regression gate.
+
+Pure stdlib, no jax import, by the same contract as the checker: analysis
+must run wherever the trace lands, including hosts without the framework
+installed (the checker file-loads this module to stay framework-free).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+# Span names carrying the per-phase step-time story (train/loop.py,
+# train/scan.py emit exactly these; serve spans would join by name).
+PHASES = ("data_wait", "step_compute", "eval", "fused_run")
+# Containment tolerance: both stamps come from the same perf_counter, but a
+# parent's duration is computed a few instructions after its child's, so
+# exact float equality is not guaranteed at the boundary.
+_EPS = 1e-6
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def trace_files(target: str) -> List[str]:
+    """Resolve a --telemetry dir (every `events*.jsonl` inside) or a single
+    trace file to a sorted list of paths. Missing target -> []."""
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "events*.jsonl")))
+    return [target] if os.path.exists(target) else []
+
+
+def load_trace(path: str) -> Tuple[List[dict], List[str]]:
+    """Parse one JSONL trace file -> (records, errors). Lenient: malformed
+    lines become errors, not exceptions — a crashed run's torn last line
+    must not hide the rest of the trace. Each record gains `_line` (1-based
+    line number) and `_file` for error attribution."""
+    records: List[dict] = []
+    errors: List[str] = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"{path}:{line_no}: malformed JSON ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"{path}:{line_no}: record is not an object")
+                continue
+            rec["_line"] = line_no
+            rec["_file"] = path
+            records.append(rec)
+    return records, errors
+
+
+def load_traces(paths: List[str]) -> Tuple[List[dict], List[str]]:
+    """Concatenate several per-process trace files (order preserved within
+    each file; files are independent streams, never interleaved)."""
+    records: List[dict] = []
+    errors: List[str] = []
+    for p in paths:
+        recs, errs = load_trace(p)
+        records.extend(recs)
+        errors.extend(errs)
+    return records, errors
+
+
+# ---------------------------------------------------------------------------
+# span-tree reconstruction (shared with scripts/check_telemetry.py)
+# ---------------------------------------------------------------------------
+
+def split_segments(records: List[dict]) -> List[List[dict]]:
+    """One ONE-FILE record stream -> run segments. Files open in append
+    mode, so an outage-resume re-exec or repeat run adds a segment beginning
+    with a fresh `trace_start` meta record; span ids and the monotonic clock
+    reset per segment."""
+    segments: List[List[dict]] = []
+    current: List[dict] = []
+    for rec in records:
+        if rec.get("kind") == "meta" and rec.get("name") == "trace_start":
+            if current:
+                segments.append(current)
+            current = []
+        current.append(rec)
+    if current:
+        segments.append(current)
+    return segments
+
+
+def _span_interval(rec: dict) -> Optional[Tuple[float, float]]:
+    """A LIVE span's [t0, t0+dur] monotonic interval, from the start stamp
+    `_Span._finish` stores in attrs. Aggregate spans (`complete_span`: a
+    duration measured elsewhere, no start stamp) have no interval."""
+    attrs = rec.get("attrs") or {}
+    t0 = attrs.get("t0_mono")
+    dur = rec.get("dur_s")
+    if isinstance(t0, (int, float)) and isinstance(dur, (int, float)):
+        return float(t0), float(t0) + float(dur)
+    return None
+
+
+def span_structure_errors(segment: List[dict]) -> List[Tuple[int, str]]:
+    """Structural violations of ONE segment's span records, as
+    (line_no, message) pairs — the span-tree reconstructor the checker and
+    `analyze` share. Checks:
+
+      * orphaned parents — a span's `parent` id never recorded in the
+        segment (parents close AFTER children, so ids resolve against the
+        whole segment);
+      * duplicate span ids — the writer's counter is unique per segment, a
+        repeat means interleaved writers or a corrupted file;
+      * enter/exit consistency — a live span's exit (t0_mono + dur_s) must
+        not land after its emission stamp: every recorded exit must match
+        a real enter (negative durations are a field-level violation the
+        checker's schema pass owns);
+      * crossing spans — a live child's interval must sit inside its live
+        parent's (strict nesting is what the writer's stack guarantees;
+        a violation means ids were reused or clocks mixed).
+    """
+    spans: Dict[object, dict] = {}
+    errors: List[Tuple[int, str]] = []
+    for rec in segment:
+        if rec.get("kind") != "span" or "span" not in rec:
+            continue
+        sid, line = rec["span"], rec.get("_line", 0)
+        if sid in spans:
+            errors.append((line, f"duplicate span id {sid} in segment"))
+            continue
+        spans[sid] = rec
+        iv = _span_interval(rec)
+        if iv is not None:
+            t0, t1 = iv
+            t_emit = rec.get("t_mono")
+            # (a negative dur_s is a FIELD-level violation, owned by the
+            # checker's per-line schema pass — flagging it here too would
+            # double-count one defect)
+            if (isinstance(t_emit, (int, float))
+                    and t1 > float(t_emit) + _EPS):
+                errors.append((line, f"span {sid} exit (t0_mono + dur_s = "
+                                     f"{t1:.6f}) is after its emission "
+                                     f"stamp {float(t_emit):.6f} — no "
+                                     f"matching enter for this exit"))
+    for sid, rec in spans.items():
+        parent = rec.get("parent")
+        if parent is None:
+            continue
+        line = rec.get("_line", 0)
+        if parent not in spans:
+            errors.append((line, f"parent span {parent} never recorded"))
+            continue
+        child_iv, parent_iv = _span_interval(rec), _span_interval(spans[parent])
+        if child_iv is None or parent_iv is None:
+            continue  # aggregate durations have no interval to contain
+        (c0, c1), (p0, p1) = child_iv, parent_iv
+        if c0 < p0 - _EPS or c1 > p1 + _EPS:
+            errors.append((line, f"span {sid} [{c0:.6f}, {c1:.6f}] crosses "
+                                 f"its parent {parent} [{p0:.6f}, {p1:.6f}]"))
+    errors.sort(key=lambda e: e[0])
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# statistics
+# ---------------------------------------------------------------------------
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list; 0.0 when empty.
+    Exact for the sample (no bucketing — the trace holds every duration)."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(1, math.ceil(q * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def _stats(vals: List[float]) -> dict:
+    s = sorted(vals)
+    return {
+        "n": len(s),
+        "p50_s": _percentile(s, 0.50),
+        "p95_s": _percentile(s, 0.95),
+        "max_s": s[-1] if s else 0.0,
+        "mean_s": (sum(s) / len(s)) if s else 0.0,
+        "total_s": sum(s),
+    }
+
+
+def clock_offset(records: List[dict]) -> float:
+    """This stream's wall = mono + offset. Every record carries both stamps,
+    so the offset is the median of their differences — robust to the few
+    records delayed between the two clock reads (e.g. under a paging
+    stall)."""
+    diffs = sorted(float(r["t_wall"]) - float(r["t_mono"]) for r in records
+                   if isinstance(r.get("t_wall"), (int, float))
+                   and isinstance(r.get("t_mono"), (int, float)))
+    return diffs[len(diffs) // 2] if diffs else 0.0
+
+
+def _linear_trend_pct(values: List[float]) -> Optional[float]:
+    """Least-squares slope of `values` over their index, as percent of the
+    mean per step — the per-epoch drift signal (positive = getting slower).
+    None with fewer than 2 points or a zero mean."""
+    n = len(values)
+    if n < 2:
+        return None
+    mean = sum(values) / n
+    if mean <= 0:
+        return None
+    xbar = (n - 1) / 2
+    num = sum((i - xbar) * (v - mean) for i, v in enumerate(values))
+    den = sum((i - xbar) ** 2 for i in range(n))
+    return 100.0 * (num / den) / mean
+
+
+def analyze(paths: List[str]) -> dict:
+    """One or many per-process trace files -> the machine-readable report.
+
+    Phase statistics pool every process's spans (a straggler's slow steps
+    belong in the distribution); the straggler section then separates the
+    processes back out, comparing per-epoch durations and wall-aligned
+    start times across ranks."""
+    records, parse_errors = load_traces(paths)
+    span_errors = list(parse_errors)
+    # name -> [dur], pooled across processes
+    phase_durs: Dict[str, List[float]] = {name: [] for name in PHASES}
+    # (segment_ordinal, proc, epoch) -> dur / aligned wall start, from the
+    # epoch spans. The segment ordinal keeps appended runs apart: a repeat
+    # run re-emits epochs 0..N into the same file, and collapsing them to
+    # (proc, epoch) would silently last-wins-overwrite the first run.
+    epoch_dur: Dict[Tuple[int, int, int], float] = {}
+    epoch_start: Dict[Tuple[int, int, int], float] = {}
+    procs = set()
+    snapshots = 0
+
+    by_file: Dict[str, List[dict]] = {}
+    for rec in records:
+        by_file.setdefault(rec["_file"], []).append(rec)
+
+    for path, recs in by_file.items():
+        for seg_idx, seg in enumerate(split_segments(recs)):
+            # Offset per SEGMENT, not per file: the monotonic clock resets
+            # across the re-exec/reboot that starts an appended segment, so
+            # one file-wide median would misalign whichever segment has
+            # fewer records by the whole outage gap.
+            off = clock_offset(seg)
+            span_errors.extend(
+                f"{path}:{line}: {msg}"
+                for line, msg in span_structure_errors(seg))
+            for rec in seg:
+                proc = rec.get("proc", 0)
+                procs.add(proc)
+                kind = rec.get("kind")
+                if kind == "snapshot":
+                    snapshots += 1
+                if kind != "span":
+                    continue
+                dur = rec.get("dur_s")
+                if not isinstance(dur, (int, float)):
+                    continue
+                name = rec.get("name")
+                if name in phase_durs:
+                    phase_durs[name].append(float(dur))
+                if name in ("epoch", "fused_run"):
+                    attrs = rec.get("attrs") or {}
+                    epoch = attrs.get("epoch", 0)
+                    if not isinstance(epoch, int):
+                        continue
+                    key = (seg_idx, proc, epoch)
+                    epoch_dur[key] = float(dur)
+                    iv = _span_interval(rec)
+                    if iv is not None:
+                        epoch_start[key] = iv[0] + off
+
+    phases = {name: _stats(durs)
+              for name, durs in phase_durs.items() if durs}
+
+    # per-epoch trend: mean duration across processes, in run order
+    # (segment ordinal first — an appended repeat run's epochs follow the
+    # first run's, they do not merge with them)
+    epoch_ids = sorted({(s, e) for (s, _p, e) in epoch_dur})
+    per_epoch_mean = []
+    for s, e in epoch_ids:
+        durs = [d for (ss, _p, ee), d in epoch_dur.items()
+                if (ss, ee) == (s, e)]
+        per_epoch_mean.append(sum(durs) / len(durs))
+    epochs = {
+        "count": len(epoch_ids),
+        "mean_s": (sum(per_epoch_mean) / len(per_epoch_mean)
+                   if per_epoch_mean else 0.0),
+        "durations_s": per_epoch_mean,
+        "trend_pct_per_epoch": _linear_trend_pct(per_epoch_mean),
+    }
+
+    # straggler skew: same epoch, different processes
+    straggler = {"processes": len(procs), "epochs_compared": 0,
+                 "max_skew_s": 0.0, "max_skew_pct": 0.0,
+                 "mean_skew_pct": 0.0, "max_start_spread_s": 0.0,
+                 "worst_epoch": None}
+    skew_pcts = []
+    for s, e in epoch_ids:
+        durs = {p: d for (ss, p, ee), d in epoch_dur.items()
+                if (ss, ee) == (s, e)}
+        if len(durs) < 2:
+            continue
+        straggler["epochs_compared"] += 1
+        lo, hi = min(durs.values()), max(durs.values())
+        mean = sum(durs.values()) / len(durs)
+        skew_s = hi - lo
+        skew_pct = 100.0 * skew_s / mean if mean > 0 else 0.0
+        skew_pcts.append(skew_pct)
+        if skew_s > straggler["max_skew_s"]:
+            straggler.update(max_skew_s=skew_s, max_skew_pct=skew_pct,
+                             worst_epoch={"epoch": e, "segment": s,
+                                          "dur_s_by_proc": {str(p): d
+                                                            for p, d
+                                                            in sorted(
+                                                                durs.items())}})
+        starts = [epoch_start[(s, p, e)] for p in durs
+                  if (s, p, e) in epoch_start]
+        if len(starts) >= 2:
+            straggler["max_start_spread_s"] = max(
+                straggler["max_start_spread_s"], max(starts) - min(starts))
+    if skew_pcts:
+        straggler["mean_skew_pct"] = sum(skew_pcts) / len(skew_pcts)
+
+    return {
+        "report": "trace_phase_stats",
+        "v": 1,
+        "files": sorted(by_file),
+        "processes": sorted(procs),
+        "n_processes": len(procs),
+        "records": len(records),
+        "snapshots": snapshots,
+        "span_errors": span_errors,
+        "phases": phases,
+        "epochs": epochs,
+        "straggler": straggler,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+# ---------------------------------------------------------------------------
+
+def compare(new: dict, baseline: dict, threshold: float = 1.5,
+            stats: Tuple[str, ...] = ("p50_s", "p95_s")) -> dict:
+    """Diff two reports' phase statistics -> {"rows": [...], "regressions":
+    [...]}. A row per (phase, stat) present in both reports; a regression is
+    a ratio past `threshold` (new/old > threshold means SLOWER). Tiny
+    absolute values are not gated (< 1 ms both sides): at that scale the
+    ratio measures scheduler noise, not the workload."""
+    rows, regressions = [], []
+    for phase in sorted(set(new.get("phases", {}))
+                        & set(baseline.get("phases", {}))):
+        for stat in stats:
+            old_v = baseline["phases"][phase].get(stat)
+            new_v = new["phases"][phase].get(stat)
+            if not (isinstance(old_v, (int, float))
+                    and isinstance(new_v, (int, float)) and old_v > 0):
+                continue
+            ratio = new_v / old_v
+            row = {"phase": phase, "stat": stat, "baseline_s": old_v,
+                   "new_s": new_v, "ratio": ratio,
+                   "regressed": (ratio > threshold
+                                 and max(old_v, new_v) >= 1e-3)}
+            rows.append(row)
+            if row["regressed"]:
+                regressions.append(row)
+    return {"threshold": threshold, "rows": rows, "regressions": regressions}
+
+
+def format_report(report: dict) -> str:
+    """The human rendering of `analyze`'s dict (the --json flag prints the
+    dict itself)."""
+    lines = [f"trace report: {report['n_processes']} process(es), "
+             f"{len(report['files'])} file(s), {report['records']} "
+             f"record(s)"]
+    if report["phases"]:
+        lines.append(f"{'phase':<14} {'n':>6} {'p50_s':>10} {'p95_s':>10} "
+                     f"{'max_s':>10} {'total_s':>10}")
+        for name in PHASES:
+            st = report["phases"].get(name)
+            if st:
+                lines.append(f"{name:<14} {st['n']:>6} {st['p50_s']:>10.4f} "
+                             f"{st['p95_s']:>10.4f} {st['max_s']:>10.4f} "
+                             f"{st['total_s']:>10.4f}")
+    else:
+        lines.append("no phase spans found (not a --telemetry train trace?)")
+    ep = report["epochs"]
+    if ep["count"]:
+        trend = ep["trend_pct_per_epoch"]
+        trend_txt = (f", trend {trend:+.1f}%/epoch" if trend is not None
+                     else "")
+        lines.append(f"epochs: {ep['count']} "
+                     f"(mean {ep['mean_s']:.4f}s{trend_txt})")
+    st = report["straggler"]
+    if st["epochs_compared"]:
+        worst = st["worst_epoch"]
+        lines.append(f"straggler skew: max {st['max_skew_s']:.4f}s "
+                     f"({st['max_skew_pct']:.1f}% of epoch mean) at epoch "
+                     f"{worst['epoch']}; mean {st['mean_skew_pct']:.1f}%; "
+                     f"start spread {st['max_start_spread_s']:.4f}s")
+    elif st["processes"] > 1:
+        lines.append("straggler skew: no epoch seen on 2+ processes")
+    else:
+        lines.append("straggler skew: single process (nothing to compare)")
+    if report["span_errors"]:
+        lines.append(f"span structure: {len(report['span_errors'])} "
+                     f"violation(s) — run scripts/check_telemetry.py")
+    else:
+        lines.append("span structure: OK")
+    return "\n".join(lines)
+
+
+def format_compare(diff: dict) -> str:
+    lines = [f"baseline comparison (gate: ratio > {diff['threshold']:g}x "
+             f"on p50/p95):"]
+    for row in diff["rows"]:
+        verdict = "REGRESSION" if row["regressed"] else "ok"
+        lines.append(f"  {row['phase']:<14} {row['stat']:<6} "
+                     f"{row['baseline_s']:.4f}s -> {row['new_s']:.4f}s  "
+                     f"({row['ratio']:.2f}x)  {verdict}")
+    if not diff["rows"]:
+        lines.append("  (no phase overlaps baseline — nothing gated)")
+    n = len(diff["regressions"])
+    verdict = f"FAIL — {n} phase stat(s) past threshold" if n else "PASS"
+    lines.append(f"regression gate: {verdict}")
+    return "\n".join(lines)

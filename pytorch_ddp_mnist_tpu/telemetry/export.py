@@ -1,0 +1,157 @@
+"""Trace export: schema-v1 JSONL -> Chrome trace-event JSON (Perfetto /
+`chrome://tracing` loadable), plus the op-level profiler capture hook.
+
+The JSONL trace is line-oriented for tools; humans want a timeline. This
+module renders a merged multi-process trace as one Chrome trace-event file:
+
+  * one track (pid) per process index, named in metadata events;
+  * live spans (`with trace.span(...)`) become complete `X` events at their
+    true start stamps; aggregate spans (`complete_span`: per-epoch
+    data_wait / step_compute totals measured elsewhere) land on a separate
+    `aggregates` thread so they cannot visually shadow the real timeline;
+  * `point` records become instant `i` events;
+  * registry `snapshot` records become counter `C` tracks (counters and
+    numeric gauges — e.g. `xla.compiles`, `host.rss_bytes` over time);
+  * processes are aligned on WALL clock: every record carries t_wall and
+    t_mono, so each stream's mono->wall offset is observable from the file
+    alone (analysis.clock_offset), and cross-process skew shows up as real
+    offset between tracks, not an artifact.
+
+Timestamps are microseconds from the earliest aligned event, the
+trace-event format's native unit.
+
+`profiler_trace` is the op-level escalation hatch: it wraps a block in
+`jax.profiler.trace` (XPlane protos for TensorBoard/XProf) — the microscope
+`cli/train.py --profile DIR` points at one run after `trace report` has
+found the slow phase cheaply on every run. Everything else here is pure
+stdlib (jax is imported only inside `profiler_trace`), so export runs on
+hosts without the framework's backend installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+from typing import List, Optional
+
+from .analysis import (clock_offset, load_traces, split_segments,
+                       _span_interval)
+
+# Thread ids within each process track: the real span timeline, the
+# per-epoch aggregate durations, and instants/counters ride on spans' tid.
+_TID_SPANS = 0
+_TID_AGGREGATES = 1
+
+
+def _scale_us(seconds: float) -> float:
+    return round(seconds * 1e6, 3)
+
+
+def chrome_trace(paths: List[str]) -> dict:
+    """Merge per-process JSONL trace files into one Chrome trace-event
+    object: `{"traceEvents": [...], "displayTimeUnit": "ms"}`."""
+    records, _errors = load_traces(paths)
+    by_file: dict = {}
+    for rec in records:
+        by_file.setdefault(rec["_file"], []).append(rec)
+
+    # Pass 1: per-stream wall alignment and the global origin. Offsets are
+    # per SEGMENT (the monotonic clock resets across the re-exec that
+    # starts an appended segment — a file-wide median would throw one
+    # segment's events off by the whole outage gap). A span's visible
+    # start is its t0 stamp when live, else emission minus duration (an
+    # aggregate's duration accumulated up to its emission point).
+    aligned = []  # (start_wall_s, rec)
+    for recs in by_file.values():
+        for seg in split_segments(recs):
+            off = clock_offset(seg)
+            for rec in seg:
+                kind = rec.get("kind")
+                t_mono = rec.get("t_mono")
+                has_mono = isinstance(t_mono, (int, float))
+                if kind == "span":
+                    iv = _span_interval(rec)
+                    if iv is not None:
+                        start = iv[0] + off
+                    else:
+                        dur = rec.get("dur_s")
+                        if not (isinstance(dur, (int, float)) and has_mono):
+                            continue  # torn/foreign record: skip, not crash
+                        start = float(t_mono) - float(dur) + off
+                elif kind in ("point", "snapshot") and has_mono:
+                    start = float(t_mono) + off
+                else:  # meta records / stamp-less records: no timeline
+                    continue
+                aligned.append((start, rec))
+    if not aligned:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t_base = min(start for start, _rec in aligned)
+
+    events: List[dict] = []
+    named_pids = set()
+    for start, rec in sorted(aligned, key=lambda it: it[0]):
+        pid = int(rec.get("proc", 0))
+        if pid not in named_pids:
+            named_pids.add(pid)
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": _TID_SPANS,
+                           "args": {"name": f"process {pid}"}})
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": _TID_SPANS, "args": {"name": "spans"}})
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": _TID_AGGREGATES,
+                           "args": {"name": "aggregates"}})
+        ts = _scale_us(start - t_base)
+        kind = rec.get("kind")
+        if kind == "span":
+            live = _span_interval(rec) is not None
+            attrs = {k: v for k, v in (rec.get("attrs") or {}).items()
+                     if k not in ("t0_mono", "t0_wall")}
+            events.append({
+                "ph": "X", "name": rec.get("name", "span"),
+                "cat": "span" if live else "aggregate",
+                "ts": ts, "dur": _scale_us(float(rec["dur_s"])),
+                "pid": pid,
+                "tid": _TID_SPANS if live else _TID_AGGREGATES,
+                "args": attrs,
+            })
+        elif kind == "point":
+            events.append({"ph": "i", "name": rec.get("name", "point"),
+                           "cat": "point", "ts": ts, "pid": pid,
+                           "tid": _TID_SPANS, "s": "t",
+                           "args": rec.get("attrs") or {}})
+        elif kind == "snapshot":
+            snap = rec.get("attrs") or {}
+            for table in ("counters", "gauges"):
+                for metric, value in sorted((snap.get(table) or {}).items()):
+                    if isinstance(value, (int, float)):
+                        events.append({"ph": "C", "name": metric,
+                                       "cat": "registry", "ts": ts,
+                                       "pid": pid, "tid": _TID_SPANS,
+                                       "args": {"value": value}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"source": "pytorch_ddp_mnist_tpu telemetry "
+                                    "schema v1",
+                          "files": sorted(by_file)}}
+
+
+def write_chrome_trace(paths: List[str], out_path: str) -> int:
+    """Render `paths` and write the trace-event JSON to `out_path`;
+    returns the event count."""
+    trace = chrome_trace(paths)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    return len(trace["traceEvents"])
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: Optional[str]):
+    """Op-level capture: wrap a block in `jax.profiler.trace(logdir)`
+    (no-op when logdir is falsy). Delegates to `utils.profiling.trace` —
+    re-exported here so the telemetry package is the one front door from
+    phase stats down to XPlane protos; `cli/train.py --profile` enters
+    through this name."""
+    from ..utils.profiling import trace
+    with trace(logdir):
+        yield

@@ -1,0 +1,183 @@
+"""Flight recorder: a bounded ring buffer of recent wireup/serve events,
+flushed to disk on failure so a dead backend leaves a structured
+post-mortem instead of a log tail.
+
+Every hardware bench round that died so far (BENCH_r01-r05) ended in an
+opaque `backend_unavailable` line: the probe/retry loop in
+`parallel/wireup.py` printed its progress to stderr, which the artifact
+never captured. The recorder closes that gap without becoming a logger:
+
+  * `record(kind, **fields)` appends one timestamped entry to a
+    fixed-capacity deque — constant memory at any rate, the oldest entries
+    drop first (with an exact `dropped` count), and nothing touches disk
+    on the happy path;
+  * producers are the paths that only matter when things go wrong:
+    `wait_for_backend`'s probe/retry loop (every error, hang, health poll
+    and recovery) and `serve/admission.py`'s reject path;
+  * `dump(reason)` flushes the ring as one JSON file — into the configured
+    dump dir (`set_dump_dir`, wired to `--telemetry DIR` by cli/train),
+    else `$PDMT_FLIGHT_DIR`, else the system temp dir — and returns the
+    path, which `bench.py` stamps into its `backend_unavailable` artifact
+    line so failed rounds are diagnosable from the JSON alone;
+  * `install_sigterm_flush()` chains a dump in front of the existing
+    SIGTERM disposition, so a caller-killed run (the bench driver's
+    timeout pattern) still leaves the post-mortem.
+
+Dumping is deliberately infallible-by-contract: any write failure returns
+None rather than raising — the recorder must never turn a primary failure
+into a secondary crash. Pure stdlib; safe to import from anywhere
+(including `parallel/wireup.py`, which must not pull jax at import time).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+DEFAULT_CAPACITY = 256
+_SCHEMA = 1
+
+
+class FlightRecorder:
+    """The ring. One per process (module-level singleton below); thread-safe
+    — probe threads, the asyncio serve loop, and signal handlers all
+    record."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        self.capacity = int(capacity)
+        self._entries: "collections.deque[dict]" = collections.deque(
+            maxlen=self.capacity)
+        # RLock, not Lock: a SIGTERM handler dumps the ring from the main
+        # thread, and the signal can land while that same thread is inside
+        # record()'s critical section — a non-reentrant lock would deadlock
+        # the dying process instead of writing its post-mortem.
+        self._lock = threading.RLock()
+        self._recorded = 0  # total ever recorded (dropped = this - len)
+        self.dump_dir: Optional[str] = None
+
+    def record(self, kind: str, **fields) -> None:
+        entry = {"t_wall": time.time(), "t_mono": time.perf_counter(),
+                 "kind": str(kind)}
+        entry.update(fields)
+        with self._lock:
+            entry["seq"] = self._recorded
+            self._recorded += 1
+            self._entries.append(entry)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    @property
+    def recorded(self) -> int:
+        return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._recorded - len(self._entries)
+
+    def _resolve_dir(self) -> str:
+        return (self.dump_dir or os.environ.get("PDMT_FLIGHT_DIR")
+                or tempfile.gettempdir())
+
+    def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Flush the ring to `path` (default: `flight.<pid>.json` under the
+        resolved dump dir) and return the written path; None when nothing
+        was ever recorded or the write fails (a post-mortem writer must
+        never crash the path that is already failing). Atomic via
+        write-then-replace: a reader (the bench driver following the
+        artifact stamp) never sees a torn file."""
+        entries = self.snapshot()
+        if not entries:
+            return None
+        payload = {
+            "v": _SCHEMA,
+            "reason": str(reason),
+            "pid": os.getpid(),
+            "dumped_t_wall": time.time(),
+            "recorded": self._recorded,
+            "dropped": self._recorded - len(entries),
+            "entries": entries,
+        }
+        try:
+            if path is None:
+                out_dir = self._resolve_dir()
+                os.makedirs(out_dir, exist_ok=True)
+                path = os.path.join(out_dir, f"flight.{os.getpid()}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record(kind: str, **fields) -> None:
+    """Append one entry to the process-wide ring (constant cost, no I/O)."""
+    _RECORDER.record(kind, **fields)
+
+
+def set_dump_dir(path: Optional[str]) -> None:
+    """Route dumps next to the JSONL trace (cli/train wires `--telemetry
+    DIR` here, so the post-mortem lands with the run's other evidence)."""
+    _RECORDER.dump_dir = path
+
+
+def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
+    return _RECORDER.dump(reason, path)
+
+
+_sigterm_installed = False
+
+
+def install_sigterm_flush() -> bool:
+    """Chain a flight dump in front of the current SIGTERM disposition.
+    Returns False (and installs nothing) off the main thread or where
+    signals are unsupported; repeat installs are no-ops (one chain link,
+    never a loop)."""
+    global _sigterm_installed
+    if _sigterm_installed:
+        return True
+
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _flush_and_chain(signum, frame):
+            _RECORDER.dump(reason="SIGTERM")
+            if callable(prev):
+                prev(signum, frame)
+            elif prev is signal.SIG_IGN:
+                # the run was launched ignoring SIGTERM (supervisor
+                # choice): preserve that — dump, keep living
+                return
+            else:
+                # SIG_DFL (or an unknowable non-Python handler, prev is
+                # None): restore the default disposition and re-deliver,
+                # so the process still dies by SIGTERM (exit status
+                # intact)
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _flush_and_chain)
+    except (ValueError, OSError):  # non-main thread / unsupported platform
+        return False
+    _sigterm_installed = True
+    return True

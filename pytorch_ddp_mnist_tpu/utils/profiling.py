@@ -8,7 +8,14 @@ This module restores that capability the TPU way:
   * `Timer` / `CumulativeTimer` — wall-clock timing that understands XLA's
     async dispatch: on device work, a naive `time.time()` pair measures only
     enqueue time, so timers take an optional pytree to `block_until_ready` on
-    exit.
+    exit. Both take an optional `registry=` (telemetry.MetricsRegistry):
+    every measured section then ALSO lands in the unified
+    `timer.{name}_s` histogram — percentiles, snapshot export, and bench
+    artifact stamps for free. The standalone `.seconds`/`.total`/`.count`
+    attributes remain for callers that hold the timer object, but the
+    registry hook is the preferred export path: it deprecates bespoke
+    accumulate-then-print plumbing around these attributes (the
+    pre-telemetry pattern).
   * `trace(logdir)` — one-line capture of a real profiler trace
     (jax.profiler: XPlane protos viewable in TensorBoard/XProf), covering
     device compute, HBM transfers, and ICI collectives — the data the
@@ -43,13 +50,17 @@ class Timer:
             t.sync(out)          # timer exit blocks on `out` first
         print(t.seconds)
 
-    Without `sync`, measures plain wall time of the block.
+    Without `sync`, measures plain wall time of the block. With
+    `registry=`, each completed block also records into the registry's
+    `timer.{name}_s` histogram (the unified-telemetry bridge).
     """
 
-    def __init__(self, name: str = "timer"):
+    def __init__(self, name: str = "timer", registry=None):
         self.name = name
         self.seconds: Optional[float] = None
         self._sync_tree: Any = None
+        self._hist = (registry.histogram(f"timer.{name}_s")
+                      if registry is not None else None)
 
     def sync(self, tree: Any) -> Any:
         """Register a pytree to block on at exit; returns it unchanged."""
@@ -64,6 +75,8 @@ class Timer:
         if self._sync_tree is not None:
             jax.block_until_ready(self._sync_tree)
         self.seconds = time.perf_counter() - self._t0
+        if self._hist is not None:
+            self._hist.record(self.seconds)
 
 
 class CumulativeTimer:
@@ -76,12 +89,19 @@ class CumulativeTimer:
             with t:
                 batch = next(loader)
         t.total, t.count, t.mean
+
+    With `registry=`, every section additionally records into the
+    `timer.{name}_s` histogram — constant memory at any rate, and the
+    per-section DISTRIBUTION (p50/p95/max) rides the unified snapshot
+    where the standalone total/count pair could only ever report a mean.
     """
 
-    def __init__(self, name: str = "section"):
+    def __init__(self, name: str = "section", registry=None):
         self.name = name
         self.total = 0.0
         self.count = 0
+        self._hist = (registry.histogram(f"timer.{name}_s")
+                      if registry is not None else None)
 
     @property
     def mean(self) -> float:
@@ -92,8 +112,11 @@ class CumulativeTimer:
         return self
 
     def __exit__(self, *exc) -> None:
-        self.total += time.perf_counter() - self._t0
+        dt = time.perf_counter() - self._t0
+        self.total += dt
         self.count += 1
+        if self._hist is not None:
+            self._hist.record(dt)
 
     def __repr__(self) -> str:
         return (f"CumulativeTimer({self.name}: total={self.total:.4f}s "

@@ -9,15 +9,23 @@ per violation on stderr) on malformed JSON, unknown schema version or kind,
 missing required fields, OUT-OF-ORDER records (t_mono must be
 non-decreasing within a run segment — the writer stamps emission time
 exactly so this holds; an appended file holds one segment per
-`trace_start` record), negative span durations, or span parent references
-that never appear in their segment. Pure stdlib, no jax import: the
-checker must run anywhere the trace lands, including hosts without the
+`trace_start` record), negative span durations, or span-STRUCTURE
+violations: parent references that never appear in their segment, duplicate
+span ids, a recorded exit with no matching enter (t0_mono + dur_s past the
+emission stamp), and child spans crossing their parent's interval. The
+structural checks are the span-tree reconstructor shared with
+`pytorch_ddp_mnist_tpu/telemetry/analysis.py` (file-loaded, not
+package-imported, so no framework import happens); when the analysis
+module is not beside this script (a copied-alone checker), they degrade to
+the orphaned-parent check with a stderr note. Pure stdlib, no jax import:
+the checker must run anywhere the trace lands, including hosts without the
 framework installed.
 """
 
 from __future__ import annotations
 
 import glob
+import importlib.util
 import json
 import os
 import sys
@@ -25,6 +33,54 @@ import sys
 SCHEMA_VERSION = 1
 KINDS = ("meta", "span", "point", "snapshot")
 REQUIRED = ("v", "kind", "name", "t_wall", "t_mono", "proc")
+
+
+def _load_analysis():
+    """The shared span-tree reconstructor, loaded BY FILE PATH (the package
+    __init__ imports jax via compat; the checker must stay framework-free).
+    None when the module is not beside this script."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "pytorch_ddp_mnist_tpu", "telemetry", "analysis.py")
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_pdmt_trace_analysis", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception as e:  # a broken analysis.py must not mask the trace
+        print(f"check_telemetry: note: could not load analysis.py "
+              f"({e}); span-structure checks degrade to orphan detection",
+              file=sys.stderr)
+        return None
+
+
+_analysis = _load_analysis()
+
+
+def _fallback_structure_errors(segment):
+    """Copied-alone degradation: orphaned-parent detection only (the
+    original checker's guarantee). Parents close AFTER children, so ids
+    resolve against the whole segment."""
+    span_ids = {rec["span"] for rec in segment
+                if rec.get("kind") == "span" and "span" in rec}
+    errors = []
+    for rec in segment:
+        if rec.get("kind") != "span":
+            continue
+        parent = rec.get("parent")
+        if parent is not None and parent not in span_ids:
+            errors.append((rec.get("_line", 0),
+                           f"parent span {parent} never recorded"))
+    return errors
+
+
+def span_structure_errors(segment):
+    if _analysis is not None:
+        return _analysis.span_structure_errors(segment)
+    return _fallback_structure_errors(segment)
 
 
 def check_file(path: str, errors: list) -> int:
@@ -35,22 +91,17 @@ def check_file(path: str, errors: list) -> int:
     file may hold several run segments, each beginning with a
     `trace_start` meta record. Ordering and span-id scope reset per
     segment: t_mono is monotonic within a segment (perf_counter restarts
-    across processes/reboots), and a span's parent must resolve within its
-    own segment (ids restart at 1 each run)."""
-    span_ids = set()
-    parent_refs = []  # (line_no, parent_id)
+    across processes/reboots), and span structure — parent resolution, id
+    uniqueness, enter/exit stamps, nesting containment — is validated per
+    segment by the reconstructor shared with telemetry/analysis.py."""
+    segment = []  # this segment's span records, for the tree reconstructor
     last_mono = None
     n = 0
 
     def flush_segment():
-        for line_no, parent in parent_refs:
-            # parents close AFTER their children, so the id resolves
-            # against the whole segment, not just the lines above
-            if parent not in span_ids:
-                errors.append(f"{path}:{line_no}: parent span {parent} "
-                              f"never recorded")
-        span_ids.clear()
-        parent_refs.clear()
+        errors.extend(f"{path}:{line}: {msg}"
+                      for line, msg in span_structure_errors(segment))
+        segment.clear()
 
     with open(path) as f:
         for line_no, line in enumerate(f, 1):
@@ -98,9 +149,8 @@ def check_file(path: str, errors: list) -> int:
                     elif rec["dur_s"] < 0:
                         errors.append(f"{where}: negative dur_s "
                                       f"{rec['dur_s']}")
-                    span_ids.add(rec["span"])
-                    if rec.get("parent") is not None:
-                        parent_refs.append((line_no, rec["parent"]))
+                    rec["_line"] = line_no
+                    segment.append(rec)
     flush_segment()
     return n
 

@@ -1,0 +1,587 @@
+"""The trace analysis layer: span-tree reconstruction + per-phase stats
+(telemetry/analysis.py), Chrome/Perfetto export (telemetry/export.py), the
+step-time regression gate (`trace report --baseline`), the flight recorder
+(telemetry/flight.py) and its wireup/serve/bench wiring, and REAL 2-process
+trace aggregation via the mp_worker launch pattern."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_ddp_mnist_tpu import telemetry
+from pytorch_ddp_mnist_tpu.telemetry import analysis, export, flight
+from pytorch_ddp_mnist_tpu.cli import trace as trace_cli
+
+# the checker script, file-loaded (repo idiom, see test_telemetry)
+import importlib.util
+import pathlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "check_telemetry_for_analysis",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts"
+    / "check_telemetry.py")
+_checker = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_checker)
+check_main = _checker.main
+
+
+# ---------------------------------------------------------------------------
+# trace fabrication helpers
+# ---------------------------------------------------------------------------
+
+def _emit_run(path, proc, step_durs, *, data_wait=0.002, eval_s=0.004):
+    """Write one process's trace: one epoch span per entry of `step_durs`,
+    with the train loop's aggregate children at fabricated durations."""
+    tr = telemetry.EventTrace(str(path), process_index=proc)
+    for epoch, dur in enumerate(step_durs):
+        with tr.span("epoch", epoch=epoch):
+            tr.complete_span("data_wait", data_wait, batches=2)
+            tr.complete_span("step_compute", dur, steps=2)
+            tr.complete_span("eval", eval_s)
+    reg = telemetry.MetricsRegistry()
+    reg.counter("xla.compiles").inc(3)
+    reg.gauge("host.rss_bytes").set(1 << 20)
+    tr.snapshot(reg)
+    tr.close()
+    return str(path)
+
+
+def _rec(**kw):
+    base = {"v": 1, "kind": "point", "name": "x", "t_wall": 1.0,
+            "t_mono": 1.0, "proc": 0}
+    base.update(kw)
+    return json.dumps(base)
+
+
+def _write(tmp_path, lines, name="events.jsonl"):
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# analysis: report structure and statistics
+# ---------------------------------------------------------------------------
+
+def test_analyze_single_process_report(tmp_path):
+    f = _emit_run(tmp_path / "events.jsonl", 0, [0.010, 0.012, 0.011])
+    rep = analysis.analyze([f])
+    assert rep["n_processes"] == 1 and rep["processes"] == [0]
+    assert rep["span_errors"] == []
+    assert rep["snapshots"] == 1
+    ph = rep["phases"]
+    assert set(ph) == {"data_wait", "step_compute", "eval"}
+    assert ph["step_compute"]["n"] == 3
+    assert ph["step_compute"]["p50_s"] == pytest.approx(0.011)
+    assert ph["step_compute"]["max_s"] == pytest.approx(0.012)
+    assert ph["step_compute"]["p95_s"] == pytest.approx(0.012)
+    assert rep["epochs"]["count"] == 3
+    # single process: nothing to compare across ranks
+    assert rep["straggler"]["epochs_compared"] == 0
+    json.dumps(rep)                                 # machine-readable
+
+
+def test_analyze_epoch_trend_detects_slowdown(tmp_path):
+    # epoch durations grow monotonically -> positive trend (%/epoch)
+    f = _emit_run(tmp_path / "events.jsonl", 0, [0.01] * 4)
+    rep = analysis.analyze([f])
+    trend = analysis._linear_trend_pct([1.0, 1.1, 1.2, 1.3])
+    assert trend == pytest.approx(100 * 0.1 / 1.15, rel=1e-6)
+    assert analysis._linear_trend_pct([1.0]) is None
+    assert rep["epochs"]["trend_pct_per_epoch"] is not None
+
+
+def test_analyze_keeps_appended_segments_apart(tmp_path):
+    """Append mode is a designed feature (outage resume / repeat runs):
+    the second run's epochs 0..N must not last-wins-overwrite the first
+    run's in the per-epoch view, and each segment gets its own wall/mono
+    clock offset (perf_counter restarts across re-execs)."""
+    path = tmp_path / "events.jsonl"
+    _emit_run(path, 0, [0.010, 0.010])
+    _emit_run(path, 0, [0.030, 0.030])   # EventTrace appends: segment 2
+    rep = analysis.analyze([str(path)])
+    assert rep["span_errors"] == []
+    assert rep["epochs"]["count"] == 4           # 2 + 2, not max() = 2
+    assert len(rep["epochs"]["durations_s"]) == 4
+    # BOTH runs' step_compute aggregates pooled in the phase stats
+    assert rep["phases"]["step_compute"]["n"] == 4
+    assert rep["phases"]["step_compute"]["max_s"] == pytest.approx(0.030)
+    # and the appended file still exports with every event at sane stamps
+    doc = export.chrome_trace([str(path)])
+    _validate_chrome(doc)
+
+
+def test_percentile_nearest_rank():
+    vals = sorted([0.1, 0.2, 0.3, 0.4])
+    assert analysis._percentile(vals, 0.50) == 0.2
+    assert analysis._percentile(vals, 0.95) == 0.4
+    assert analysis._percentile([], 0.5) == 0.0
+
+
+def test_clock_offset_is_median_of_stamp_pairs():
+    recs = [{"t_wall": 100.0 + m, "t_mono": m} for m in (1.0, 2.0, 3.0)]
+    recs.append({"t_wall": 999.0, "t_mono": 4.0})   # one delayed outlier
+    assert analysis.clock_offset(recs) == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# span-tree reconstruction: structural violations
+# ---------------------------------------------------------------------------
+
+def test_span_structure_real_trace_is_clean(tmp_path):
+    f = _emit_run(tmp_path / "events.jsonl", 0, [0.01, 0.01])
+    records, errors = analysis.load_trace(f)
+    assert errors == []
+    for seg in analysis.split_segments(records):
+        assert analysis.span_structure_errors(seg) == []
+
+
+def _seg(lines):
+    recs = [json.loads(ln) for ln in lines]
+    for i, r in enumerate(recs, 1):
+        r["_line"] = i
+    return recs
+
+
+def test_span_structure_flags_orphan_duplicate_crossing_and_bad_exit():
+    orphan = _seg([_rec(kind="span", name="c", span=2, parent=77,
+                        dur_s=0.1)])
+    assert any("never recorded" in msg
+               for _ln, msg in analysis.span_structure_errors(orphan))
+
+    dup = _seg([
+        _rec(kind="span", name="a", span=1, parent=None, dur_s=0.1,
+             t_mono=1.0),
+        _rec(kind="span", name="b", span=1, parent=None, dur_s=0.1,
+             t_mono=2.0),
+    ])
+    assert any("duplicate span id" in msg
+               for _ln, msg in analysis.span_structure_errors(dup))
+
+    # child [0.5, 1.1] pokes out of parent [0.0, 1.0]
+    crossing = _seg([
+        _rec(kind="span", name="child", span=2, parent=1, dur_s=0.6,
+             t_mono=1.1, attrs={"t0_mono": 0.5, "t0_wall": 0.5}),
+        _rec(kind="span", name="parent", span=1, parent=None, dur_s=1.0,
+             t_mono=1.15, attrs={"t0_mono": 0.0, "t0_wall": 0.0}),
+    ])
+    assert any("crosses" in msg
+               for _ln, msg in analysis.span_structure_errors(crossing))
+
+    # exit stamp (t0 + dur = 7.0) lands after the emission stamp (6.0):
+    # an exit with no matching enter
+    bad_exit = _seg([
+        _rec(kind="span", name="ghost", span=1, parent=None, dur_s=2.0,
+             t_mono=6.0, attrs={"t0_mono": 5.0}),
+    ])
+    assert any("no matching enter" in msg
+               for _ln, msg in analysis.span_structure_errors(bad_exit))
+
+
+def test_checker_rejects_structural_violations(tmp_path, capsys):
+    """The checker satellite: span-STRUCTURE violations (shared
+    reconstructor) exit nonzero with named messages."""
+    crossing = [
+        _rec(kind="meta", name="trace_start", t_mono=0.0),
+        _rec(kind="span", name="child", span=2, parent=1, dur_s=0.6,
+             t_mono=1.1, attrs={"t0_mono": 0.5}),
+        _rec(kind="span", name="parent", span=1, parent=None, dur_s=1.0,
+             t_mono=1.15, attrs={"t0_mono": 0.0}),
+    ]
+    assert check_main([_write(tmp_path, crossing)]) == 1
+    assert "crosses" in capsys.readouterr().err
+
+    dup = [
+        _rec(kind="span", name="a", span=1, dur_s=0.1, t_mono=1.0),
+        _rec(kind="span", name="b", span=1, dur_s=0.1, t_mono=2.0),
+    ]
+    assert check_main([_write(tmp_path, dup)]) == 1
+    assert "duplicate span id" in capsys.readouterr().err
+
+    ghost = [_rec(kind="span", name="g", span=1, dur_s=2.0, t_mono=6.0,
+                  attrs={"t0_mono": 5.0})]
+    assert check_main([_write(tmp_path, ghost)]) == 1
+    assert "no matching enter" in capsys.readouterr().err
+
+
+def test_checker_still_accepts_real_emitted_trace(tmp_path):
+    _emit_run(tmp_path / "events.jsonl", 0, [0.01, 0.01])
+    assert check_main([str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+# ---------------------------------------------------------------------------
+
+def test_compare_flags_2x_step_compute_regression(tmp_path):
+    base = _emit_run(tmp_path / "base.jsonl", 0, [0.010] * 4)
+    slow = _emit_run(tmp_path / "slow.jsonl", 0, [0.020] * 4)
+    diff = analysis.compare(analysis.analyze([slow]),
+                            analysis.analyze([base]), threshold=1.5)
+    regressed = {(r["phase"], r["stat"]) for r in diff["regressions"]}
+    assert ("step_compute", "p50_s") in regressed
+    # unchanged phases pass
+    assert not any(r["phase"] == "eval" for r in diff["regressions"])
+    # and the inverse comparison (things got FASTER) gates nothing
+    diff_fast = analysis.compare(analysis.analyze([base]),
+                                 analysis.analyze([slow]), threshold=1.5)
+    assert diff_fast["regressions"] == []
+
+
+def test_compare_ignores_sub_millisecond_noise(tmp_path):
+    base = _emit_run(tmp_path / "base.jsonl", 0, [0.010], eval_s=0.0001)
+    new = _emit_run(tmp_path / "new.jsonl", 0, [0.010], eval_s=0.0003)
+    diff = analysis.compare(analysis.analyze([new]),
+                            analysis.analyze([base]), threshold=1.5)
+    assert not any(r["phase"] == "eval" for r in diff["regressions"])
+
+
+def test_trace_cli_report_baseline_gate_exit_codes(tmp_path, capsys):
+    base_dir, slow_dir = tmp_path / "base", tmp_path / "slow"
+    base_dir.mkdir(), slow_dir.mkdir()
+    _emit_run(base_dir / "events.jsonl", 0, [0.010] * 4)
+    _emit_run(slow_dir / "events.jsonl", 0, [0.020] * 4)
+    # a run gated against itself passes (the trace-smoke round-trip)
+    assert trace_cli.main(["report", str(base_dir),
+                           "--baseline", str(base_dir)]) == 0
+    capsys.readouterr()
+    # the injected 2x step_compute regression exits 3
+    rc = trace_cli.main(["report", str(slow_dir),
+                         "--baseline", str(base_dir)])
+    assert rc == 3
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "step_compute" in out and "FAIL" in out
+
+
+def test_trace_cli_report_accepts_saved_json_baseline(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _emit_run(run_dir / "events.jsonl", 0, [0.010] * 3)
+    assert trace_cli.main(["report", str(run_dir), "--json"]) == 0
+    saved = tmp_path / "report.json"
+    saved.write_text(capsys.readouterr().out)
+    assert trace_cli.main(["report", str(run_dir),
+                           "--baseline", str(saved)]) == 0
+    # the COMBINED --baseline --json document round-trips too (its nested
+    # report unwraps), instead of silently gating nothing
+    capsys.readouterr()
+    assert trace_cli.main(["report", str(run_dir), "--baseline",
+                           str(saved), "--json"]) == 0
+    combined = tmp_path / "combined.json"
+    combined.write_text(capsys.readouterr().out)
+    assert trace_cli.main(["report", str(run_dir),
+                           "--baseline", str(combined)]) == 0
+
+
+def test_trace_cli_gate_refuses_to_pass_on_zero_overlap(tmp_path, capsys):
+    """A baseline whose phases share nothing with the new run means the
+    gate compared NOTHING — that must be a named failure (exit 1), not a
+    silent PASS that lets renamed-span regressions through CI."""
+    run_dir, empty_dir = tmp_path / "run", tmp_path / "empty"
+    run_dir.mkdir(), empty_dir.mkdir()
+    _emit_run(run_dir / "events.jsonl", 0, [0.010] * 2)
+    tr = telemetry.EventTrace(str(empty_dir / "events.jsonl"),
+                              process_index=0)
+    tr.point("no_phases_here")
+    tr.close()
+    assert trace_cli.main(["report", str(run_dir),
+                           "--baseline", str(empty_dir)]) == 1
+    assert "gate checked nothing" in capsys.readouterr().err
+
+
+def test_trace_cli_report_prints_phases_and_errors(tmp_path, capsys):
+    assert trace_cli.main(["report", str(tmp_path / "nope")]) == 1
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _emit_run(run_dir / "events.jsonl", 0, [0.01, 0.01])
+    assert trace_cli.main(["report", str(run_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "p50_s" in out and "step_compute" in out
+    assert "span structure: OK" in out
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+_VALID_PH = {"X", "i", "C", "M"}
+
+
+def _validate_chrome(doc):
+    """The schema the acceptance names: valid Chrome trace-event JSON."""
+    assert isinstance(doc, dict) and isinstance(doc["traceEvents"], list)
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in _VALID_PH
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] == "C":
+            assert isinstance(ev["args"]["value"], (int, float))
+    json.loads(json.dumps(doc))                     # round-trips verbatim
+
+
+def test_chrome_export_schema_and_tracks(tmp_path):
+    _emit_run(tmp_path / "events.jsonl", 0, [0.01, 0.01])
+    _emit_run(tmp_path / "events.rank1.jsonl", 1, [0.01, 0.01])
+    doc = export.chrome_trace(analysis.trace_files(str(tmp_path)))
+    _validate_chrome(doc)
+    evs = doc["traceEvents"]
+    assert {ev["pid"] for ev in evs} == {0, 1}      # one track per process
+    x_names = {ev["name"] for ev in evs if ev["ph"] == "X"}
+    assert {"epoch", "data_wait", "step_compute", "eval"} <= x_names
+    # live spans and aggregates ride separate threads
+    tids = {ev["name"]: ev["tid"] for ev in evs if ev["ph"] == "X"}
+    assert tids["epoch"] != tids["step_compute"]
+    # registry snapshot became counter tracks
+    counters = {ev["name"] for ev in evs if ev["ph"] == "C"}
+    assert {"xla.compiles", "host.rss_bytes"} <= counters
+    # process metadata names both tracks
+    meta = [ev for ev in evs if ev["ph"] == "M"
+            and ev["name"] == "process_name"]
+    assert len(meta) == 2
+
+
+def test_write_chrome_trace_file(tmp_path):
+    f = _emit_run(tmp_path / "events.jsonl", 0, [0.01])
+    out = tmp_path / "trace.chrome.json"
+    n = export.write_chrome_trace([f], str(out))
+    assert n > 0
+    _validate_chrome(json.loads(out.read_text()))
+
+
+def test_trace_cli_export(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    _emit_run(run_dir / "events.jsonl", 0, [0.01])
+    out = tmp_path / "t.json"
+    assert trace_cli.main(["export", str(run_dir), "-o", str(out)]) == 0
+    assert "Perfetto" in capsys.readouterr().out
+    _validate_chrome(json.loads(out.read_text()))
+    assert trace_cli.main(["export", str(tmp_path / "nope"),
+                           "-o", str(out)]) == 1
+
+
+def test_export_empty_span_set(tmp_path):
+    (tmp_path / "events.jsonl").write_text(
+        _rec(kind="meta", name="trace_start") + "\n")
+    doc = export.chrome_trace([str(tmp_path / "events.jsonl")])
+    assert doc["traceEvents"] == []
+
+
+def test_export_skips_stampless_records_instead_of_crashing(tmp_path):
+    """A torn/foreign record without t_mono is SKIPPED (the lenient-loader
+    contract), never a KeyError that hides every valid record."""
+    lines = [
+        _rec(kind="meta", name="trace_start"),
+        json.dumps({"v": 1, "kind": "point", "name": "torn",
+                    "t_wall": 1.0, "proc": 0}),          # no t_mono
+        _rec(kind="span", name="ok", span=1, dur_s=0.5, t_mono=2.0),
+    ]
+    doc = export.chrome_trace([_write(tmp_path, lines)
+                               + "/events.jsonl"])
+    _validate_chrome(doc)
+    names = {ev["name"] for ev in doc["traceEvents"] if ev["ph"] != "M"}
+    assert "ok" in names and "torn" not in names
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded_with_exact_drop_count():
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("probe", attempt=i)
+    entries = rec.snapshot()
+    assert len(entries) == 4
+    assert rec.recorded == 10 and rec.dropped == 6
+    assert [e["attempt"] for e in entries] == [6, 7, 8, 9]  # newest kept
+    assert entries[-1]["seq"] == 9
+    with pytest.raises(ValueError):
+        flight.FlightRecorder(capacity=0)
+
+
+def test_flight_dump_payload_and_empty_behavior(tmp_path):
+    rec = flight.FlightRecorder(capacity=8)
+    assert rec.dump("nothing recorded") is None     # empty ring: no file
+    rec.record("backend_probe_error", error="UNAVAILABLE")
+    rec.dump_dir = str(tmp_path)
+    path = rec.dump("test failure")
+    assert path and os.path.exists(path)
+    payload = json.loads(open(path).read())
+    assert payload["v"] == 1 and payload["reason"] == "test failure"
+    assert payload["recorded"] == 1 and payload["dropped"] == 0
+    assert payload["entries"][0]["kind"] == "backend_probe_error"
+    assert payload["pid"] == os.getpid()
+
+
+def test_admission_rejects_feed_flight_recorder():
+    from pytorch_ddp_mnist_tpu.serve.admission import (AdmissionController,
+                                                       Rejected)
+    before = flight.get_flight_recorder().recorded
+    ctl = AdmissionController(max_depth=1)
+    ctl.admit()
+    with pytest.raises(Rejected):
+        ctl.admit()                                 # queue full
+    ctl.begin_drain()
+    ctl.release()
+    with pytest.raises(Rejected):
+        ctl.admit()                                 # draining
+    kinds = [e for e in flight.get_flight_recorder().snapshot()
+             if e["kind"] == "serve_reject" and e["seq"] >= before]
+    reasons = {e["reason"] for e in kinds}
+    assert {"queue_full", "draining"} <= reasons
+
+
+def test_wireup_retry_loop_feeds_flight_recorder(monkeypatch):
+    from pytorch_ddp_mnist_tpu.parallel import wireup
+    before = flight.get_flight_recorder().recorded
+    monkeypatch.setattr(
+        wireup, "_probe_devices_bounded",
+        lambda _t: ("error", RuntimeError("UNAVAILABLE: tunnel down")))
+    with pytest.raises(wireup.BackendUnavailableError):
+        wireup.wait_for_backend(max_wait_s=0.05, poll_s=0.01)
+    fresh = [e for e in flight.get_flight_recorder().snapshot()
+             if e["seq"] >= before]
+    kinds = {e["kind"] for e in fresh}
+    assert {"backend_wait_start", "backend_probe_error",
+            "backend_unavailable"} <= kinds
+    err = next(e for e in fresh if e["kind"] == "backend_probe_error")
+    assert "UNAVAILABLE" in err["error"]
+
+
+def test_bench_artifact_stamps_flight_dump(tmp_path, monkeypatch, capsys):
+    """The satellite: a backend_unavailable artifact line carries the
+    flight-recorder dump path, so BENCH_r0X-style failures are diagnosable
+    from the JSON alone."""
+    import bench
+    monkeypatch.setenv("PDMT_FLIGHT_DIR", str(tmp_path))
+    flight.record("backend_probe_error", attempt=1, error="UNAVAILABLE")
+    bench._emit_backend_error(RuntimeError("tunnel never came up"))
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["error"].startswith("backend_unavailable")
+    assert line["value"] is None
+    dump_path = line["flight_recorder"]
+    assert dump_path and os.path.exists(dump_path)
+    payload = json.loads(open(dump_path).read())
+    assert payload["reason"].startswith("bench backend_unavailable")
+    assert any(e["kind"] == "backend_probe_error"
+               for e in payload["entries"])
+
+
+def test_flight_sigterm_flush_preserves_sig_ign(tmp_path, monkeypatch):
+    """A run launched with SIGTERM ignored (supervisor choice) must stay
+    alive after the flush — chaining means preserving the disposition,
+    not converting ignore into death."""
+    import signal
+    prev = signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    monkeypatch.setattr(flight, "_sigterm_installed", False)
+    try:
+        assert flight.install_sigterm_flush() is True
+        flight.record("probe", note="pre-ign-sigterm")
+        flight.set_dump_dir(str(tmp_path))
+        os.kill(os.getpid(), signal.SIGTERM)    # must NOT kill the test
+        assert (tmp_path / f"flight.{os.getpid()}.json").exists()
+    finally:
+        flight.set_dump_dir(None)
+        monkeypatch.setattr(flight, "_sigterm_installed", False)
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_flight_sigterm_flush_chains(tmp_path, monkeypatch):
+    """install_sigterm_flush dumps the ring then chains the previous
+    handler (a callable here, so the process survives the test)."""
+    import signal
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    monkeypatch.setattr(flight, "_sigterm_installed", False)
+    try:
+        assert flight.install_sigterm_flush() is True
+        flight.record("probe", note="pre-sigterm")
+        flight.set_dump_dir(str(tmp_path))
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert hits == [signal.SIGTERM]             # chained
+        dumped = json.loads(
+            open(tmp_path / f"flight.{os.getpid()}.json").read())
+        assert dumped["reason"] == "SIGTERM"
+    finally:
+        flight.set_dump_dir(None)
+        monkeypatch.setattr(flight, "_sigterm_installed", False)
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# REAL multi-process aggregation (the mp_worker launch pattern)
+# ---------------------------------------------------------------------------
+
+STALL_S = 0.05
+MP_EPOCHS = 3
+
+
+def test_two_process_trace_aggregation(tmp_path):
+    """Two real worker processes emit rank-gated traces into one dir; the
+    merged report must see both processes, aligned epochs, and the injected
+    rank-1 straggler in its skew fields — the acceptance scenario."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "trace_worker.py"),
+         str(tmp_path), str(rank), str(MP_EPOCHS), str(STALL_S)],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for rank in range(2)]
+    for p in procs:
+        _out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-2000:]
+
+    files = analysis.trace_files(str(tmp_path))
+    assert len(files) == 2                          # events + rank1 sibling
+    assert check_main([str(tmp_path)]) == 0         # schema + structure
+
+    rep = analysis.analyze(files)
+    assert rep["n_processes"] == 2 and rep["processes"] == [0, 1]
+    assert rep["span_errors"] == []
+    assert rep["epochs"]["count"] == MP_EPOCHS
+    assert rep["phases"]["step_compute"]["n"] == 2 * MP_EPOCHS
+    # the injected straggler: every epoch compared across both ranks, and
+    # the skew is at least most of the injected stall
+    st = rep["straggler"]
+    assert st["epochs_compared"] == MP_EPOCHS
+    assert st["max_skew_s"] >= STALL_S * 0.6
+    assert st["max_skew_pct"] > 0
+    assert set(st["worst_epoch"]["dur_s_by_proc"]) == {"0", "1"}
+    # wall alignment: both workers started within the same few seconds
+    assert st["max_start_spread_s"] < 60.0
+
+    # the CLI front door renders the same merged view (acceptance text)
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu", "trace", "report",
+         str(tmp_path)],
+        cwd=REPO, env=env, text=True, capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "2 process(es)" in out.stdout
+    assert "straggler skew" in out.stdout and "p50_s" in out.stdout
+
+    # and the merged trace exports as valid Chrome trace-event JSON
+    doc = export.chrome_trace(files)
+    _validate_chrome(doc)
+    assert {ev["pid"] for ev in doc["traceEvents"]} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# front-door registration
+# ---------------------------------------------------------------------------
+
+def test_main_dispatch_knows_trace():
+    from pytorch_ddp_mnist_tpu.__main__ import _COMMANDS
+    assert "trace" in _COMMANDS
+    assert _COMMANDS["trace"][0] == "pytorch_ddp_mnist_tpu.cli.trace"

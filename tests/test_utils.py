@@ -73,6 +73,41 @@ def test_cumulative_timer_accumulates():
     assert "io" in repr(t)
 
 
+def test_timer_registry_bridge_publishes_histogram():
+    """registry=: each completed Timer block lands in the unified
+    `timer.{name}_s` histogram — the telemetry bridge that deprecates
+    bespoke accumulate-then-print plumbing around .seconds."""
+    from pytorch_ddp_mnist_tpu.telemetry import MetricsRegistry
+    reg = MetricsRegistry()
+    for _ in range(2):
+        with Timer("step", registry=reg) as t:
+            time.sleep(0.005)
+    snap = reg.snapshot()["histograms"]["timer.step_s"]
+    assert snap["n"] == 2
+    assert snap["max"] >= 0.005
+    assert t.seconds is not None                    # standalone path intact
+    # no registry (the default): nothing registered anywhere
+    with Timer("step") as t2:
+        pass
+    assert reg.snapshot()["histograms"]["timer.step_s"]["n"] == 2
+    assert t2.seconds is not None
+
+
+def test_cumulative_timer_registry_bridge_records_distribution():
+    """CumulativeTimer's registry hook records each SECTION (n == count),
+    giving percentiles where total/count could only ever report a mean."""
+    from pytorch_ddp_mnist_tpu.telemetry import MetricsRegistry
+    reg = MetricsRegistry()
+    t = CumulativeTimer("io", registry=reg)
+    for _ in range(3):
+        with t:
+            time.sleep(0.002)
+    snap = reg.snapshot()["histograms"]["timer.io_s"]
+    assert snap["n"] == t.count == 3
+    assert snap["mean"] == pytest.approx(t.mean, rel=0.5)
+    assert snap["p95"] > 0
+
+
 def test_device_sync_accepts_tree_and_noarg():
     out = jax.jit(lambda a: a * 2)(jnp.ones(8))
     device_sync({"a": out})

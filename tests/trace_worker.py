@@ -1,0 +1,47 @@
+"""Worker process for the multi-process trace-aggregation test
+(tests/test_trace_analysis.py) — NOT collected by pytest (no test_ prefix).
+
+The mp_worker.py launch pattern without the jax.distributed rendezvous:
+trace aggregation is pure file math over per-rank `events*.jsonl` siblings,
+so each worker just IS one process index — it enables telemetry with an
+explicit rank (no backend query) and emits the train loop's span shape
+(epoch spans with data_wait / step_compute / eval children) with REAL
+elapsed time. Rank >= 1 sleeps an extra `stall_s` inside each epoch — the
+injected straggler the parent asserts the merged report isolates.
+
+    python tests/trace_worker.py OUT_DIR RANK EPOCHS STALL_S
+"""
+
+import sys
+import time
+
+
+def main() -> int:
+    out_dir, rank = sys.argv[1], int(sys.argv[2])
+    epochs, stall_s = int(sys.argv[3]), float(sys.argv[4])
+
+    from pytorch_ddp_mnist_tpu import telemetry
+
+    trace = telemetry.enable(out_dir, process_index=rank)
+    for epoch in range(epochs):
+        with trace.span("epoch", epoch=epoch):
+            t0 = time.perf_counter()
+            time.sleep(0.005)
+            trace.complete_span("data_wait", time.perf_counter() - t0,
+                                batches=2)
+            t0 = time.perf_counter()
+            time.sleep(0.01 + (stall_s if rank else 0.0))  # the straggler
+            trace.complete_span("step_compute", time.perf_counter() - t0,
+                                steps=2)
+            t0 = time.perf_counter()
+            time.sleep(0.002)
+            trace.complete_span("eval", time.perf_counter() - t0)
+    reg = telemetry.MetricsRegistry()
+    reg.counter("worker.epochs").inc(epochs)
+    trace.snapshot(reg)
+    telemetry.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
