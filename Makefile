@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -50,6 +50,27 @@ trace-smoke:
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/pdmt_trace_smoke/trace.chrome.json')); \
 		assert d['traceEvents'], 'empty chrome trace'"
+
+# DDP comms smoke: the 3-strategy parity matrix on an 8-fake-device CPU
+# mesh — one telemetry-instrumented --parallel epoch per strategy, each
+# trace schema-validated AND gated on the ddp.* metrics being present
+# (a run that silently dropped ddp.bytes_on_wire / ddp.collective_s
+# fails), then `bench.py --mode ddp` emits the per-strategy artifact
+# lines (throughput + scaling efficiency + parity drift vs pmean).
+ddp-smoke:
+	rm -rf /tmp/pdmt_ddp_smoke
+	for comm in pmean sharded bf16; do \
+		JAX_PLATFORMS=cpu \
+		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m pytorch_ddp_mnist_tpu train --parallel \
+			--wireup_method single --ddp_comm $$comm --epochs 1 \
+			--limit 512 --batch_size 16 --checkpoint "" \
+			--telemetry /tmp/pdmt_ddp_smoke/$$comm || exit 1; \
+		$(PY) scripts/check_telemetry.py --require ddp. \
+			/tmp/pdmt_ddp_smoke/$$comm || exit 1; \
+	done
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) bench.py --mode ddp --epochs 3 --batch_size 16
 
 native:
 	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native

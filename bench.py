@@ -45,6 +45,11 @@ NOMINAL_BASELINE_STREAM_IMGS_PER_SEC = 1_000_000.0
 # magnitude below the closed-loop eval number by construction (per-request
 # latency budget vs fused throughput), hence its own nominal.
 NOMINAL_BASELINE_SERVE_RPS = 1_000.0
+# DDP mode normalizes the PER-CHIP train rate of the N-device mesh — same
+# magnitude as the train nominal but a different program (per-step XLA
+# collective in the scan, vs the single-chip epoch kernel), hence its own
+# constant (same retuning-isolation rule as the others).
+NOMINAL_BASELINE_DDP_IMGS_PER_SEC = 1_000_000.0
 
 # Roofline context for every throughput line (VERDICT r4 #8: a reader of a
 # BENCH_r0X.json should see how close the chip is to its ceiling without
@@ -76,6 +81,10 @@ FUSED_EPOCHS = 400
 # --mode accuracy trains real epochs (not timing windows); the north-star
 # acceptance names 10 (BASELINE.json / ddp_tutorial_multi_gpu.py:127).
 ACCURACY_EPOCHS = 10
+# --mode ddp fuses this many epochs per timing window (default): the DDP
+# scan program is measured per STRATEGY plus a 1-device baseline, so the
+# whole mode stays a few windows even on CPU fake devices.
+DDP_EPOCHS = 10
 
 from pytorch_ddp_mnist_tpu.train.scan import resolve_kernel  # noqa: E402
 from pytorch_ddp_mnist_tpu.ops.pallas_step import (  # noqa: E402
@@ -335,6 +344,172 @@ def _serve_bench(a) -> None:
     }))
 
 
+def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
+                      n_rows: int = 8192, strategies=None,
+                      parity_steps: int = 3, parity_lr: float = 0.05,
+                      n_devices: int = None) -> list:
+    """Measure the DDP scan program once per gradient-communication
+    strategy on the full-device mesh, plus a 1-device baseline, and return
+    one row dict per strategy:
+
+        {strategy, n_devices, images_per_sec, per_chip_images_per_sec,
+         scaling_efficiency_vs_1dev, bytes_on_wire_per_step_per_device,
+         collective_s_p50, parity_max_rel_diff_vs_pmean,
+         parity_max_abs_diff_vs_pmean}
+
+    `scaling_efficiency_vs_1dev` = (N-device per-chip rate) / (1-device
+    rate of the same per-chip batch) — 1.0 is perfect linear scaling.
+    `parity_max_rel_diff_vs_pmean` re-runs `parity_steps` streaming DP
+    steps per strategy from one init and reports the worst relative
+    parameter divergence vs the pmean baseline (0.0 for pmean itself — the
+    bitwise pin); `parity_lr` governs ONLY that probe (deliberately larger
+    than the measured program's fixed lr=0.01 so drift has signal).
+    Shared by `bench.py --mode ddp` and `scripts/multichip_smoke.py` so
+    the two artifacts can never measure different programs."""
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler, collectives
+    from pytorch_ddp_mnist_tpu.parallel import data_parallel_mesh
+    from pytorch_ddp_mnist_tpu.parallel.ddp import (batch_sharding,
+                                                    make_dp_train_step,
+                                                    replicated)
+    from pytorch_ddp_mnist_tpu.parallel.mesh import DATA_AXIS, make_mesh
+    from pytorch_ddp_mnist_tpu.train.scan import (epoch_batch_indices,
+                                                  make_dp_run_fn,
+                                                  resident_images)
+    from pytorch_ddp_mnist_tpu.utils import Timer
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    strategies = list(strategies or collectives.STRATEGIES)
+    # n_devices caps the mesh (e.g. multichip_smoke's pool holds a +1
+    # spare device for the dry run's simulator that must NOT join the
+    # measured mesh); default = every device, the bench-mode contract.
+    mesh = (data_parallel_mesh() if n_devices is None
+            else make_mesh([n_devices], [DATA_AXIS],
+                           jax.devices()[:n_devices]))
+    n = int(mesh.devices.size)
+    n_rows = max(n_rows, per_chip_batch * n)  # at least one step per epoch
+
+    split = synthetic_mnist(n_rows, seed=0)
+    x_host = resident_images(split.images)
+    y_host = split.labels.astype(np.int32)
+    params_host = jax.tree_util.tree_map(np.asarray,
+                                         init_mlp(jax.random.key(0)))
+    key_host = np.asarray(jax.random.key_data(jax.random.key(1)))
+
+    def measure(mesh_m, comm):
+        nm = int(mesh_m.devices.size)
+        batch = per_chip_batch * nm
+        rep = replicated(mesh_m)
+        x_all = jax.device_put(x_host, rep)
+        y_all = jax.device_put(y_host, rep)
+        sampler = ShardedSampler(n_rows, num_replicas=1, rank=0, seed=42)
+        idxs = []
+        for e in range(epochs):
+            sampler.set_epoch(e)
+            idxs.append(epoch_batch_indices(sampler, batch))
+        idxs = jax.device_put(np.stack(idxs),
+                              NamedSharding(mesh_m, P(None, None, DATA_AXIS)))
+        run = make_dp_run_fn(mesh_m, lr=0.01, kernel="xla", comm=comm)
+
+        def fresh():
+            return (jax.device_put(params_host, rep),
+                    jax.random.wrap_key_data(jax.device_put(key_host, rep)))
+
+        p, k = fresh()
+        losses = np.asarray(run(p, k, x_all, y_all, idxs)[2])  # compile+sync
+        assert np.isfinite(losses).all()
+        best = float("inf")
+        for _ in range(3):
+            p, k = fresh()
+            with Timer("window") as t:
+                out = run(p, k, x_all, y_all, idxs)
+                t.sync(out[2])
+            best = min(best, t.seconds)
+        return idxs.size / best
+
+    def parity_params(comm):
+        """`parity_steps` streaming DP steps on the full mesh — the
+        make_dp_train_step program the acceptance pins."""
+        step = make_dp_train_step(mesh, lr=parity_lr, comm=comm)
+        p = jax.device_put(params_host, replicated(mesh))
+        k = jax.random.wrap_key_data(
+            jax.device_put(key_host, replicated(mesh)))
+        bs = batch_sharding(mesh)
+        b = per_chip_batch * n
+        for s in range(parity_steps):
+            rows = np.arange(s * b, (s + 1) * b) % n_rows
+            x = jax.device_put(
+                (x_host[rows].astype(np.float32) / 255.0), bs)
+            y = jax.device_put(y_host[rows], bs)
+            p, k, _ = step(p, k, x, y)
+        return jax.tree_util.tree_map(np.asarray, p)
+
+    one_dev_rate = measure(make_mesh([1], [DATA_AXIS], jax.devices()[:1]),
+                           "pmean")
+    # The pmean row below re-runs this probe from a FRESH build and diffs
+    # against it — a deliberate determinism pin (a nondeterministic
+    # collective would surface as a nonzero pmean-vs-pmean diff in the
+    # artifact), not a redundant measurement.
+    p_ref = parity_params("pmean")
+    ref_leaves = jax.tree_util.tree_leaves(p_ref)
+
+    rows = []
+    for comm in strategies:
+        rate = measure(mesh, comm)
+        leaves = jax.tree_util.tree_leaves(parity_params(comm))
+        # rel over near-zero params overstates drift; the abs number is
+        # the complementary view (both land in the artifact)
+        rel = max(float(np.max(np.abs(a - b) / (np.abs(b) + 1e-12)))
+                  for a, b in zip(leaves, ref_leaves))
+        absd = max(float(np.max(np.abs(a - b)))
+                   for a, b in zip(leaves, ref_leaves))
+        probe = collectives.make_comm_probe(mesh, comm)
+        secs = collectives.measure_collective_seconds(
+            probe, jax.device_put(params_host, replicated(mesh)))
+        rows.append({
+            "strategy": comm,
+            "n_devices": n,
+            "images_per_sec": round(rate, 1),
+            "per_chip_images_per_sec": round(rate / n, 1),
+            "scaling_efficiency_vs_1dev": round((rate / n) / one_dev_rate, 4),
+            "bytes_on_wire_per_step_per_device":
+                collectives.bytes_on_wire(params_host, n, comm),
+            "collective_s_p50": round(sorted(secs)[len(secs) // 2], 6),
+            "parity_max_rel_diff_vs_pmean": rel,
+            "parity_max_abs_diff_vs_pmean": absd,
+        })
+    return rows
+
+
+def _ddp_bench(a) -> None:
+    """`--mode ddp`: the multichip story's read side — one artifact line
+    per gradient-communication strategy (pmean / sharded / bf16, or the
+    one picked by --ddp_comm) on the full-device mesh: images/sec,
+    scaling efficiency vs a 1-device run, analytic wire bytes, isolated
+    collective time, and parity drift vs the pmean baseline. Runs on real
+    chips or `--xla_force_host_platform_device_count` fake devices alike
+    (the artifact stamps compile/memory state; the caller's env names the
+    backend)."""
+    from pytorch_ddp_mnist_tpu.parallel import COMM_STRATEGIES
+    strategies = (COMM_STRATEGIES if a.ddp_comm == "all" else (a.ddp_comm,))
+    rows = ddp_strategy_rows(per_chip_batch=a.batch_size, epochs=a.epochs,
+                             strategies=strategies)
+    stamp = registry_stamp()
+    for r in rows:
+        print(json.dumps({
+            "metric": "mnist_ddp_train_images_per_sec_per_chip",
+            "value": r["per_chip_images_per_sec"],
+            "unit": "images/sec/chip",
+            "vs_baseline": round(r["per_chip_images_per_sec"]
+                                 / NOMINAL_BASELINE_DDP_IMGS_PER_SEC, 4),
+            **{k: v for k, v in r.items()
+               if k != "per_chip_images_per_sec"},
+            **perf_fields(r["per_chip_images_per_sec"]),
+            **stamp,
+        }))
+
+
 def measure_train_accuracy(kernel: str, dtype: str, superstep: int,
                            impl: str, epochs: int,
                            interpret: bool = False) -> "tuple[float, float]":
@@ -493,7 +668,7 @@ def main(argv=None) -> None:
                         "SLOWER than 1 at 2/4/8 (docs/PERF.md) — kept for "
                         "reproducing that negative result")
     p.add_argument("--mode", choices=("train", "stream", "eval", "accuracy",
-                                      "serve"),
+                                      "serve", "ddp"),
                    default="train",
                    help="train: the flagship device-train metric (driver "
                         "default); stream: NetCDF disk-streaming loader "
@@ -508,7 +683,18 @@ def main(argv=None) -> None:
                         "trained identically; serve: open-loop Poisson "
                         "latency-percentile bench of the serve/ request "
                         "path (admission + micro-batching + bucketed AOT "
-                        "engine)")
+                        "engine); ddp: per-strategy DDP comms bench — one "
+                        "JSON line per gradient-communication strategy on "
+                        "the full-device mesh (images/sec, scaling "
+                        "efficiency vs 1 device, wire bytes, parity drift "
+                        "vs pmean; real chips or "
+                        "--xla_force_host_platform_device_count fakes)")
+    p.add_argument("--ddp_comm", choices=("all", "pmean", "sharded", "bf16"),
+                   default="all",
+                   help="ddp mode: which gradient-communication "
+                        "strategy(ies) to measure (parallel/collectives.py; "
+                        "default all three — scripts/bench_matrix.py "
+                        "selects one per row)")
     p.add_argument("--num_workers", type=int, default=0,
                    help="stream mode: readahead threads")
     p.add_argument("--offered_rps", type=float, default=500.0,
@@ -555,10 +741,14 @@ def main(argv=None) -> None:
             if getattr(a, dest) != p.get_default(dest):
                 p.error(f"--{dest} {getattr(a, dest)} is a serve-mode "
                         f"knob; --mode {a.mode} never reads it")
+    if a.mode != "ddp" and a.ddp_comm != "all":
+        p.error(f"--ddp_comm {a.ddp_comm} is a ddp-mode knob; "
+                f"--mode {a.mode} never reads it")
     if a.epochs is None:   # per-mode default, a sentinel rather than a
         # value compare so an EXPLICIT --epochs 400 in accuracy mode is
         # honored instead of silently remapped
-        a.epochs = ACCURACY_EPOCHS if a.mode == "accuracy" else FUSED_EPOCHS
+        a.epochs = (ACCURACY_EPOCHS if a.mode == "accuracy"
+                    else DDP_EPOCHS if a.mode == "ddp" else FUSED_EPOCHS)
     if a.epochs < 1:
         p.error("--epochs must be >= 1")
     if a.batch_size < 1:
@@ -570,8 +760,12 @@ def main(argv=None) -> None:
     # default change can't desynchronize this check (ADVICE r3).
     if a.mode != "train":
         # accuracy mode READS the variant config (it trains the resolved
-        # flagless variant); it still rejects the knobs it never consults
+        # flagless variant); it still rejects the knobs it never consults.
+        # ddp mode reads batch_size (per-chip) + epochs + ddp_comm and
+        # fixes the rest (xla kernel, f32 — the strategy is the variant).
         blocked = (("unroll", "ring", "batch_size") if a.mode == "accuracy"
+                   else ("kernel", "dtype", "impl", "superstep", "unroll",
+                         "ring") if a.mode == "ddp"
                    else ("kernel", "dtype", "impl", "superstep", "unroll",
                          "ring", "batch_size"))
         for dest in blocked:
@@ -656,6 +850,8 @@ def main(argv=None) -> None:
         return _eval_bench(a)
     if a.mode == "serve":
         return _serve_bench(a)
+    if a.mode == "ddp":
+        return _ddp_bench(a)
 
     from pytorch_ddp_mnist_tpu.data import synthetic_mnist
     from pytorch_ddp_mnist_tpu.models import init_mlp

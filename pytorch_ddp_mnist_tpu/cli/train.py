@@ -266,6 +266,24 @@ def main(argv=None) -> int:
                 f"got {tcfg['batch_size']} — use --kernel pallas instead")
     if tcfg["fused"] and not tcfg["cached"]:
         raise SystemExit("--fused fuses the epoch scan; add --cached")
+    if tcfg["ddp_comm"] != "pmean":
+        # the comm strategies are per-step XLA collectives over the 'dp'
+        # mesh — meaningless serially, and the whole-epoch kernel owns its
+        # allreduce in-kernel (--kernel pallas_epoch's ICI ring)
+        if not tcfg["parallel"]:
+            raise SystemExit(
+                f"--ddp_comm {tcfg['ddp_comm']} selects the DDP gradient "
+                f"collective; it needs --parallel")
+        if tcfg["kernel"] == "pallas_epoch":
+            raise SystemExit(
+                f"--ddp_comm {tcfg['ddp_comm']} selects the per-step XLA "
+                f"gradient collective; --kernel pallas_epoch performs its "
+                f"allreduce IN-kernel (the ICI ring) and never reads it")
+    if tcfg["bf16_rounding"] != "nearest" and tcfg["ddp_comm"] != "bf16":
+        raise SystemExit(
+            f"--bf16_rounding {tcfg['bf16_rounding']} rounds the bf16 "
+            f"strategy's wire cast; --ddp_comm {tcfg['ddp_comm']} never "
+            f"casts — use --ddp_comm bf16")
     if not 0 <= tcfg["start_epoch"] <= tcfg["n_epochs"]:
         raise SystemExit(f"--start_epoch {tcfg['start_epoch']} outside "
                          f"[0, {tcfg['n_epochs']}] (n_epochs is the TOTAL "
@@ -387,10 +405,13 @@ def main(argv=None) -> int:
                 from ..ops.pallas_step import make_pallas_dp_train_step
                 train_step = make_pallas_dp_train_step(
                     mesh, tcfg["lr"], interpret=_pallas_interpret(),
-                    dtype=tcfg["dtype"])
+                    dtype=tcfg["dtype"], comm=tcfg["ddp_comm"],
+                    bf16_rounding=tcfg["bf16_rounding"])
             else:
-                train_step = make_dp_train_step(mesh, tcfg["lr"],
-                                                dtype=tcfg["dtype"])
+                train_step = make_dp_train_step(
+                    mesh, tcfg["lr"], dtype=tcfg["dtype"],
+                    comm=tcfg["ddp_comm"],
+                    bf16_rounding=tcfg["bf16_rounding"])
         put = lambda b: global_batch_from_local(mesh, b)  # noqa: E731
         num_shards = mesh.devices.size  # data sharding is per-device
         local_shards = len(jax.local_devices())
@@ -604,7 +625,8 @@ def main(argv=None) -> int:
                               mesh=mesh, dtype=tcfg["dtype"],
                               kernel=tcfg["kernel"],
                               interpret=use_pallas and _pallas_interpret(),
-                              fused=tcfg["fused"],
+                              fused=tcfg["fused"], comm=tcfg["ddp_comm"],
+                              bf16_rounding=tcfg["bf16_rounding"],
                               log=log, epoch_hook=hook, start_epoch=start,
                               eval_perm=eval_perm)
     else:

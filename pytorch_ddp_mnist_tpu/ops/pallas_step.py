@@ -1258,26 +1258,38 @@ def make_pallas_train_step(lr: float, *, interpret: bool = False,
 
 
 def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False,
-                              dtype: str = "float32"):
+                              dtype: str = "float32", comm: str = "pmean",
+                              bf16_rounding: str = "nearest"):
     """SPMD data-parallel fused step over the 'dp' mesh — the
-    parallel.ddp.make_dp_train_step shape (per-replica kernel, pmean'd
-    grads, redundant SGD) with the Pallas kernel as the local compute.
-    dtype='bfloat16' as in make_pallas_train_step."""
+    parallel.ddp.make_dp_train_step shape (per-replica kernel, grads through
+    the selected comm strategy) with the Pallas kernel as the local compute.
+    dtype='bfloat16' as in make_pallas_train_step; `comm` as in
+    parallel/collectives.py (pmean / sharded / bf16)."""
     from jax.sharding import PartitionSpec as P
     from ..compat import shard_map
+    from ..parallel import collectives
     from ..parallel.mesh import DATA_AXIS
     from .sgd import sgd_step
 
+    collectives.validate_comm(comm)
+    collectives.validate_bf16_rounding(bf16_rounding, comm)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    n_dev = int(mesh.devices.size)
 
     def _shard_fn(params, sub, x, y):
         rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
         mask = dropout_mask(rkey, x.shape[0])
         loss, grads = fused_loss_and_grads(params, x.astype(compute_dt), y,
                                            mask, interpret=interpret)
-        grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
         loss = jax.lax.pmean(loss, DATA_AXIS)
-        return grads, loss
+        if comm == "pmean":
+            grads = jax.lax.pmean(grads, DATA_AXIS)  # the DDP allreduce-mean
+            return grads, loss
+        rnd = (jax.random.fold_in(rkey, 7)
+               if bf16_rounding == "stochastic" else None)
+        params = collectives.apply_gradients(params, grads, lr, DATA_AXIS,
+                                             comm, n_dev, rounding_key=rnd)
+        return params, loss
 
     # check_vma=False: grads come out of the kernel, not an autodiff
     # transpose, so shard_map's replication tracking (the reason ddp.py
@@ -1289,9 +1301,18 @@ def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False,
         out_specs=(P(), P()), check_vma=False)
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, key, x, y):
+    def jitted(params, key, x, y):
         key, sub = jax.random.split(key)
-        grads, loss = sharded(params, sub, x, y)
-        return sgd_step(params, grads, lr), key, loss
+        out, loss = sharded(params, sub, x, y)
+        if comm == "pmean":
+            return sgd_step(params, out, lr), key, loss
+        return out, key, loss
 
+    def step(params, key, x, y):
+        return jitted(params, key, x, y)
+
+    # same telemetry metadata contract as parallel.ddp.make_dp_train_step
+    step.ddp_comm = comm
+    step.ddp_mesh = mesh
+    step.ddp_devices = n_dev
     return step

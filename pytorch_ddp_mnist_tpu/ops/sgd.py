@@ -13,3 +13,11 @@ def sgd_step(params, grads, lr: float):
     """params <- params - lr * grads, elementwise over the pytree."""
     return jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
                                   params, grads)
+
+
+def sgd_step_flat(flat_params, flat_grads, lr: float):
+    """The sharded-update variant: the same `p - lr*g` math on ONE flat
+    (n,) slice — the 1/N shard each device owns after the reduce-scatter
+    in `parallel.collectives.sharded_update`. Kept beside `sgd_step` so
+    the two spellings of the optimizer can never drift apart."""
+    return flat_params - lr * flat_grads.astype(flat_params.dtype)

@@ -1,4 +1,6 @@
 from .sampler import ShardedSampler
 from .mesh import make_mesh, data_parallel_mesh
+from .collectives import STRATEGIES as COMM_STRATEGIES
 
-__all__ = ["ShardedSampler", "make_mesh", "data_parallel_mesh"]
+__all__ = ["ShardedSampler", "make_mesh", "data_parallel_mesh",
+           "COMM_STRATEGIES"]

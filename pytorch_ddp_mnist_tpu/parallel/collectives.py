@@ -1,0 +1,276 @@
+"""Gradient-communication strategies for the DDP step — one interface,
+three selectable programs.
+
+The reference's DDP step (ddp_tutorial_multi_gpu.py:94) allreduce-means the
+full float32 gradient every step and then runs the SGD update REDUNDANTLY on
+every rank. That shape is the baseline here (`pmean`), and two measured
+alternatives sit behind the same switch:
+
+  * `pmean`    — the naive baseline: one full-gradient f32
+    `jax.lax.pmean`, replicated SGD update on every device. Exact DDP
+    semantics; the bitwise anchor every other strategy is pinned against.
+  * `sharded`  — the reduce-scatter → sharded-update → all-gather pattern
+    of "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training" (arXiv:2004.13336, PAPERS.md): gradients are flattened into
+    device-count-aligned buckets, each bucket is `psum_scatter`ed so every
+    device owns 1/N of the mean gradient, the SGD update runs ONLY on that
+    shard (`ops.sgd.sgd_step_flat` — update FLOPs and HBM traffic cut by
+    1/N), and the fresh params are `all_gather`ed back. Same reduction
+    tree as an allreduce, so parity with `pmean` holds to f32
+    reduction-order tolerance (pinned at rtol 1e-6 by test).
+  * `bf16`     — compressed allreduce in the EQuARX spirit
+    (arXiv:2506.17615): gradients are cast to bfloat16 before the reduce,
+    so the wire carries HALF the bytes AND the allreduce sums in bf16;
+    the mean, SGD update, and master params stay float32. Optional
+    stochastic rounding of the cast (`stochastic_round_bf16`,
+    `bf16_rounding="stochastic"` / CLI `--bf16_rounding`) de-biases the
+    quantization. Numeric drift vs `pmean` is bounded and pinned by test
+    (note the bf16 REDUCTION error grows with device count — re-pin the
+    bound before leaning on it past ~dozens of replicas).
+
+All three run inside a `shard_map` body over the 'dp' axis; `parallel/ddp.py`
+and `train/scan.py` select them via `comm=` / the CLI's `--ddp_comm`, and
+`bench.py --mode ddp` measures all three on the same mesh.
+
+Wire-byte accounting (`bytes_on_wire`) uses the ring-collective cost model:
+per device per step, a ring allreduce of M bytes moves 2*(N-1)/N*M, a
+reduce-scatter or all-gather moves (N-1)/N*M. Under that model `sharded`
+moves the same bytes as `pmean` (RS grads + AG params == allreduce) — its
+win is the 1/N update and HBM traffic, plus near-halved bytes wherever XLA
+lowers small allreduces as all-gather + local reduce — while `bf16` halves
+the wire outright. docs/PERF.md §DDP gradient communication carries the
+worked numbers for the 118,272-param MLP.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.sgd import sgd_step, sgd_step_flat
+
+STRATEGIES = ("pmean", "sharded", "bf16")
+
+# Bucket granularity for the sharded-update flatten: leaves are packed
+# greedily into buckets of at most this many elements (16 MiB of f32 —
+# the torch-DDP 25 MB bucket idea, sized down for TPU-core VMEM comfort).
+# The 118k-param MLP packs into ONE bucket; the knob exists so the
+# machinery is general and the multi-bucket path stays testable.
+DEFAULT_BUCKET_ELEMS = 4 * 1024 * 1024
+
+
+def validate_comm(comm: str) -> None:
+    """Reject unknown strategies by name — the single source of truth the
+    CLI, bench, and step builders all funnel through."""
+    if comm not in STRATEGIES:
+        raise ValueError(f"unknown DDP comm strategy {comm!r}; "
+                         f"choose one of {STRATEGIES}")
+
+
+def validate_bf16_rounding(bf16_rounding: str, comm: str) -> None:
+    """The bf16 strategy's rounding mode knob: 'nearest' (default — the
+    plain round-to-nearest-even cast) or 'stochastic'
+    (stochastic_round_bf16, unbiased in expectation). Rejected by name on
+    any other strategy rather than silently ignored (the unroll lesson)."""
+    if bf16_rounding not in ("nearest", "stochastic"):
+        raise ValueError(f"bf16_rounding must be 'nearest' or 'stochastic';"
+                         f" got {bf16_rounding!r}")
+    if bf16_rounding == "stochastic" and comm != "bf16":
+        raise ValueError(
+            f"bf16_rounding='stochastic' rounds the bf16 strategy's wire "
+            f"cast; comm={comm!r} never casts — use comm='bf16'")
+
+
+def _leaf_buckets(leaves, bucket_elems: int):
+    """Greedy static partition of leaf INDICES into buckets of at most
+    `bucket_elems` elements (a leaf larger than the budget gets its own
+    bucket). Pure host math over static shapes — identical on every
+    device, so the bucketization itself never needs communication."""
+    buckets, cur = [[]], 0
+    for i, leaf in enumerate(leaves):
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        if buckets[-1] and cur + size > bucket_elems:
+            buckets.append([])
+            cur = 0
+        buckets[-1].append(i)
+        cur += size
+    return buckets
+
+
+def padded_size(n: int, n_devices: int) -> int:
+    """`n` rounded up to a multiple of `n_devices` (the reduce-scatter
+    alignment pad)."""
+    return -(-n // n_devices) * n_devices
+
+
+def bytes_on_wire(params_or_count, n_devices: int, comm: str, *,
+                  bucket_elems: int = DEFAULT_BUCKET_ELEMS) -> int:
+    """Analytic per-device per-step wire bytes under the ring-collective
+    cost model (module docstring). `params_or_count` is the params pytree
+    (bucket padding is then exact) or a plain element count.
+
+    1-device meshes communicate nothing (the pmean is the identity)."""
+    validate_comm(comm)
+    n = int(n_devices)
+    if n <= 1:
+        return 0
+    if isinstance(params_or_count, (int, np.integer)):
+        n_params = int(params_or_count)
+        padded = padded_size(n_params, n)
+    else:
+        leaves = jax.tree_util.tree_leaves(params_or_count)
+        n_params = sum(int(np.prod(l.shape)) if l.shape else 1
+                       for l in leaves)
+        padded = sum(padded_size(
+            sum(int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
+                for i in bucket), n)
+            for bucket in _leaf_buckets(leaves, bucket_elems))
+    ring = (n - 1) / n
+    if comm == "pmean":
+        return int(2 * ring * 4 * n_params)        # f32 allreduce
+    if comm == "sharded":
+        # RS of grads + AG of params, both over the padded buckets.
+        return int(2 * ring * 4 * padded)
+    return int(2 * ring * 2 * n_params)            # bf16 allreduce
+
+
+def stochastic_round_bf16(key: jax.Array, x: jax.Array) -> jax.Array:
+    """Stochastically round an f32 array to bfloat16: add uniform random
+    bits below the bf16 mantissa cut, then truncate. Unbiased in
+    expectation (E[round(x)] == x), unlike round-to-nearest-even which
+    systematically loses sub-ulp gradient mass — the EQuARX de-biasing
+    trick, exposed for the `bf16` strategy's opt-in rounding mode."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(rounded, jnp.float32).astype(
+        jnp.bfloat16)
+
+
+def bf16_allreduce_mean(grads, axis_name: str, n_devices: int, *,
+                        rounding_key: jax.Array | None = None):
+    """Compressed allreduce-mean: cast each gradient leaf to bf16 (the wire
+    carries 2 bytes/element; the `psum` itself also reduces in bf16 — that
+    is where the wire saving comes from), then take the mean in FLOAT32 so
+    the SGD update and master params stay full precision. `rounding_key`
+    opts into stochastic rounding of the cast (one subkey per leaf)."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if rounding_key is not None:
+        keys = jax.random.split(rounding_key, len(leaves))
+        cast = [stochastic_round_bf16(k, g) for k, g in zip(keys, leaves)]
+    else:
+        cast = [g.astype(jnp.bfloat16) for g in leaves]
+    reduced = [jax.lax.psum(g, axis_name).astype(jnp.float32) / n_devices
+               for g in cast]
+    return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def sharded_update(params, grads, lr: float, axis_name: str,
+                   n_devices: int, *,
+                   bucket_elems: int = DEFAULT_BUCKET_ELEMS):
+    """reduce-scatter → sharded SGD → all-gather, per bucket (the
+    arXiv:2004.13336 pattern; module docstring).
+
+    Must run inside a shard_map body over `axis_name` with per-device
+    (device-varying) `grads` and replicated `params`; returns the fresh
+    params, identical on every device (the all-gather re-replicates)."""
+    p_leaves, treedef = jax.tree_util.tree_flatten(params)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    assert len(p_leaves) == len(g_leaves), "params/grads tree mismatch"
+    me = jax.lax.axis_index(axis_name)
+    new_leaves: list = [None] * len(p_leaves)
+    for bucket in _leaf_buckets(p_leaves, bucket_elems):
+        flat_g = jnp.concatenate(
+            [g_leaves[i].reshape(-1).astype(jnp.float32) for i in bucket])
+        flat_p = jnp.concatenate([p_leaves[i].reshape(-1) for i in bucket])
+        n = flat_p.size
+        shard = padded_size(n, n_devices) // n_devices
+        pad = shard * n_devices - n
+        if pad:
+            flat_g = jnp.concatenate([flat_g, jnp.zeros(pad, flat_g.dtype)])
+            flat_p = jnp.concatenate([flat_p, jnp.zeros(pad, flat_p.dtype)])
+        # Each device leaves the reduce-scatter owning 1/N of the SUM;
+        # the /N makes it the DDP mean. The update then touches only this
+        # device's shard — 1/N of the FLOPs and HBM traffic of the
+        # redundant replicated update.
+        g_shard = jax.lax.psum_scatter(
+            flat_g, axis_name, scatter_dimension=0, tiled=True) / n_devices
+        p_shard = jax.lax.dynamic_slice(flat_p, (me * shard,), (shard,))
+        fresh = sgd_step_flat(p_shard, g_shard, lr)
+        flat_new = jax.lax.all_gather(fresh, axis_name, tiled=True)
+        off = 0
+        for i in bucket:
+            size = p_leaves[i].size
+            new_leaves[i] = flat_new[off:off + size].reshape(
+                p_leaves[i].shape)
+            off += size
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def apply_gradients(params, grads, lr: float, axis_name: str, comm: str,
+                    n_devices: int, *,
+                    rounding_key: jax.Array | None = None,
+                    bucket_elems: int = DEFAULT_BUCKET_ELEMS):
+    """The one entry point: local per-device `grads` in, fresh replicated
+    params out, via the selected communication strategy. Runs inside a
+    shard_map body over `axis_name`."""
+    validate_comm(comm)
+    if comm == "sharded":
+        return sharded_update(params, grads, lr, axis_name, n_devices,
+                              bucket_elems=bucket_elems)
+    if comm == "bf16":
+        mean = bf16_allreduce_mean(grads, axis_name, n_devices,
+                                   rounding_key=rounding_key)
+    else:
+        mean = jax.lax.pmean(grads, axis_name)
+    return sgd_step(params, mean, lr)
+
+
+# ---------------------------------------------------------------------------
+# The comm probe: an isolated, timeable program of JUST the gradient
+# communication a strategy performs. The in-step collective overlaps with
+# compute inside one XLA program and is not host-observable without the
+# profiler; the probe runs the same collective pattern on a params-shaped
+# tree so `ddp.collective_s` reports an honest isolated comms cost.
+# ---------------------------------------------------------------------------
+
+
+def make_comm_probe(mesh, comm: str):
+    """Jitted (params-shaped tree) -> reduced tree program of the
+    strategy's communication pattern over `mesh`'s 'dp' axis."""
+    from jax.sharding import PartitionSpec as P
+    from ..compat import shard_map
+    from .mesh import DATA_AXIS
+    validate_comm(comm)
+    n_dev = int(mesh.devices.size)
+
+    def body(tree):
+        if comm == "sharded":
+            # RS + sharded touch + AG — the sharded strategy's wire pattern
+            # (the O(1/N) update itself is deliberately included: it is
+            # negligible by construction, which the probe demonstrates).
+            return sharded_update(tree, tree, 0.0, DATA_AXIS, n_dev)
+        if comm == "bf16":
+            return bf16_allreduce_mean(tree, DATA_AXIS, n_dev)
+        return jax.lax.pmean(tree, DATA_AXIS)
+
+    sharded_body = shard_map(body, mesh=mesh, in_specs=(P(),),
+                             out_specs=P(), check_vma=False)
+    return jax.jit(sharded_body)
+
+
+def measure_collective_seconds(probe, params, reps: int = 3) -> list:
+    """Run a `make_comm_probe` program `reps` times and return per-rep
+    wall seconds (each rep blocked to completion). The first call compiles;
+    callers warm the probe once before timing — this helper does that
+    itself, so the returned list holds steady-state reps only."""
+    jax.block_until_ready(probe(params))      # compile + warm
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(probe(params))
+        out.append(time.perf_counter() - t0)
+    return out

@@ -21,11 +21,14 @@ tuning knobs.
 
 bfloat16: optional compute dtype for the fwd/bwd (MXU-native); params and the
 SGD update stay float32 (master weights).
+
+Gradient communication is strategy-selectable since round 9
+(`comm=` / `--ddp_comm`): the pmean baseline above, the reduce-scatter →
+sharded-update → all-gather pattern, or the bf16-compressed allreduce —
+see parallel/collectives.py for the three programs and their cost model.
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -50,53 +53,152 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32"):
-    """Build the jitted SPMD step: (params, key, x, y) -> (params', key', loss).
+def _mesh_axis_size(mesh) -> int:
+    """Device count of a Mesh OR an AbstractMesh (the export-lowering
+    surface builds the step program over a deviceless mesh)."""
+    try:
+        return int(mesh.devices.size)
+    except (AttributeError, ValueError):
+        # AbstractMesh raises ValueError("does not implement devices")
+        import numpy as np
+        return int(np.prod(list(mesh.shape.values())))
 
-    x: (global_batch, 784) sharded over 'dp'; params replicated; returned loss
-    is the global batch mean (= mean of per-replica means at equal local batch,
-    exactly DDP's effective loss).
+
+def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
+                    comm: str = "pmean", bf16_rounding: str = "nearest"):
+    """The un-jitted SPMD step program: (params, key, x, y) ->
+    (params', key', loss) over `mesh` (a Mesh, or an AbstractMesh for
+    client-side export lowering — tests/test_export_lowering.py).
+
+    `comm` selects the gradient-communication strategy
+    (parallel/collectives.py): 'pmean' (the reference-semantics baseline —
+    full f32 allreduce-mean + replicated update), 'sharded' (bucketized
+    reduce-scatter → 1/N sharded SGD → params all-gather), or 'bf16'
+    (compressed allreduce: bf16 wire + reduction, f32 mean/update).
+    `bf16_rounding='stochastic'` opts the bf16 cast into unbiased
+    stochastic rounding (per-step per-replica keys off the dropout chain).
     """
+    from . import collectives
+    collectives.validate_comm(comm)
+    collectives.validate_bf16_rounding(bf16_rounding, comm)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    n_dev = _mesh_axis_size(mesh)
 
     def _local(params, x, y, rkey):
         logits = mlp_apply(params, x.astype(compute_dt), train=True,
                            dropout_key=rkey)
         return cross_entropy(logits, y)
 
-    def _shard_fn(params, sub, x, y):
-        # Mark params device-varying: each replica differentiates its OWN
-        # copy, so the cotangent stays local and the allreduce below is the
-        # ONLY cross-device grad reduction (without this, shard_map's
-        # replicated-input transpose auto-psums grads — a sum, not DDP's
-        # mean, and doubled up with ours).
-        params = _pvary(params, DATA_AXIS)
-        # Distinct dropout stream per replica — parity item 4.
-        rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
-        loss, grads = jax.value_and_grad(_local)(params, x, y, rkey)
-        grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
-        loss = jax.lax.pmean(loss, DATA_AXIS)
-        return grads, loss
+    if comm == "pmean":
+        def _shard_fn(params, sub, x, y):
+            # Mark params device-varying: each replica differentiates its
+            # OWN copy, so the cotangent stays local and the allreduce
+            # below is the ONLY cross-device grad reduction (without this,
+            # shard_map's replicated-input transpose auto-psums grads — a
+            # sum, not DDP's mean, and doubled up with ours).
+            params = _pvary(params, DATA_AXIS)
+            # Distinct dropout stream per replica — parity item 4.
+            rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
+            loss, grads = jax.value_and_grad(_local)(params, x, y, rkey)
+            grads = jax.lax.pmean(grads, DATA_AXIS)  # the DDP allreduce-mean
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            return grads, loss
+    else:
+        def _shard_fn(params, sub, x, y):
+            # Same local fwd/bwd as the pmean path (pvary note above);
+            # only the grads' trip across the wire — and where the SGD
+            # update runs — changes with the strategy.
+            params = _pvary(params, DATA_AXIS)
+            rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
+            loss, grads = jax.value_and_grad(_local)(params, x, y, rkey)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            # per-step per-replica rounding noise off the dropout chain
+            # (distinct per replica so cast errors decorrelate in the sum)
+            rnd = (jax.random.fold_in(rkey, 7)
+                   if bf16_rounding == "stochastic" else None)
+            params = collectives.apply_gradients(
+                params, grads, lr, DATA_AXIS, comm, n_dev,
+                rounding_key=rnd)
+            return params, loss
 
+    # check_vma only on the pmean path: the sharded/bf16 bodies end in
+    # all_gather/psum programs whose outputs are value-replicated but not
+    # provably so to the static replication checker; their cross-strategy
+    # parity (and therefore replication) is pinned by test instead.
     sharded = shard_map(
         _shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P()))
+        out_specs=(P(), P()), check_vma=comm == "pmean")
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    if comm == "pmean":
+        def program(params, key, x, y):
+            key, sub = jax.random.split(key)
+            grads, loss = sharded(params, sub, x, y)
+            # Redundant-per-replica optimizer (DDP semantics): params and
+            # grads are both replicated, XLA fuses this update into the
+            # step program.
+            return sgd_step(params, grads, lr), key, loss
+    else:
+        def program(params, key, x, y):
+            key, sub = jax.random.split(key)
+            new_params, loss = sharded(params, sub, x, y)
+            return new_params, key, loss
+
+    return program
+
+
+def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
+                       comm: str = "pmean",
+                       bf16_rounding: str = "nearest"):
+    """Build the jitted SPMD step: (params, key, x, y) -> (params', key', loss).
+
+    x: (global_batch, 784) sharded over 'dp'; params replicated; returned loss
+    is the global batch mean (= mean of per-replica means at equal local batch,
+    exactly DDP's effective loss). `comm` selects the gradient-communication
+    strategy (see dp_step_program / parallel/collectives.py).
+
+    The returned step carries metadata the train loop's telemetry reads:
+    `.ddp_comm` (strategy), `.ddp_mesh`, `.ddp_devices` — the
+    `ddp.bytes_on_wire` / `ddp.collective_s` wiring in train/loop.py keys
+    off these without the loop having to know about meshes.
+    """
+    program = dp_step_program(mesh, lr, dtype=dtype, comm=comm,
+                              bf16_rounding=bf16_rounding)
+    jitted = jax.jit(program, donate_argnums=(0, 1))
+
     def step(params, key, x, y):
-        key, sub = jax.random.split(key)
-        grads, loss = sharded(params, sub, x, y)
-        # Redundant-per-replica optimizer (DDP semantics): params and grads
-        # are both replicated, XLA fuses this update into the step program.
-        return sgd_step(params, grads, lr), key, loss
+        return jitted(params, key, x, y)
 
+    step.ddp_comm = comm
+    step.ddp_mesh = mesh
+    step.ddp_devices = _mesh_axis_size(mesh)
     return step
 
 
+def _check_batch_divisible(n_rows: int, n_shards: int, what: str) -> None:
+    """A ragged final batch used to surface as an opaque XLA sharding error
+    deep inside device_put/make_array; name the numbers instead. Loaders in
+    this repo wrap-pad every batch to full size, so hitting this means a
+    hand-built batch — the fix is the caller's choice (drop, pad, or pick a
+    divisible batch size), not something to guess at silently here."""
+    if n_rows % n_shards:
+        raise ValueError(
+            f"{what}: batch of {n_rows} rows does not divide over "
+            f"{n_shards} device(s) of the 'dp' mesh — use a batch size "
+            f"divisible by {n_shards}, or pad/drop the ragged final batch "
+            f"(the BatchLoader/NetCDFShardLoader wrap-pad does this)")
+
+
 def shard_batch(mesh: Mesh, batch):
-    """Place a host batch pytree with leading-dim 'dp' sharding."""
+    """Place a host batch pytree with leading-dim 'dp' sharding.
+
+    Raises ValueError (naming batch size and device count) for a leading
+    dim not divisible by the mesh size, instead of the opaque XLA sharding
+    error that used to escape."""
     s = batch_sharding(mesh)
+    n_shards = int(mesh.devices.size)
+    for leaf in jax.tree_util.tree_leaves(batch):
+        _check_batch_divisible(int(leaf.shape[0]), n_shards, "shard_batch")
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, s), batch)
 
 
@@ -109,9 +211,18 @@ def global_batch_from_local(mesh: Mesh, local_batch):
     reads just its sampler shard, mnist_pnetcdf_cpu_mp.py:32,46) and the
     runtime stitches the shards into one logical array for the SPMD step.
     In a single-process run it degrades to a plain sharded device_put.
+
+    A local batch whose row count does not divide over this process's mesh
+    devices raises a ValueError naming the sizes (the ragged-final-batch
+    fix — previously an opaque XLA sharding error).
     """
     import numpy as np
     s = batch_sharding(mesh)
+    local_shards = int(mesh.local_mesh.devices.size)
+    for leaf in jax.tree_util.tree_leaves(local_batch):
+        _check_batch_divisible(int(np.asarray(leaf).shape[0]), local_shards,
+                               "global_batch_from_local (this process's "
+                               "local shard)")
     return jax.tree_util.tree_map(
         lambda a: jax.make_array_from_process_local_data(s, np.asarray(a)),
         local_batch)

@@ -53,6 +53,28 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--seed", type=int, default=0)
     t.add_argument("--parallel", action="store_true",
                    help="data-parallel over the device mesh (DDP analog)")
+    t.add_argument("--ddp-comm", "--ddp_comm", dest="ddp_comm",
+                   choices=("pmean", "sharded", "bf16"), default="pmean",
+                   help="gradient-communication strategy for --parallel "
+                        "(parallel/collectives.py): pmean (default — the "
+                        "reference DDP shape: full f32 allreduce-mean + "
+                        "replicated SGD update), sharded (bucketized "
+                        "reduce-scatter, SGD on each device's 1/N shard, "
+                        "params all-gather — 1/N update FLOPs/HBM; parity "
+                        "with pmean to f32 reduction-order tolerance), or "
+                        "bf16 (compressed allreduce: bf16 wire bytes AND "
+                        "bf16 reduction, f32 mean/update against f32 "
+                        "master params — bounded drift, pinned by test). "
+                        "Telemetry reports "
+                        "ddp.bytes_on_wire / ddp.collective_s per strategy")
+    t.add_argument("--bf16_rounding", choices=("nearest", "stochastic"),
+                   default="nearest",
+                   help="--ddp_comm bf16 only: how gradients round into "
+                        "the bf16 wire cast — nearest (default, round-to-"
+                        "nearest-even) or stochastic (unbiased stochastic "
+                        "rounding, per-step per-replica noise; "
+                        "parallel/collectives.stochastic_round_bf16). "
+                        "Rejected by name on other strategies")
     t.add_argument("--wireup_method", choices=WIREUP_CHOICES, default="auto")
     t.add_argument("--num_workers", type=int, default=0,
                    help="readahead threads for the --netcdf streaming loader "
@@ -197,7 +219,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     return {
         "trainer": {
             "batch_size": a.batch_size, "n_epochs": a.n_epochs, "lr": a.lr,
-            "seed": a.seed, "parallel": a.parallel,
+            "seed": a.seed, "parallel": a.parallel, "ddp_comm": a.ddp_comm,
+            "bf16_rounding": a.bf16_rounding,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
             "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,

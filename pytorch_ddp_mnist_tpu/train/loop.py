@@ -238,6 +238,48 @@ def epoch_summary(epoch: int, losses: np.ndarray, batch_size: int,
             f"acc={val_acc:.4f} {imgs / dt:.0f} img/s{io}]")
 
 
+def make_ddp_comm_recorder(mesh, comm: str, n_devices: int, params):
+    """Per-epoch recorder for the DDP gradient-communication metrics —
+    shared by the streaming `fit` and the epoch-scanned `fit_cached` so the
+    two paths can never report different units.
+
+    Always (cheap host math): `ddp.bytes_on_wire` — a registry counter of
+    cumulative analytic per-device wire bytes (ring cost model,
+    parallel/collectives.bytes_on_wire). Only when telemetry is ENABLED
+    (the zero-per-step-host-sync invariant stays intact — and this much is
+    once per EPOCH, not per step): run the isolated comm probe
+    (collectives.make_comm_probe — the strategy's collective pattern on a
+    params-shaped tree), record the reps into the `ddp.collective_s`
+    histogram, and emit a `ddp_comm_probe` child span so trace reports
+    show comms cost beside step_compute.
+    """
+    from ..parallel import collectives
+    from ..telemetry import get_registry
+    from ..telemetry.events import NullTracer
+
+    bytes_step = collectives.bytes_on_wire(params, n_devices, comm)
+    reg = get_registry()
+    wire = reg.counter("ddp.bytes_on_wire")
+    hist = reg.histogram("ddp.collective_s")
+    box = {}
+
+    def record(steps: int, params) -> None:
+        wire.inc(steps * bytes_step)
+        tracer = get_tracer()
+        if isinstance(tracer, NullTracer):
+            return
+        if "probe" not in box:
+            box["probe"] = collectives.make_comm_probe(mesh, comm)
+        secs = collectives.measure_collective_seconds(box["probe"], params)
+        for s in secs:
+            hist.record(s)
+        tracer.complete_span("ddp_comm_probe", sum(secs), strategy=comm,
+                             reps=len(secs), steps=steps,
+                             bytes_on_wire_per_step=bytes_step)
+
+    return record
+
+
 class _LiveLoss:
     """Per-step live loss for the progress bar WITHOUT per-step syncs.
 
@@ -312,6 +354,12 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
     tracer = get_tracer()  # NullTracer unless --telemetry enabled it
+    # DP steps carry their comm strategy as metadata (parallel/ddp.py):
+    # wire up the ddp.* metrics without the loop knowing about meshes.
+    ddp_record = None
+    if getattr(step, "ddp_comm", None) is not None:
+        ddp_record = make_ddp_comm_recorder(
+            step.ddp_mesh, step.ddp_comm, step.ddp_devices, params)
     for epoch in range(start_epoch, epochs):
         # Per-epoch trace span with the phase split the reference's
         # ancestral I/O harness existed to report (SURVEY.md §5.1):
@@ -354,6 +402,8 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                            batch_size,
                            perm=eval_perm(epoch) if eval_perm else None)
             tracer.complete_span("eval", time.perf_counter() - t_eval)
+            if ddp_record is not None:
+                ddp_record(len(losses), params)
             log(epoch_summary(epoch, losses, batch_size, val,
                               time.perf_counter() - t0,
                               io_seconds=io_timer.total))

@@ -44,7 +44,8 @@ from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
 from ..telemetry.events import get_tracer
-from .loop import (TrainState, epoch_summary, evaluate, make_eval_step,
+from .loop import (TrainState, epoch_summary, evaluate,
+                   make_ddp_comm_recorder, make_eval_step,
                    make_snapshot_eval_step, val_summary)
 
 
@@ -377,10 +378,14 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
 
 
 def _dp_step_body(x_all, y_all, me, lr, compute_dt, kernel="xla",
-                  interpret=False):
+                  interpret=False, comm="pmean", n_dev=1,
+                  bf16_rounding="nearest"):
     """The shared per-step scan body of the DP programs: gather this
-    replica's rows, fwd/bwd with a replica-distinct dropout key, pmean grads
-    (the DDP allreduce), SGD."""
+    replica's rows, fwd/bwd with a replica-distinct dropout key, then the
+    selected gradient-communication strategy (`comm`,
+    parallel/collectives.py) — pmean + replicated SGD (the DDP baseline),
+    reduce-scatter + sharded update + all-gather, or bf16-compressed
+    allreduce."""
 
     def body(carry, batch_idx):
         params, key = carry
@@ -389,28 +394,40 @@ def _dp_step_body(x_all, y_all, me, lr, compute_dt, kernel="xla",
         x = _gathered_x(x_all, batch_idx, compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
         loss, grads = _loss_and_grads(params, x, y, rkey, kernel, interpret)
-        grads = jax.lax.pmean(grads, DATA_AXIS)   # the DDP allreduce-mean
         loss = jax.lax.pmean(loss, DATA_AXIS)
-        return (sgd_step(params, grads, lr), key), loss
+        if comm == "pmean":
+            grads = jax.lax.pmean(grads, DATA_AXIS)  # the DDP allreduce-mean
+            params = sgd_step(params, grads, lr)
+        else:
+            from ..parallel import collectives
+            rnd = (jax.random.fold_in(rkey, 7)
+                   if bf16_rounding == "stochastic" else None)
+            params = collectives.apply_gradients(
+                params, grads, lr, DATA_AXIS, comm, n_dev,
+                rounding_key=rnd)
+        return (params, key), loss
 
     return body
 
 
 def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
-                     kernel: str = "xla", interpret: bool = False) -> Callable:
+                     kernel: str = "xla", interpret: bool = False,
+                     comm: str = "pmean",
+                     bf16_rounding: str = "nearest") -> Callable:
     """SPMD epoch program over the 'dp' mesh.
 
     x_all/y_all replicated (each device holds the dataset and gathers its own
     rows — no data-movement collective); idx (nbatches, global_B) sharded on
-    dim 1 over 'dp'; per-step grads pmean'ed exactly like
-    parallel.ddp.make_dp_train_step. Dropout keys fold in the replica index
-    (independent masks per replica, SURVEY.md §7 item 4).
+    dim 1 over 'dp'; per-step gradient communication follows `comm` exactly
+    like parallel.ddp.make_dp_train_step. Dropout keys fold in the replica
+    index (independent masks per replica, SURVEY.md §7 item 4).
 
     One epoch is the one-element case of the fused multi-epoch program
     (tests prove the equivalence), so this just wraps make_dp_run_fn.
     """
     run = make_dp_run_fn(mesh, lr, dtype=dtype, kernel=kernel,
-                         interpret=interpret)
+                         interpret=interpret, comm=comm,
+                         bf16_rounding=bf16_rounding)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def epoch(params, key, x_all, y_all, idx):
@@ -423,7 +440,9 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
 def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                    kernel: str = "xla", interpret: bool = False,
                    snapshots: bool = False, unroll: int = 1,
-                   superstep: int = 1, ring: str = "auto") -> Callable:
+                   superstep: int = 1, ring: str = "auto",
+                   comm: str = "pmean",
+                   bf16_rounding: str = "nearest") -> Callable:
     """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
     (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
     sharded on the batch dim.
@@ -444,11 +463,25 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     `ring` (kernel='pallas_epoch', multi-device only) picks the in-kernel
     allreduce strategy — 'allgather' / 'reduce_scatter' / 'auto' (slot-
     budget switch); see ops.pallas_step.epoch_fused_sgd.
+
+    `comm` selects the per-step gradient communication
+    (parallel/collectives.py: 'pmean' / 'sharded' / 'bf16') for the
+    scan-body kernels; kernel='pallas_epoch' owns its comms in-kernel (the
+    ICI ring) and rejects a non-default comm by name.
     """
+    from ..parallel import collectives
+    from ..parallel.ddp import _mesh_axis_size
     _check_kernel(kernel, dtype)
     _check_superstep(superstep, kernel)
-    n_dev = int(mesh.devices.size)
+    n_dev = _mesh_axis_size(mesh)  # Mesh or AbstractMesh (export lowering)
     _check_ring(ring, kernel, n_dev)
+    collectives.validate_comm(comm)
+    collectives.validate_bf16_rounding(bf16_rounding, comm)
+    if comm != "pmean" and kernel == "pallas_epoch":
+        raise ValueError(
+            f"comm={comm!r} selects the per-step XLA gradient collective; "
+            f"kernel 'pallas_epoch' performs its allreduce IN-kernel (the "
+            f"ICI ring — pick it with ring=) and never reads comm")
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     use_pallas = kernel.startswith("pallas")
 
@@ -516,7 +549,9 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
             params = _pvary(params, DATA_AXIS)
         me = jax.lax.axis_index(DATA_AXIS)
         body = _dp_step_body(x_all, y_all, me, lr, compute_dt,
-                             kernel=kernel, interpret=interpret)
+                             kernel=kernel, interpret=interpret,
+                             comm=comm, n_dev=n_dev,
+                             bf16_rounding=bf16_rounding)
 
         def epoch(carry, idx_e):
             carry, losses = jax.lax.scan(body, carry, idx_e, unroll=unroll)
@@ -524,16 +559,23 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
             return carry, out
 
         (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
-        params = jax.tree_util.tree_map(
-            lambda a: jax.lax.pmean(a, DATA_AXIS), params)
+        if comm == "pmean":
+            # per-replica lockstep copies: pmean re-replicates for output.
+            # The sharded/bf16 strategies end each step in an
+            # all-gather/psum whose outputs are already value-identical on
+            # every device — a further pmean would only add a run-final
+            # collective for nothing.
+            params = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, DATA_AXIS), params)
         if snapshots:
             losses, (p_snaps, k_snaps) = out
             # params snapshots are per-replica copies kept in lockstep by the
             # in-body allreduce: pmean re-replicates them for output. The key
             # evolves identically on every replica (pure split chain) and is
             # not a float — no reduction, it is already replicated.
-            p_snaps = jax.tree_util.tree_map(
-                lambda a: jax.lax.pmean(a, DATA_AXIS), p_snaps)
+            if comm == "pmean":
+                p_snaps = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, DATA_AXIS), p_snaps)
             return params, key, losses, (p_snaps, k_snaps)
         return params, key, out
 
@@ -541,7 +583,8 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     sharded = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, None, DATA_AXIS)),
-        out_specs=(P(),) * nout, check_vma=not use_pallas)
+        out_specs=(P(),) * nout,
+        check_vma=not use_pallas and comm == "pmean")
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def run(params, key, x_all, y_all, idxs):
@@ -554,7 +597,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                epochs: int, batch_size: int, lr: float,
                mesh: Optional[Mesh] = None, dtype: str = "float32",
                kernel: str = "xla", interpret: bool = False,
-               fused: bool = False,
+               fused: bool = False, comm: str = "pmean",
+               bf16_rounding: str = "nearest",
                log: Callable[[str], None] = print,
                epoch_hook: Callable | None = None,
                start_epoch: int = 0,
@@ -591,7 +635,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         x_all = replicate_state(mesh, resident_images(x_train))
         y_all = replicate_state(mesh, np.asarray(y_train, np.int32))
         epoch_fn = None if fused else make_dp_epoch_fn(
-            mesh, lr, dtype=dtype, kernel=kernel, interpret=interpret)
+            mesh, lr, dtype=dtype, kernel=kernel, interpret=interpret,
+            comm=comm, bf16_rounding=bf16_rounding)
         idx_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     else:
         x_all = jax.device_put(resident_images(x_train))
@@ -603,6 +648,14 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     # Test set to device once, not per epoch (mirrors loop.fit's hoist).
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
+    # DP runs publish the ddp.* comm metrics (same recorder as loop.fit) —
+    # except kernel='pallas_epoch', whose allreduce happens IN-kernel via
+    # its own ring strategy: the recorder's ring-model bytes and XLA-pmean
+    # probe would attribute a collective that program never runs.
+    ddp_record = (make_ddp_comm_recorder(mesh, comm,
+                                         int(mesh.devices.size), params)
+                  if mesh is not None and kernel != "pallas_epoch"
+                  else None)
 
     if fused:
         if epochs <= start_epoch:  # match the per-epoch loop's no-op
@@ -617,7 +670,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         idxs = np.stack(idxs)
         if mesh is not None:
             run = make_dp_run_fn(mesh, lr, dtype=dtype, kernel=kernel,
-                                 interpret=interpret, snapshots=True)
+                                 interpret=interpret, snapshots=True,
+                                 comm=comm, bf16_rounding=bf16_rounding)
             sh3 = NamedSharding(mesh, P(None, None, DATA_AXIS))
             idxs = jax.make_array_from_callback(
                 idxs.shape, sh3, lambda s, _i=idxs: _i[s])
@@ -634,6 +688,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         get_tracer().complete_span("fused_run", time.perf_counter() - t0,
                                    epochs=len(run_epochs),
                                    steps=int(losses.size))
+        if ddp_record is not None:
+            ddp_record(int(losses.size), params)
         # Replay ALL epochs' val lines from one vmapped eval program + one
         # fetch — per-epoch evaluate() calls here would cost E dispatch
         # round-trips (a full tunnel RTT each on a remote TPU).
@@ -675,6 +731,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                            batch_size,
                            perm=eval_perm(epoch) if eval_perm else None)
             tracer.complete_span("eval", time.perf_counter() - t_eval)
+            if ddp_record is not None:
+                ddp_record(int(losses.size), params)
             log(epoch_summary(epoch, losses, batch_size, val,
                               time.perf_counter() - t0))
             state = TrainState(params, key)

@@ -82,6 +82,18 @@ VARIANTS = [
       "--superstep", "8"]),
     ("bf16-matmul / whole-epoch kernel / superstep 8",
      ["--kernel", "pallas_epoch", "--dtype", "bfloat16", "--superstep", "8"]),
+    # The DDP comms axis (round 9): per-strategy gradient communication on
+    # the full-device mesh (parallel/collectives.py; bench --mode ddp).
+    # On a single chip the three strategies degenerate to the same
+    # no-collective program — these rows earn their keep in a MULTI-chip
+    # hardware window, where one queue pass measures all three (per-chip
+    # rate + scaling efficiency + parity drift land in the artifact line).
+    ("DDP comms / pmean baseline (full-mesh, per-step allreduce)",
+     ["--mode", "ddp", "--ddp_comm", "pmean"]),
+    ("DDP comms / sharded update (reduce-scatter + 1/N SGD + all-gather)",
+     ["--mode", "ddp", "--ddp_comm", "sharded"]),
+    ("DDP comms / bf16 compressed allreduce",
+     ["--mode", "ddp", "--ddp_comm", "bf16"]),
 ]
 
 # Single source of truth for the roofline math: bench.perf_fields — the

@@ -3,6 +3,9 @@
 
     python scripts/check_telemetry.py /tmp/obs            # a --telemetry dir
     python scripts/check_telemetry.py events.jsonl        # or one file
+    python scripts/check_telemetry.py --require ddp. DIR  # + metric gate:
+        # fail unless the trace's registry snapshot carries at least one
+        # metric per --require prefix (repeatable; the ddp-smoke contract)
 
 Exit 0 when every `events*.jsonl` is schema-valid; nonzero (with one line
 per violation on stderr) on malformed JSON, unknown schema version or kind,
@@ -155,8 +158,42 @@ def check_file(path: str, errors: list) -> int:
     return n
 
 
+def _snapshot_metric_names(path: str) -> set:
+    """All metric names appearing in a file's registry-snapshot records
+    (counters + gauges + histograms). Tolerant of malformed lines — the
+    schema pass already reported those."""
+    names: set = set()
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or rec.get("kind") != "snapshot":
+                continue
+            attrs = rec.get("attrs") or {}
+            for table in ("counters", "gauges", "histograms"):
+                t = attrs.get(table)
+                if isinstance(t, dict):
+                    names.update(t)
+    return names
+
+
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = sys.argv[1:] if argv is None else list(argv)
+    # --require PREFIX (repeatable): fail unless the trace's registry
+    # snapshot holds at least one metric whose name starts with PREFIX —
+    # e.g. `--require ddp.` in `make ddp-smoke` fails on any run that
+    # silently dropped the DDP comms metrics. Parsed by hand so the
+    # historical exit codes (2 = usage) stay exactly pinned by tests.
+    require = []
+    while "--require" in argv:
+        i = argv.index("--require")
+        if i + 1 >= len(argv):
+            print(__doc__.strip(), file=sys.stderr)
+            return 2
+        require.append(argv[i + 1])
+        del argv[i:i + 2]
     if len(argv) != 1:
         print(__doc__.strip(), file=sys.stderr)
         return 2
@@ -179,6 +216,16 @@ def main(argv=None) -> int:
         if got == 0:
             errors.append(f"{path}: empty trace")
         total += got
+    if require:
+        names: set = set()
+        for path in files:
+            names.update(_snapshot_metric_names(path))
+        for prefix in require:
+            if not any(n.startswith(prefix) for n in names):
+                errors.append(
+                    f"{target}: no registry-snapshot metric matching "
+                    f"--require {prefix!r} (snapshot metrics: "
+                    f"{sorted(names) or 'none'})")
     if errors:
         for e in errors:
             print(f"check_telemetry: {e}", file=sys.stderr)

@@ -45,6 +45,7 @@ else:
 # pass; `pytest -m integration -q` runs the rest; `pytest -m "" -q` runs all.
 _INTEGRATION_FILES = {
     "test_multiprocess.py",   # real jax.distributed 4-process rendezvous runs
+    "test_mp_comm.py",        # 4-process DDP comm-strategy parity worlds
     "test_bench.py",          # bench.py CLI end-to-end via subprocess
     "test_cli.py",            # full trainer CLI configs end-to-end
     "test_measure_scripts.py",  # measure_hw.sh / hw_window.sh shell runs

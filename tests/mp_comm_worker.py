@@ -1,0 +1,91 @@
+"""Worker process for the multi-process DDP-comms parity test
+(tests/test_mp_comm.py) — NOT collected by pytest (no test_ prefix).
+
+The mp_worker.py shape (one jax.distributed process per rank, env wireup,
+SPMD DP training over the cross-process mesh), parameterized by the
+gradient-communication strategy: `--comm pmean|sharded|bf16` selects the
+parallel/collectives.py program inside make_dp_train_step. After
+HPARAMS["steps"] steps every rank prints one JSON line (losses + checksum)
+and, when `--save PATH` is given, rank 0 writes the final params to
+PATH (.npz, one array per leaf in tree order) so the parent can compare
+full parameter vectors across strategies — pmean-vs-pmean bitwise,
+sharded-vs-pmean rtol 1e-6, bf16-vs-pmean bounded drift.
+"""
+
+import argparse
+import json
+import sys
+
+# Single source of truth with the serial golden replay — same contract as
+# tests/mp_worker.py (n / WORLD >= steps * local_batch).
+HPARAMS = dict(n=1024, local_batch=32, steps=3, lr=0.05,
+               data_seed=0, sampler_seed=42, param_seed=0, key_seed=1)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--comm", choices=("pmean", "sharded", "bf16"),
+                   required=True)
+    p.add_argument("--save", default=None,
+                   help="rank 0: write final params here (.npz)")
+    a = p.parse_args()
+
+    import numpy as np
+    import jax
+
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel.ddp import (
+        dp_mesh, global_batch_from_local, make_dp_train_step,
+        replicate_state)
+    from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
+    from pytorch_ddp_mnist_tpu.parallel.wireup import initialize_runtime
+
+    n, local_batch, steps, lr = (HPARAMS["n"], HPARAMS["local_batch"],
+                                 HPARAMS["steps"], HPARAMS["lr"])
+
+    rt = initialize_runtime("env")
+    assert jax.process_count() == rt.size, "rendezvous failed"
+    mesh = dp_mesh()
+    assert mesh.devices.size == rt.size
+
+    split = synthetic_mnist(n, seed=HPARAMS["data_seed"])
+    x_all = normalize_images(split.images)
+    y_all = split.labels.astype(np.int32)
+    sampler = ShardedSampler(n, num_replicas=rt.size, rank=rt.rank,
+                             seed=HPARAMS["sampler_seed"])
+    sampler.set_epoch(0)
+    shard = sampler.indices()
+
+    step = make_dp_train_step(mesh, lr=lr, comm=a.comm)
+    params = replicate_state(mesh,
+                             init_mlp(jax.random.key(HPARAMS["param_seed"])))
+    key = replicate_state(mesh, jax.random.key(HPARAMS["key_seed"]))
+
+    losses = []
+    for s in range(steps):
+        rows = shard[s * local_batch:(s + 1) * local_batch]
+        assert len(rows) == local_batch, \
+            f"shard exhausted at step {s}: raise HPARAMS['n']"
+        gx, gy = global_batch_from_local(mesh, (x_all[rows], y_all[rows]))
+        params, key, loss = step(params, key, gx, gy)
+        losses.append(float(loss))
+
+    # Params are replicated on every strategy's output (pmean by out_specs,
+    # sharded/bf16 by the trailing all-gather/psum) — any rank can fetch.
+    leaves = [np.asarray(leaf)
+              for leaf in jax.tree_util.tree_leaves(params)]
+    checksum = float(sum(np.abs(leaf).sum() for leaf in leaves))
+    if a.save and rt.rank == 0:
+        np.savez(a.save, **{f"leaf{i}": leaf
+                            for i, leaf in enumerate(leaves)})
+    rt.barrier()
+    print(json.dumps({"rank": rt.rank, "size": rt.size, "comm": a.comm,
+                      "losses": losses, "checksum": checksum}))
+    sys.stdout.flush()
+    rt.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

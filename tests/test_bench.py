@@ -82,6 +82,49 @@ def test_serve_mode_contract():
     assert rec["compile_count"] == 5
 
 
+def test_ddp_mode_contract_8_fake_devices():
+    """The PR acceptance as a test: `--mode ddp` on 8 fake CPU devices
+    emits ONE artifact line per strategy (pmean, sharded, bf16), each with
+    non-null images_per_sec and scaling_efficiency_vs_1dev; the pmean row
+    pins zero parity drift against itself, the sharded row stays within
+    rtol 1e-6 of pmean."""
+    env = dict(ENV, XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "ddp", "--epochs", "2",
+         "--batch_size", "16"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    recs = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    assert [r["strategy"] for r in recs] == ["pmean", "sharded", "bf16"]
+    by = {r["strategy"]: r for r in recs}
+    for r in recs:
+        assert r["metric"] == "mnist_ddp_train_images_per_sec_per_chip"
+        assert r["n_devices"] == 8
+        assert r["images_per_sec"] is not None and r["images_per_sec"] > 0
+        assert r["scaling_efficiency_vs_1dev"] is not None
+        assert 0 < r["scaling_efficiency_vs_1dev"] < 2
+        assert r["bytes_on_wire_per_step_per_device"] > 0
+        assert r["collective_s_p50"] > 0
+    assert by["pmean"]["parity_max_abs_diff_vs_pmean"] == 0.0
+    assert by["sharded"]["parity_max_rel_diff_vs_pmean"] < 1e-6
+    # the compressed wire is half the f32 wire, exactly
+    assert (by["bf16"]["bytes_on_wire_per_step_per_device"] * 2
+            == by["pmean"]["bytes_on_wire_per_step_per_device"])
+
+
+def test_ddp_comm_knob_rejected_outside_ddp_mode():
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "train", "--epochs", "1",
+         "--ddp_comm", "sharded"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--ddp_comm" in out.stderr
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "ddp", "--kernel", "xla"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0 and "--kernel" in out.stderr
+
+
 def test_mode_knob_compat_rejected_by_name():
     """Variant knobs the selected mode never reads are rejected, not
     silently accepted as a mislabeled measurement."""
@@ -751,6 +794,12 @@ def test_promote_gate_labels_and_matrix_explicitness():
     for lbl, _d, _k in gate.CANDIDATES:
         assert lbl in labels, lbl
     for label, argv in bm.VARIANTS:
+        if "--mode" in argv and argv[argv.index("--mode") + 1] == "ddp":
+            # ddp-mode rows never read --dtype (bench rejects it by name
+            # there — the comm strategy IS the variant; f32/xla fixed), so
+            # the calibration cannot relabel them
+            assert "--ddp_comm" in argv, (label, argv)
+            continue
         assert "--dtype" in argv, (label, argv)
         if "pallas_epoch" in argv:
             # --superstep 0 (auto) reads the calibration too: an epoch-

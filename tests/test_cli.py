@@ -184,6 +184,29 @@ def test_pallas_epoch_cli_guards(capsys):
         main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "2048"])
 
 
+def test_ddp_comm_cli_guards_and_training(tmp_path, capsys):
+    """--ddp_comm guard rails (serial and pallas_epoch rejected by name)
+    and an end-to-end --parallel --ddp_comm run per non-default strategy
+    on the virtual 8-device mesh — both the streaming and the cached scan
+    paths train to finite numbers."""
+    with pytest.raises(SystemExit, match="--parallel"):
+        main(["--ddp_comm", "sharded", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="IN-kernel"):
+        main(["--ddp_comm", "bf16", "--parallel", "--cached",
+              "--kernel", "pallas_epoch", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="never casts"):
+        main(["--parallel", "--ddp_comm", "sharded",
+              "--bf16_rounding", "stochastic", "--n_epochs", "1"])
+    main(["--parallel", "--ddp_comm", "sharded", "--n_epochs", "1",
+          "--limit", "512", "--batch_size", "16", "--checkpoint", ""])
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and _mean_train(lines[0]) > 0
+    main(["--parallel", "--cached", "--ddp_comm", "bf16", "--n_epochs", "1",
+          "--limit", "512", "--batch_size", "16", "--checkpoint", ""])
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and _mean_train(lines[0]) > 0
+
+
 def test_eval_shuffle_changes_only_ref_unit(tmp_path, capsys):
     """--eval_shuffle reproduces the reference's shuffled test loader
     (ddp_tutorial_multi_gpu.py:43-47): the Σ(mean/B) ref-unit val_loss gets

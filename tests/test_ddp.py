@@ -141,6 +141,54 @@ def test_bf16_compute_path(mesh):
     assert params["fc1"]["w"].dtype == jnp.float32
 
 
+def test_shard_batch_ragged_raises_named_error(mesh):
+    """A batch not divisible by the mesh size used to surface as an opaque
+    XLA sharding error; it must now be a ValueError naming the batch size
+    and device count."""
+    from pytorch_ddp_mnist_tpu.parallel.ddp import shard_batch
+    x, y = _batch(30)
+    with pytest.raises(ValueError) as ei:
+        shard_batch(mesh, (x, y))
+    assert "30" in str(ei.value) and "8" in str(ei.value)
+
+
+def test_global_batch_from_local_ragged_raises_named_error(mesh):
+    from pytorch_ddp_mnist_tpu.parallel.ddp import global_batch_from_local
+    x, y = _batch(30)
+    with pytest.raises(ValueError) as ei:
+        global_batch_from_local(mesh, (x, y))
+    assert "30" in str(ei.value) and "8" in str(ei.value)
+
+
+def test_shard_batch_divisible_still_works(mesh):
+    from pytorch_ddp_mnist_tpu.parallel.ddp import shard_batch
+    x, y = _batch(32)
+    xs, ys = shard_batch(mesh, (x, y))
+    np.testing.assert_array_equal(np.asarray(xs), x)
+    np.testing.assert_array_equal(np.asarray(ys), y)
+
+
+def test_comm_strategies_run_and_losses_close(mesh):
+    """Every comm strategy builds, runs, and reports (to strategy
+    tolerance) the same loss on the same batch — the single-process smoke
+    of the deeper parity suite in test_collectives.py."""
+    from pytorch_ddp_mnist_tpu.parallel import COMM_STRATEGIES
+    x, y = _batch(8 * 8, seed=11)
+    losses = {}
+    for comm in COMM_STRATEGIES:
+        step = make_dp_train_step(mesh, lr=0.01, comm=comm)
+        assert step.ddp_comm == comm and step.ddp_devices == 8
+        params = jax.device_put(init_mlp(jax.random.key(0)),
+                                replicated(mesh))
+        key = jax.device_put(jax.random.key(1), replicated(mesh))
+        _, _, loss = step(params, key,
+                          jax.device_put(x, batch_sharding(mesh)),
+                          jax.device_put(y, batch_sharding(mesh)))
+        losses[comm] = float(loss)
+    assert np.allclose(losses["sharded"], losses["pmean"], rtol=1e-6)
+    assert np.allclose(losses["bf16"], losses["pmean"], rtol=1e-3)
+
+
 def test_replicate_state_preserves_rbg_key_impl(mesh):
     """replicate_state must rewrap PRNG keys with their own engine — an rbg
     key (key_data shape (4,), not threefry's (2,)) used to crash the DP

@@ -193,3 +193,44 @@ def test_dp_ring_bf16_lowers():
 def test_dp_ring_threefry_lowers():
     # the fixed SMEM-resident key table, in the DP kernel
     _export_dp(2, rng_impl="threefry")
+
+
+# ---------------------------------------------------------------------------
+# Gradient-communication strategies (parallel/collectives.py): every comm
+# program of the DP train step — pmean, bucketized reduce-scatter +
+# sharded update + all-gather, bf16-compressed allreduce — must lower for
+# an 8-device TPU mesh from this CPU host. The collectives are plain XLA
+# (no Mosaic), but psum_scatter/all_gather layouts and the bf16 reduce
+# still go through the client-side TPU lowering pipeline here.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("comm", ["pmean", "sharded", "bf16"])
+def test_dp_comm_strategy_step_lowers(comm):
+    from pytorch_ddp_mnist_tpu.parallel.ddp import dp_step_program
+
+    n = 8
+    mesh = abstract_mesh((n,), ("dp",))
+    prog = dp_step_program(mesh, 0.01, comm=comm)
+    params = init_mlp(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    x = jnp.zeros((n * B, 784), jnp.float32)
+    y = jnp.zeros((n * B,), jnp.int32)
+    _export_tpu(prog, params, key, x, y)
+
+
+@pytest.mark.parametrize("comm", ["sharded", "bf16"])
+def test_dp_comm_strategy_scan_program_lowers(comm):
+    # the epoch-scanned form (make_dp_run_fn threads comm through
+    # _dp_step_body) over the same 8-device abstract mesh
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
+
+    n = 8
+    mesh = abstract_mesh((n,), ("dp",))
+    run = make_dp_run_fn(mesh, lr=0.01, comm=comm)
+    params = init_mlp(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    x_all = jnp.zeros((n * 2 * B, 784), jnp.uint8)
+    y_all = jnp.zeros((n * 2 * B,), jnp.int32)
+    idxs = jnp.zeros((1, 2, n * B), jnp.int32)
+    _export_tpu(run, params, key, x_all, y_all, idxs)

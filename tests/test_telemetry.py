@@ -237,6 +237,32 @@ def _rec(**kw):
     return json.dumps(base)
 
 
+def test_checker_require_metric_prefix(tmp_path, capsys):
+    """--require PREFIX (the ddp-smoke contract): pass when the registry
+    snapshot carries a matching metric, fail (naming the prefix) when not,
+    usage error when the prefix value is missing."""
+    trace = [
+        _rec(kind="meta", name="trace_start", t_mono=1.0),
+        _rec(kind="snapshot", name="registry", t_mono=2.0,
+             attrs={"counters": {"ddp.bytes_on_wire": 8192},
+                    "gauges": {},
+                    "histograms": {"ddp.collective_s": {"n": 3}}}),
+    ]
+    path = _write(tmp_path, trace)
+    assert check_main(["--require", "ddp.", path]) == 0
+    assert check_main(["--require", "ddp.", "--require", "serve.",
+                       path]) == 1
+    assert "serve." in capsys.readouterr().err
+    assert check_main([path, "--require"]) == 2     # usage
+    # a trace with NO snapshot at all fails the gate too (own dir — the
+    # gate is per-target, and the first dir legitimately satisfies it)
+    bare_dir = tmp_path / "bare"
+    bare_dir.mkdir()
+    bare = _write(bare_dir, [_rec(kind="meta", name="trace_start",
+                                  t_mono=1.0)])
+    assert check_main(["--require", "ddp.", bare]) == 1
+
+
 def test_checker_accepts_synthetic_good_stream(tmp_path, capsys):
     good = [
         _rec(kind="meta", name="trace_start", t_mono=1.0),
