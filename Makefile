@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -71,6 +71,18 @@ ddp-smoke:
 	done
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) bench.py --mode ddp --epochs 3 --batch_size 16
+
+# Chaos smoke (docs/ROBUSTNESS.md): SIGKILL a seeded rank of a 4-process
+# fake-CPU-device training run at a seeded mid-epoch step, relaunch with
+# --resume <step-ckpt dir>, assert the finished params are BYTE-identical
+# to the unbroken baseline, and gate the resumed run's telemetry on the
+# checkpoint.* metrics (check_telemetry --require checkpoint.). On a
+# jaxlib without CPU multiprocess collectives it degrades to the same
+# matrix at world=1 (script exit 75 is the multiproc skip signal).
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py || \
+		{ rc=$$?; [ $$rc -eq 75 ] && \
+		JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py --world 1; }
 
 native:
 	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native

@@ -25,6 +25,18 @@ from ..parallel import ShardedSampler
 from ..train import (TrainState, fit, save_checkpoint, load_checkpoint)
 from ..train.config import configure
 
+# The rank-gated stash filename _persist_and_reexec falls back to when
+# --checkpoint is empty; the end-of-run cleanup matches on it too.
+_DEFAULT_STASH = "outage_resume.msgpack"
+
+
+def _run_geometry(tcfg, dcfg, global_batch: int) -> dict:
+    """The config fields whose change would silently RE-INTERPRET a step
+    checkpoint's (epoch, offset) position — stamped into every manifest
+    and compared at directory resume (same values or refuse by name)."""
+    return {"global_batch": int(global_batch), "limit": int(dcfg["limit"]),
+            "sampler_rng": tcfg["sampler_rng"]}
+
 
 def _persist_and_reexec(tcfg, stash, remaining: int, process_index: int,
                         why: str) -> None:
@@ -33,7 +45,7 @@ def _persist_and_reexec(tcfg, stash, remaining: int, process_index: int,
     epoch. Never returns. Shared by the serial wedged-client path and the
     parallel coordinated resume; callers have already verified the CLI
     context (argv is None, no PDMT_NO_REEXEC)."""
-    ckpt = tcfg["checkpoint"] or "outage_resume.msgpack"
+    ckpt = tcfg["checkpoint"] or _DEFAULT_STASH
     # Rank-gated stash files: rank 0 persists to the real checkpoint path;
     # every other rank to a rank-suffixed sibling (multi-host ranks cannot
     # read each other's filesystems, and params are replicated — identical
@@ -194,6 +206,15 @@ def main(argv=None) -> int:
     config = configure(argv)
     tcfg, dcfg = config["trainer"], config["data"]
 
+    # Fault injection (--fault + $PDMT_FAULT, utils/faultpoints.py): parse
+    # NOW so a typo'd chaos spec refuses to start instead of silently
+    # running fault-free; the real process rank binds after wireup.
+    from ..utils import faultpoints
+    try:
+        faultpoints.install(tcfg["fault"])
+    except faultpoints.FaultSpecError as e:
+        raise SystemExit(f"--fault: {e}")
+
     # --telemetry DIR: arm the compile listener BEFORE the first jit (it is
     # pure jax.monitoring plumbing — no backend touch), and open the JSONL
     # trace now for serial runs. PARALLEL runs defer the trace open until
@@ -290,6 +311,27 @@ def main(argv=None) -> int:
                          f"run length; start_epoch resumes inside it)")
     if tcfg["outage_retries"] < 0:
         raise SystemExit("--outage_retries must be >= 0")
+    if tcfg["ckpt_every_steps"] < 0:
+        raise SystemExit("--ckpt_every_steps must be >= 0")
+    if tcfg["ckpt_keep"] < 1:
+        raise SystemExit("--ckpt_keep must be >= 1")
+    if tcfg["ckpt_every_steps"]:
+        if tcfg["fused"]:
+            raise SystemExit(
+                "--ckpt_every_steps saves at chunk boundaries the host "
+                "controls; --fused runs all epochs as ONE device program "
+                "with no mid-run host control — use plain --cached")
+        if tcfg["kernel"] == "pallas_epoch":
+            raise SystemExit(
+                "--ckpt_every_steps chunks the epoch scan; --kernel "
+                "pallas_epoch splits its dropout key once per EPOCH and "
+                "chunking would fork the RNG chain — use --kernel "
+                "xla/pallas")
+        if not tcfg["checkpoint"]:
+            raise SystemExit(
+                "--ckpt_every_steps writes step checkpoints under "
+                "<--checkpoint>.steps/; pass a non-empty --checkpoint to "
+                "derive the directory from")
     # --outage_retries composes with --parallel since round 5: every rank
     # persists its own stash and the world re-execs into a fresh
     # rendezvous (_train_with_outage_retry's parallel branch). That resume
@@ -341,11 +383,14 @@ def main(argv=None) -> int:
                 "the torch mask stream mid-epoch instead of at the resume "
                 "boundary; use --resume/--start_epoch (which re-seat the "
                 "stream exactly) or the default jax dropout stream")
-        if tcfg["resume"] and not tcfg["start_epoch"]:
+        if (tcfg["resume"] and not tcfg["start_epoch"]
+                and not os.path.isdir(tcfg["resume"])):
             # the fast-forward is driven by --start_epoch; a resume
             # without it would train mid-run weights on masks from stream
             # position 0 — silently off the bitwise trajectory this flag
-            # exists to guarantee
+            # exists to guarantee. A DIRECTORY resume is exempt: the step
+            # checkpoint manifest carries the exact position and the
+            # stream fast-forwards from it below.
             raise SystemExit(
                 "--dropout_rng torch with --resume needs --start_epoch "
                 "(it positions the mask stream at the resume boundary; "
@@ -396,6 +441,7 @@ def main(argv=None) -> int:
                                     global_batch_from_local, replicate_state)
         runtime = initialize_runtime(tcfg["wireup_method"])
         process_index, num_processes = jax.process_index(), jax.process_count()
+        faultpoints.set_rank(process_index)  # rank-gated specs bind here
         if tcfg["telemetry"]:  # post-rendezvous: the real rank is known now
             telemetry.enable(tcfg["telemetry"], process_index=process_index)
         use_pallas = _resolve_kernel()
@@ -522,7 +568,78 @@ def main(argv=None) -> int:
                 pass
             sidecar_box["sidecar"] = None
 
-    if tcfg["resume"]:
+    start_offset = 0           # mid-epoch resume position (directory resume)
+    if tcfg["resume"] and os.path.isdir(tcfg["resume"]):
+        # Step-granular resume: --resume points at a ckpt_manager directory
+        # (the <--checkpoint>.steps/ that --ckpt_every_steps writes). The
+        # newest INTACT checkpoint supplies params, the RNG key chain, and
+        # the exact sampler position — no --start_epoch needed (and a
+        # conflicting one is refused rather than silently ignored).
+        from ..train.checkpoint import CheckpointError
+        from ..train.ckpt_manager import CheckpointManager
+        if tcfg["start_epoch"]:
+            raise SystemExit(
+                "--start_epoch conflicts with a step-checkpoint directory "
+                "--resume: the manifest carries the exact resume position")
+        try:
+            restored = CheckpointManager(
+                tcfg["resume"], keep=tcfg["ckpt_keep"]).restore_latest(
+                    state.params)
+        except CheckpointError as e:
+            raise SystemExit(f"--resume: {e}")
+        if restored.epoch > tcfg["n_epochs"]:
+            raise SystemExit(
+                f"--resume: checkpoint at epoch {restored.epoch} is past "
+                f"--n_epochs {tcfg['n_epochs']} (n_epochs is the TOTAL run "
+                f"length)")
+        # Run-geometry guard: (epoch, offset) only address the right
+        # batches under the SAME geometry the manifest was stamped with —
+        # a different global batch / dataset limit / permutation source
+        # would silently re-interpret the position and walk off the
+        # bitwise trajectory. Refuse by name instead.
+        geometry = _run_geometry(tcfg, dcfg, global_batch)
+        mismatch = {k: (v, geometry[k]) for k, v in restored.meta.items()
+                    if k in geometry and geometry[k] != v}
+        if mismatch:
+            raise SystemExit(
+                "--resume: checkpoint was written under different run "
+                "geometry; its (epoch, offset) would address different "
+                "batches: " + ", ".join(
+                    f"{k}: checkpoint={v[0]!r} vs this run={v[1]!r}"
+                    for k, v in sorted(mismatch.items())))
+        absent = sorted(k for k in geometry if k not in restored.meta)
+        if absent:
+            # a manifest written through the raw manager API (no CLI
+            # stamp): the guard cannot verify these — say so rather than
+            # implying it did
+            print(f"[ckpt] warning: manifest carries no run-geometry "
+                  f"stamp for {absent}; cannot verify this run matches "
+                  f"the checkpoint's geometry", file=sys.stderr, flush=True)
+        if restored.offset and (tcfg["fused"]
+                                or tcfg["kernel"] == "pallas_epoch"):
+            # same conflicts --ckpt_every_steps rejects above, caught at
+            # the CLI boundary instead of as fit_cached's ValueError after
+            # data setup
+            raise SystemExit(
+                f"--resume: checkpoint is MID-epoch (offset "
+                f"{restored.offset}) and needs step-granular replay; "
+                + ("--fused runs all epochs as ONE device program"
+                   if tcfg["fused"] else
+                   "--kernel pallas_epoch splits its dropout key once per "
+                   "EPOCH")
+                + " — resume with plain --cached / --kernel xla|pallas")
+        state = TrainState(restored.params, jax.random.wrap_key_data(
+            jax.numpy.asarray(restored.key_data), impl=restored.impl))
+        tcfg["start_epoch"] = restored.epoch
+        start_offset = restored.offset
+        # the manifest's PRNG engine is authoritative for the restored key
+        # chain; everything downstream (stash keys, sidecars, new step
+        # checkpoints) describes THAT key, so the config follows it
+        tcfg["impl"] = restored.impl
+        print(f"[ckpt] resuming from {restored.path}: step {restored.step} "
+              f"(epoch {restored.epoch}, offset {restored.offset})",
+              file=sys.stderr, flush=True)
+    elif tcfg["resume"]:
         state = TrainState(load_checkpoint(tcfg["resume"], state.params),
                            state.key)
         # RNG sidecar (written by the outage-resume re-exec): restores the
@@ -577,6 +694,33 @@ def main(argv=None) -> int:
         stash["params"] = jax.tree_util.tree_map(np.asarray, state.params)
         stash["key"] = np.asarray(jax.random.key_data(state.key))
 
+    # Step-granular crash-consistent checkpointing (--ckpt_every_steps,
+    # train/ckpt_manager.py): rank 0 commits the FULL resume state —
+    # params, RNG key chain, epoch/step/sampler offset — every N steps
+    # (and at epoch ends) into <--checkpoint>.steps/, atomic +
+    # CRC-stamped + keep-last-N. A kill at ANY step then resumes bitwise
+    # via `--resume <that directory>`. A FAILED save must never take down
+    # a healthy run: it degrades to a flight-recorder entry and a stderr
+    # line (durability shrinks; training continues).
+    step_hook = None
+    if tcfg["ckpt_every_steps"] and process_index == 0:
+        from ..train.checkpoint import CheckpointError
+        from ..train.ckpt_manager import CheckpointManager
+        step_mgr = CheckpointManager(tcfg["checkpoint"] + ".steps",
+                                     keep=tcfg["ckpt_keep"])
+
+        def step_hook(ep, off, gs, st):
+            try:
+                step_mgr.save(st.params,
+                              np.asarray(jax.random.key_data(st.key)),
+                              tcfg["impl"], step=gs, epoch=ep, offset=off,
+                              meta=_run_geometry(tcfg, dcfg, global_batch))
+            except CheckpointError as e:
+                telemetry.flight.record("checkpoint_save_failed", step=gs,
+                                        error=str(e)[:500])
+                print(f"[ckpt] step checkpoint save failed (training "
+                      f"continues): {e}", file=sys.stderr, flush=True)
+
     # --eval_shuffle: the reference's shuffled test loader, engine-faithful
     # (torch-bitwise MT19937 randperm, seeded --seed + epoch since the
     # reference's is unseeded). Only the ref-unit val_loss's batch
@@ -619,6 +763,10 @@ def main(argv=None) -> int:
                                  permutation=tcfg["sampler_rng"])
 
         def run_fit(st, start):
+            # start_offset belongs to THE run epoch it was restored into:
+            # an outage-retry re-entry at a later epoch starts it at 0 (a
+            # re-entry at the SAME epoch means no epoch completed — the
+            # stash holds the restored mid-epoch state, offset and all)
             return fit_cached(st, images, y_train, sampler, x_test,
                               test_labels, epochs=tcfg["n_epochs"],
                               batch_size=global_batch, lr=tcfg["lr"],
@@ -628,6 +776,11 @@ def main(argv=None) -> int:
                               fused=tcfg["fused"], comm=tcfg["ddp_comm"],
                               bf16_rounding=tcfg["bf16_rounding"],
                               log=log, epoch_hook=hook, start_epoch=start,
+                              start_offset=(start_offset
+                                            if start == tcfg["start_epoch"]
+                                            else 0),
+                              ckpt_every_steps=tcfg["ckpt_every_steps"],
+                              step_hook=step_hook,
                               eval_perm=eval_perm)
     else:
         if tcfg["dropout_rng"] == "torch":
@@ -641,7 +794,10 @@ def main(argv=None) -> int:
             from ..train.loop import make_torch_dropout_train_step
             train_step = make_torch_dropout_train_step(
                 tcfg["lr"], tcfg["seed"],
-                skip_steps=tcfg["start_epoch"] * len(loader),
+                # mask position is a pure function of completed steps, so a
+                # mid-epoch directory resume fast-forwards by the manifest
+                # offset on top of the whole-epoch skip
+                skip_steps=tcfg["start_epoch"] * len(loader) + start_offset,
                 batch_size=tcfg["batch_size"])
 
         def run_fit(st, start):
@@ -651,6 +807,11 @@ def main(argv=None) -> int:
                        **({"lr": tcfg["lr"]} if train_step is None else {}),
                        log=log, train_step=train_step, put=put,
                        epoch_hook=hook, start_epoch=start,
+                       start_offset=(start_offset
+                                     if start == tcfg["start_epoch"]
+                                     else 0),
+                       ckpt_every_steps=tcfg["ckpt_every_steps"],
+                       step_hook=step_hook,
                        eval_perm=eval_perm)
     state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
                                      argv, process_index=process_index)
@@ -676,13 +837,25 @@ def main(argv=None) -> int:
         save_checkpoint(tcfg["checkpoint"], state.params)
         _consume_sidecar(tcfg["checkpoint"])
         print(f"saved checkpoint to {tcfg['checkpoint']}")
-    # A non-zero rank resumed from its own outage stash: the run completed,
-    # so the rank-suffixed file (and its sidecar, never path-matched by
-    # _consume_sidecar) has served its purpose — same durable-progress
-    # rule as the sidecar itself.
-    if (tcfg["resume"] and process_index > 0
-            and tcfg["resume"].endswith(f".rank{process_index}")):
-        for stale in (tcfg["resume"], tcfg["resume"] + ".rng.npz"):
+    # The run resumed from an outage STASH file and completed: the stash
+    # has served its purpose — same durable-progress rule as the sidecar.
+    # Two shapes qualify (both otherwise persist forever in the cwd):
+    #   * a non-zero rank's rank-suffixed sibling (never path-matched by
+    #     _consume_sidecar);
+    #   * rank 0's default-named stash (--checkpoint was empty, so
+    #     _persist_and_reexec fell back to _DEFAULT_STASH and no final
+    #     save ever overwrites/consumes it).
+    # A user's own --resume checkpoint never matches either shape.
+    stale_stash = None
+    if tcfg["resume"]:
+        if (process_index > 0
+                and tcfg["resume"].endswith(f".rank{process_index}")):
+            stale_stash = tcfg["resume"]
+        elif (process_index == 0 and not tcfg["checkpoint"]
+                and os.path.basename(tcfg["resume"]) == _DEFAULT_STASH):
+            stale_stash = tcfg["resume"]
+    if stale_stash:
+        for stale in (stale_stash, stale_stash + ".rng.npz"):
             try:
                 os.remove(stale)
             except FileNotFoundError:

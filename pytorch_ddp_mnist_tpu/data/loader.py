@@ -60,7 +60,20 @@ class BatchLoader:
         return math.ceil(len(self.sampler) / self.batch_size)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        for b in _batched_indices(self.sampler, self.batch_size):
+        return self.iter_from(0)
+
+    def iter_from(self, start: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Iterate this epoch's batches from batch index `start` — the
+        mid-epoch resume path (train.loop.fit start_offset): skipped
+        batches' index rows are simply dropped, never gathered."""
+        from ..utils import faultpoints
+        for i, b in enumerate(_batched_indices(self.sampler, self.batch_size)):
+            if i < start:
+                continue
+            # chaos hook: PDMT_FAULT=loader_stall:batch=K:delay_s=S stalls
+            # this batch — the injected I/O hiccup the data_wait telemetry
+            # phase exists to expose (no-op when no faults are installed)
+            faultpoints.fire("loader_next", batch=i)
             yield self.images[b], self.labels[b].astype(np.int32)
 
 
@@ -120,9 +133,19 @@ class NetCDFShardLoader:
         return normalize_images(images), self._labels[b].astype(np.int32)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        batches = list(_batched_indices(self.sampler, self.batch_size))
+        yield from self.iter_from(0)
+
+    def iter_from(self, start: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Iterate from batch index `start` (mid-epoch resume): skipped
+        batches are dropped from the index list BEFORE any disk gather —
+        neither this thread nor the readahead workers ever read them."""
+        from ..utils import faultpoints
+        batches = list(_batched_indices(self.sampler, self.batch_size))[start:]
         if self.num_workers <= 0 or len(batches) <= 1:
-            for b in batches:
+            for i, b in enumerate(batches, start=start):
+                # same loader_stall chaos hook as BatchLoader — fired at
+                # the CONSUMER so the stall lands in data_wait either way
+                faultpoints.fire("loader_next", batch=i)
                 yield self._load(b)
             return
         yield from self._iter_readahead(batches)
@@ -156,7 +179,9 @@ class NetCDFShardLoader:
         for t in threads:
             t.start()
         try:
+            from ..utils import faultpoints
             for i in range(len(batches)):
+                faultpoints.fire("loader_next", batch=i)
                 item = qs[i % nw].get()
                 if isinstance(item, BaseException):
                     raise item

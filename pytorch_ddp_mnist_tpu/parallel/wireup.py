@@ -69,7 +69,16 @@ class Runtime:
     initialized: bool = False
 
     def barrier(self) -> None:
-        """Cross-process sync (reference barrier, mnist_cpu_mp.py:201-203)."""
+        """Cross-process sync (reference barrier, mnist_cpu_mp.py:201-203).
+
+        Chaos hook: `PDMT_FAULT=collective_timeout[:rank=R]` makes this
+        barrier raise the DEADLINE_EXCEEDED-shaped RuntimeError a dead
+        collective produces (utils/faultpoints) — the injectable version
+        of the failure `looks_like_backend_loss` triages. Imported lazily:
+        this module must stay importable without jax or the package's
+        heavier utils."""
+        from ..utils import faultpoints
+        faultpoints.fire("barrier", rank=self.rank)
         if self.size > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("pytorch_ddp_mnist_tpu.barrier")

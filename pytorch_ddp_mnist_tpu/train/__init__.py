@@ -1,7 +1,9 @@
 from .loop import TrainState, make_train_step, make_eval_step, fit, evaluate
-from .checkpoint import save_checkpoint, load_checkpoint
+from .checkpoint import CheckpointError, save_checkpoint, load_checkpoint
+from .ckpt_manager import CheckpointManager, StepCheckpoint
 
 __all__ = [
     "TrainState", "make_train_step", "make_eval_step", "fit", "evaluate",
-    "save_checkpoint", "load_checkpoint",
+    "CheckpointError", "save_checkpoint", "load_checkpoint",
+    "CheckpointManager", "StepCheckpoint",
 ]

@@ -33,6 +33,17 @@ from flax import serialization
 _TORCH_STEMS = (("fc1", "0"), ("fc2", "3"), ("fc3", "5"))
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint file that cannot be decoded (truncated, torn, or not a
+    checkpoint at all) — or, from the step-checkpoint manager, a directory
+    with no intact checkpoint left to fall back to.
+
+    Exists so a corrupt file surfaces as ONE named error carrying the path
+    and byte size instead of a raw flax/msgpack traceback, and so the
+    manager's intact-fallback path (`train/ckpt_manager.py`) has a precise
+    exception class to catch — any other exception still means a bug."""
+
+
 def is_torch_path(path: str) -> bool:
     """True if `path` selects the torch state_dict checkpoint format."""
     return path.endswith((".pt", ".pth"))
@@ -130,4 +141,14 @@ def load_checkpoint(path: str, template):
                     f"has shape {np.shape(have)}, expected {np.shape(exp)}")
         return params
     with open(path, "rb") as f:
-        return serialization.from_bytes(template, f.read())
+        blob = f.read()
+    try:
+        return serialization.from_bytes(template, blob)
+    except Exception as e:
+        # A truncated/torn msgpack body surfaces as a raw flax/msgpack
+        # exception with no filename — wrap it with the path and size so a
+        # dead relaunch names its evidence (and the step-checkpoint
+        # manager's fallback can catch it by class).
+        raise CheckpointError(
+            f"{path}: cannot decode checkpoint ({len(blob)} bytes): "
+            f"{type(e).__name__}: {e}") from e

@@ -1,0 +1,315 @@
+"""Crash-consistent step-granular checkpointing.
+
+The reference does ONE final params-only `torch.save` with no load path
+(SURVEY.md §5.4); this framework's prior resume was epoch-granular only —
+a preemption mid-epoch lost the whole epoch, and a torn file surfaced as a
+raw msgpack error. This manager closes both gaps: a run killed at ANY step
+resumes bitwise on the unbroken trajectory, and a corrupted checkpoint
+degrades to the previous intact one instead of crashing the relaunch.
+
+One checkpoint = two files in the manager directory:
+
+    step_00000012.msgpack   payload — flax msgpack of the params pytree,
+                            the SAME bytes `save_checkpoint` writes (so
+                            `load_checkpoint` reads a payload directly)
+    step_00000012.json      manifest — the COMMIT record:
+        {"v": 1, "step": 12,         global steps completed
+         "epoch": 1, "offset": 4,    sampler position: epoch in progress +
+                                     batches already consumed in it (the
+                                     ShardedSampler permutation is a pure
+                                     function of seed+epoch, so this pair
+                                     IS the full sampler state)
+         "key": [...], "impl": "threefry2x32",   RNG key chain (key_data
+                                     words; tiny, so it lives here, not in
+                                     the payload)
+         "payload": "step_00000012.msgpack",
+         "bytes": N, "crc32": C,     payload size + CRC32 stamp
+         "t_wall": ...}
+
+Crash consistency:
+  * write order is payload-tmp -> fsync -> rename, THEN manifest-tmp ->
+    rename. The manifest is the commit: a crash at any instant leaves
+    either a fully committed checkpoint or an uncommitted one (payload
+    without manifest / stray .tmp), never a half-truth;
+  * `restore_latest` walks manifests newest-first and takes the first
+    INTACT one — manifest parses, payload exists, size matches, CRC32
+    matches, msgpack decodes. Every rejected candidate is recorded to the
+    telemetry flight recorder (`checkpoint_fallback`) so a relaunch that
+    skipped a torn file leaves evidence of it;
+  * rotation deletes beyond keep-last-N, manifest FIRST (uncommit) then
+    payload — interruption mid-rotation again leaves only committed or
+    uncommitted states.
+
+Telemetry: every save records `checkpoint.save_s` (histogram) and
+`checkpoint.bytes` (counter) into the unified registry, so `--telemetry`
+runs stamp checkpoint cost into the end-of-run snapshot
+(`scripts/check_telemetry.py --require checkpoint.` gates on it).
+
+Fault points: `utils/faultpoints.fire("ckpt_save", step=...)` runs just
+before the payload rename — `PDMT_FAULT=ckpt_save_io:step=K` makes save K
+fail with an OSError while the directory stays consistent (pinned by
+tests/test_ckpt_manager.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Any, List
+
+import numpy as np
+
+from .checkpoint import CheckpointError
+
+_SCHEMA = 1
+_NAME_RE = re.compile(r"^step_(\d{8})\.json$")
+_PAYLOAD_RE = re.compile(r"^step_(\d{8})\.msgpack$")
+
+
+def _manifest_name(step: int) -> str:
+    return f"step_{step:08d}.json"
+
+
+def _payload_name(step: int) -> str:
+    return f"step_{step:08d}.msgpack"
+
+
+@dataclass
+class StepCheckpoint:
+    """One restored checkpoint: everything a resume needs to replay the
+    remaining steps of the unbroken trajectory bitwise."""
+    params: Any
+    key_data: np.ndarray     # jax.random.key_data words (uint32)
+    impl: str                # PRNG engine the key words belong to
+    step: int                # global steps completed
+    epoch: int               # epoch in progress at save time
+    offset: int              # batches already consumed in that epoch
+    path: str                # manifest path it came from
+    meta: dict               # caller-stamped run geometry (may be empty):
+                             # the fields whose change would silently
+                             # re-interpret (epoch, offset) — the CLI
+                             # stamps global_batch/limit/sampler_rng and
+                             # refuses a resume that contradicts them
+
+
+class CheckpointManager:
+    """Atomic, CRC-stamped, keep-last-N step checkpoints in one directory.
+
+    `save` is rank-agnostic — the CALLER gates on rank 0 (params are
+    replicated in DP, identical bytes everywhere, same contract as
+    `save_checkpoint`). `restore_latest` is safe from every rank: it only
+    reads."""
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1; got {keep}")
+        self.directory = directory
+        self.keep = int(keep)
+
+    # -- write side ---------------------------------------------------------
+
+    def save(self, params, key_data, impl: str, *, step: int, epoch: int,
+             offset: int, meta: dict | None = None) -> str:
+        """Commit one step checkpoint; returns the manifest path.
+
+        Fetches params to host (this is the one deliberate device sync of a
+        checkpoint save). Raises CheckpointError on any I/O failure, with
+        the temp file cleaned up and prior checkpoints untouched — a failed
+        save never costs existing durability."""
+        import jax
+        from flax import serialization
+        from ..telemetry import get_registry
+        from ..utils import faultpoints
+
+        t0 = time.perf_counter()
+        os.makedirs(self.directory, exist_ok=True)
+        host = jax.tree_util.tree_map(np.asarray, params)
+        blob = serialization.to_bytes(host)
+        payload = os.path.join(self.directory, _payload_name(step))
+        manifest = os.path.join(self.directory, _manifest_name(step))
+        tmp = f"{payload}.tmp.{os.getpid()}"
+        try:
+            faultpoints.fire("ckpt_save", step=step, epoch=epoch)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, payload)
+            record = {
+                "v": _SCHEMA, "step": int(step), "epoch": int(epoch),
+                "offset": int(offset),
+                "key": [int(w) for w in np.asarray(key_data).ravel()],
+                "impl": str(impl),
+                "payload": os.path.basename(payload),
+                "bytes": len(blob), "crc32": zlib.crc32(blob),
+                "meta": dict(meta or {}),
+                "t_wall": time.time(),
+            }
+            mtmp = f"{manifest}.tmp.{os.getpid()}"
+            with open(mtmp, "w") as f:
+                json.dump(record, f)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, manifest)  # <- the commit point
+            # The renames are page-cache metadata ops; rotation below
+            # issues durable DELETES of older checkpoints. fsync the
+            # directory first, or a power loss could persist the deletes
+            # while losing this commit — exactly the zero-intact-left
+            # state crash consistency promises away.
+            try:
+                dfd = os.open(self.directory, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass  # best effort (non-POSIX dir fsync)
+        except OSError as e:
+            for stray in (tmp, f"{manifest}.tmp.{os.getpid()}"):
+                try:
+                    os.unlink(stray)
+                except OSError:
+                    pass
+            raise CheckpointError(
+                f"step checkpoint save failed at step {step} "
+                f"({payload}): {e}") from e
+        self._rotate()
+        reg = get_registry()
+        reg.histogram("checkpoint.save_s").record(time.perf_counter() - t0)
+        reg.counter("checkpoint.bytes").inc(len(blob))
+        return manifest
+
+    def _rotate(self) -> None:
+        """Drop committed checkpoints beyond keep-last-N — manifest first
+        (uncommit), then payload, so a crash mid-rotation can only leave an
+        uncommitted orphan, never a manifest pointing at nothing. Then
+        sweep crash debris: `.tmp.<pid>` files from DEAD writers (a SIGKILL
+        mid-save never reaches save's cleanup) and payloads whose manifest
+        never committed — both invisible to restore, but each kill/resume
+        cycle would otherwise leave one full-size orphan behind forever."""
+        committed = self.steps()
+        for step in committed[:-self.keep]:
+            for name in (_manifest_name(step), _payload_name(step)):
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+        live = set(committed[-self.keep:])
+        my_suffix = f".{os.getpid()}"
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if ".tmp." in name:
+                stray = not name.endswith(my_suffix)  # ours may be in flight
+            else:
+                m = _PAYLOAD_RE.match(name)
+                stray = bool(m) and int(m.group(1)) not in live
+            if stray:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    # -- read side ----------------------------------------------------------
+
+    def steps(self) -> List[int]:
+        """Committed (manifest-bearing) step numbers, ascending."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(int(m.group(1)) for n in names
+                      if (m := _NAME_RE.match(n)))
+
+    def _load_intact(self, step: int, template) -> StepCheckpoint:
+        """Load + verify one committed checkpoint; CheckpointError names
+        exactly what is wrong (missing/short/CRC-mismatched payload, bad
+        manifest, undecodable msgpack)."""
+        from flax import serialization
+
+        manifest = os.path.join(self.directory, _manifest_name(step))
+        try:
+            with open(manifest) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointError(f"{manifest}: unreadable manifest: {e}") from e
+        if rec.get("v") != _SCHEMA:
+            raise CheckpointError(
+                f"{manifest}: unknown manifest schema {rec.get('v')!r}")
+        missing = [k for k in ("step", "epoch", "offset", "key", "impl",
+                               "payload", "bytes", "crc32") if k not in rec]
+        if missing:
+            # must stay a CheckpointError: restore_latest's fallback walk
+            # catches exactly that class — a KeyError here would crash the
+            # relaunch this path exists to survive
+            raise CheckpointError(
+                f"{manifest}: manifest missing fields {missing}")
+        payload = os.path.join(self.directory, rec["payload"])
+        try:
+            with open(payload, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise CheckpointError(f"{payload}: unreadable payload: {e}") from e
+        if len(blob) != rec["bytes"]:
+            raise CheckpointError(
+                f"{payload}: truncated payload ({len(blob)} bytes, manifest "
+                f"says {rec['bytes']})")
+        if zlib.crc32(blob) != rec["crc32"]:
+            raise CheckpointError(
+                f"{payload}: CRC32 mismatch ({zlib.crc32(blob):#010x}, "
+                f"manifest says {rec['crc32']:#010x}) — corrupt payload of "
+                f"{len(blob)} bytes")
+        try:
+            params = serialization.from_bytes(template, blob)
+        except Exception as e:
+            raise CheckpointError(
+                f"{payload}: cannot decode checkpoint ({len(blob)} bytes): "
+                f"{type(e).__name__}: {e}") from e
+        return StepCheckpoint(
+            params=params,
+            key_data=np.asarray(rec["key"], np.uint32),
+            impl=str(rec["impl"]), step=int(rec["step"]),
+            epoch=int(rec["epoch"]), offset=int(rec["offset"]),
+            path=manifest, meta=dict(rec.get("meta") or {}))
+
+    def restore_latest(self, template) -> StepCheckpoint:
+        """Newest INTACT checkpoint, falling back past torn/corrupt ones.
+
+        Every rejected candidate lands in the flight recorder (kind
+        `checkpoint_fallback`, with the path and the named defect) and on
+        stderr; the restore that finally succeeds records
+        `checkpoint_restore`. Raises CheckpointError naming every tried
+        path when nothing intact remains."""
+        import sys
+        from ..telemetry import flight
+
+        steps = self.steps()
+        if not steps:
+            raise CheckpointError(
+                f"{self.directory}: no committed step checkpoints "
+                f"(no step_*.json manifests)")
+        tried = []
+        for step in reversed(steps):
+            try:
+                ckpt = self._load_intact(step, template)
+            except CheckpointError as e:
+                tried.append(str(e))
+                flight.record("checkpoint_fallback", step=step,
+                              error=str(e)[:500])
+                print(f"[ckpt] skipping torn checkpoint at step {step}: {e}",
+                      file=sys.stderr, flush=True)
+                continue
+            flight.record("checkpoint_restore", step=ckpt.step,
+                          epoch=ckpt.epoch, offset=ckpt.offset,
+                          fallbacks=len(tried))
+            return ckpt
+        raise CheckpointError(
+            f"{self.directory}: no intact step checkpoint; tried "
+            f"{len(tried)}:\n" + "\n".join(f"  {t}" for t in tried))

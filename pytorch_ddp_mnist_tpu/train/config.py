@@ -88,6 +88,35 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--resume", type=str, default=None,
                    help="checkpoint to load before training (added capability;"
                         " the reference has no load path)")
+    t.add_argument("--ckpt_every_steps", type=int, default=0,
+                   help="step-granular crash-consistent checkpointing "
+                        "(train/ckpt_manager.py): every N global steps "
+                        "(and at each epoch end) save the FULL resume "
+                        "state — params, epoch, step, sampler offset, RNG "
+                        "key chain — as an atomic CRC-stamped checkpoint "
+                        "under <--checkpoint>.steps/. Resume with "
+                        "--resume <that directory>: training continues at "
+                        "the exact step, bitwise on the unbroken "
+                        "trajectory, falling back past torn checkpoints. "
+                        "0 (default) = epoch-granular only. Needs "
+                        "--checkpoint; rejects --fused and --kernel "
+                        "pallas_epoch by name. Saves are rank-0-gated and "
+                        "every rank reads the directory at resume — "
+                        "multi-HOST worlds need it on a shared filesystem "
+                        "(docs/ROBUSTNESS.md)")
+    t.add_argument("--ckpt_keep", type=int, default=3,
+                   help="keep-last-N rotation for --ckpt_every_steps "
+                        "checkpoints (default 3; older ones are deleted "
+                        "after each successful save)")
+    t.add_argument("--fault", type=str, default=None, metavar="SPEC",
+                   help="deterministic fault injection "
+                        "(utils/faultpoints.py), merged with $PDMT_FAULT: "
+                        "comma-separated specs like 'kill:rank=2:step=5', "
+                        "'ckpt_save_io:step=3', "
+                        "'loader_stall:batch=3:delay_s=0.5', "
+                        "'collective_timeout'. Every fired fault lands in "
+                        "the telemetry flight recorder. Chaos testing "
+                        "only — see docs/ROBUSTNESS.md for the catalog")
     t.add_argument("--start_epoch", type=int, default=0,
                    help="resume the run at this GLOBAL epoch index: epochs "
                         "[start_epoch, n_epochs) run with their "
@@ -224,6 +253,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
             "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,
+            "ckpt_every_steps": a.ckpt_every_steps, "ckpt_keep": a.ckpt_keep,
+            "fault": a.fault,
             "sampler_rng": a.sampler_rng, "eval_shuffle": a.eval_shuffle,
             "dropout_rng": a.dropout_rng,
             "dtype": a.dtype, "impl": a.impl,

@@ -319,11 +319,64 @@ class _LiveLoss:
                 return
 
 
+def step_ckpt_positions(nsteps: int, epoch: int, i: int):
+    """Sampler position a step checkpoint must record after in-epoch step
+    `i` (0-based) of an epoch with `nsteps` steps: (epoch, i+1), except the
+    epoch-final step normalizes to (epoch+1, 0) — the state after an
+    epoch's last step IS the state entering the next epoch (eval mutates
+    nothing), so a resume never replays a zero-step epoch tail. Shared by
+    the streaming and epoch-scanned trainers so their manifests can never
+    disagree about what an offset means."""
+    if i + 1 >= nsteps:
+        return epoch + 1, 0
+    return epoch, i + 1
+
+
+def _fire_step_hook(step_hook, every: int, nsteps: int, epoch: int, i: int,
+                    params, key) -> None:
+    """Invoke the step-checkpoint hook when in-epoch step `i` (0-based)
+    lands on the cadence (`every` global steps) or closes the epoch.
+    `step_hook(epoch', offset', global_step, state)` — positions from
+    step_ckpt_positions. One helper for both trainers (cadence drift
+    between them would silently break resume parity expectations)."""
+    if step_hook is None or not every:
+        return
+    # cadence is EPOCH-LOCAL (step i+1 a multiple of `every`, plus the
+    # epoch-final step): the epoch-scanned trainer chunks each epoch's scan
+    # at exactly these boundaries, so both trainers save at identical
+    # global steps for any nsteps/every combination
+    if (i + 1) % every == 0 or i + 1 >= nsteps:
+        ep, off = step_ckpt_positions(nsteps, epoch, i)
+        step_hook(ep, off, epoch * nsteps + i + 1, TrainState(params, key))
+
+
+def _skip_batches(loader, n: int):
+    """`loader`'s batches with the first `n` skipped — the mid-epoch
+    resume path (the skipped batches' CONTENT is irrelevant: the restored
+    RNG key already encodes every step through them, and the sampler
+    permutation is position-addressed). The package loaders skip at the
+    INDEX level (`iter_from` — skipped rows are never gathered from
+    memory or disk); the fallback discards materialized batches, for
+    duck-typed loaders that only support iteration."""
+    if hasattr(loader, "iter_from"):
+        return loader.iter_from(n)
+
+    def dropped():
+        it = iter(loader)
+        for _ in range(n):
+            next(it, None)
+        yield from it
+
+    return dropped()
+
+
 def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         epochs: int, batch_size: int, lr: float | None = None,
         log: Callable[[str], None] = print,
         train_step: Callable | None = None, sharding=None, put=None,
         epoch_hook: Callable | None = None, start_epoch: int = 0,
+        start_offset: int = 0, ckpt_every_steps: int = 0,
+        step_hook: Callable | None = None,
         eval_perm: Callable | None = None) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
@@ -340,11 +393,26 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     exactly what the unbroken run would have done from there (the
     outage-resume path of cli.train; state must carry epoch k-1's params
     AND key for bitwise fidelity).
+
+    `start_offset` additionally resumes MID-epoch: the first run epoch
+    skips its first `start_offset` batches (the step-checkpoint manager's
+    resume path — state must carry the params AND key saved after exactly
+    that many steps of that epoch; the resumed epoch's printed train_loss
+    then covers only the remaining steps). `step_hook(epoch, offset,
+    global_step, state)` fires every `ckpt_every_steps` global steps and
+    at each epoch end (see step_ckpt_positions) — the save cadence of
+    `train/ckpt_manager.py`. Each step is also a `kill` fault point
+    (utils/faultpoints), fired AFTER the hook so an injected kill at step
+    K never races the step-K checkpoint.
     """
+    from ..utils import faultpoints
+
     if (train_step is None) == (lr is None):
         raise ValueError("pass exactly one of lr= or train_step=")
     if not 0 <= start_epoch <= epochs:
         raise ValueError(f"start_epoch={start_epoch} outside [0, {epochs}]")
+    if start_offset < 0:
+        raise ValueError(f"start_offset={start_offset} must be >= 0")
     step = train_step if train_step is not None else make_train_step(lr)
     eval_step = make_eval_step()
     # Hoist the test set to device ONCE — the reference re-materializes its
@@ -360,6 +428,11 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     if getattr(step, "ddp_comm", None) is not None:
         ddp_record = make_ddp_comm_recorder(
             step.ddp_mesh, step.ddp_comm, step.ddp_devices, params)
+    nsteps = len(train_loader)
+    if start_epoch < epochs and start_offset >= nsteps:
+        raise ValueError(f"start_offset={start_offset} >= the epoch's "
+                         f"{nsteps} steps (a committed step checkpoint "
+                         f"never records a full-epoch offset)")
     for epoch in range(start_epoch, epochs):
         # Per-epoch trace span with the phase split the reference's
         # ancestral I/O harness existed to report (SURVEY.md §5.1):
@@ -375,11 +448,15 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             step_timer = CumulativeTimer("step-dispatch")
             train_loader.sampler.set_epoch(epoch)
             losses = []
+            offset = start_offset if epoch == start_epoch else 0
+            src = (train_loader if offset == 0
+                   else _skip_batches(train_loader, offset))
             batches = progress(
-                device_prefetch(train_loader, sharding=sharding, put=put),
+                device_prefetch(src, sharding=sharding, put=put),
                 desc=f"epoch {epoch}")
             live = _LiveLoss(batches)
             it = iter(batches)
+            i = offset
             while True:
                 with io_timer:   # host time blocked on the data pipeline
                     batch = next(it, None)
@@ -389,6 +466,13 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                 with step_timer:
                     params, key, loss = step(params, key, x, y)
                 losses.append(loss)
+                _fire_step_hook(step_hook, ckpt_every_steps, nsteps,
+                                epoch, i, params, key)
+                # hook BEFORE the kill fault point: an injected kill at
+                # step K must never race the step-K checkpoint it tests
+                faultpoints.fire("step", step=epoch * nsteps + i + 1,
+                                 epoch=epoch)
+                i += 1
                 live.poll(losses)  # async bar update; never waits on device
             t_fetch = time.perf_counter()
             losses = np.asarray(jnp.stack(losses))  # single fetch per epoch

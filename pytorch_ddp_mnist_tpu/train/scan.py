@@ -44,7 +44,7 @@ from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
 from ..telemetry.events import get_tracer
-from .loop import (TrainState, epoch_summary, evaluate,
+from .loop import (TrainState, _fire_step_hook, epoch_summary, evaluate,
                    make_ddp_comm_recorder, make_eval_step,
                    make_snapshot_eval_step, val_summary)
 
@@ -601,7 +601,9 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                bf16_rounding: str = "nearest",
                log: Callable[[str], None] = print,
                epoch_hook: Callable | None = None,
-               start_epoch: int = 0,
+               start_epoch: int = 0, start_offset: int = 0,
+               ckpt_every_steps: int = 0,
+               step_hook: Callable | None = None,
                eval_perm: Callable | None = None) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
 
@@ -620,11 +622,39 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     (set_epoch uses global numbers) and epoch-line numbering — the
     outage-resume path (cli.train --start_epoch); with epoch k-1's params
     and key in `state`, the resumed trajectory is bitwise the unbroken one.
+
+    Step granularity (`train/ckpt_manager.py`): `ckpt_every_steps=N` CHUNKS
+    each epoch's scan at every N steps — the host regains control at each
+    boundary to run `step_hook(epoch, offset, global_step, state)` (same
+    contract and cadence as the streaming `fit`) and the `kill`/`step`
+    fault point. Per-step math is untouched: the chunks are consecutive
+    slices of the same sequential scan, and the per-STEP key-split chain
+    crosses chunk boundaries unchanged, so a chunked run — and a
+    `start_offset` mid-epoch resume, which skips the first `offset` index
+    rows of the first run epoch — stays bitwise on the unchunked
+    trajectory. `kernel='pallas_epoch'` splits its key once per EPOCH, so
+    chunking would fork its dropout stream: rejected by name. `fused=True`
+    has no mid-run host control at all: likewise rejected.
     """
     import time
 
+    from ..utils import faultpoints
+
     if not 0 <= start_epoch <= epochs:
         raise ValueError(f"start_epoch={start_epoch} outside [0, {epochs}]")
+    if start_offset < 0:
+        raise ValueError(f"start_offset={start_offset} must be >= 0")
+    if fused and (ckpt_every_steps or step_hook is not None or start_offset):
+        raise ValueError(
+            "step-granular checkpointing (ckpt_every_steps/step_hook/"
+            "start_offset) needs per-chunk host control; fused=True runs "
+            "all epochs as ONE device program — use plain cached mode")
+    if kernel == "pallas_epoch" and (ckpt_every_steps or start_offset):
+        raise ValueError(
+            "step-granular checkpointing chunks the epoch scan, but kernel "
+            "'pallas_epoch' derives its whole epoch's dropout stream from "
+            "ONE per-epoch key split — chunking would fork the RNG chain; "
+            "use kernel='xla'/'pallas' for step-granular checkpoints")
 
     if mesh is not None:
         # replicate_state / make_array_from_callback build GLOBAL arrays, so
@@ -716,14 +746,42 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             t0 = time.perf_counter()
             sampler.set_epoch(epoch)
             idx = epoch_batch_indices(sampler, batch_size)
-            if idx_sharding is not None:
-                idx = jax.make_array_from_callback(
-                    idx.shape, idx_sharding, lambda s, _i=idx: _i[s])
-            params, key, losses = epoch_fn(params, key, x_all, y_all, idx)
-            losses = np.asarray(losses)             # one host fetch per epoch
-            # the fetch above blocks until the epoch program finished, so
-            # this is the whole device phase — the cached path has no
-            # separate data wait (the dataset lives in HBM)
+            nb = idx.shape[0]
+            offset = start_offset if epoch == start_epoch else 0
+            if offset >= nb:
+                raise ValueError(
+                    f"start_offset={offset} >= the epoch's {nb} steps (a "
+                    f"committed step checkpoint never records a full-epoch "
+                    f"offset)")
+            # Chunk boundaries at epoch-local multiples of ckpt_every_steps
+            # (0 = the whole remaining epoch as one program, today's
+            # behavior). A resumed run's boundaries therefore coincide with
+            # the unbroken run's past the resume point; the chunks are
+            # consecutive slices of the same sequential scan either way, so
+            # the math is chunking-invariant.
+            loss_parts = []
+            c0 = offset
+            while c0 < nb:
+                c1 = (min(nb, (c0 // ckpt_every_steps + 1) * ckpt_every_steps)
+                      if ckpt_every_steps else nb)
+                part = idx[c0:c1]
+                if idx_sharding is not None:
+                    part = jax.make_array_from_callback(
+                        part.shape, idx_sharding, lambda s, _i=part: _i[s])
+                params, key, part_losses = epoch_fn(params, key,
+                                                    x_all, y_all, part)
+                loss_parts.append(np.asarray(part_losses))  # chunk sync
+                _fire_step_hook(step_hook, ckpt_every_steps, nb, epoch,
+                                c1 - 1, params, key)
+                # hook BEFORE the kill point: an injected kill at step K
+                # must never race the step-K checkpoint it tests
+                faultpoints.fire("step", step=epoch * nb + c1, epoch=epoch)
+                c0 = c1
+            losses = np.concatenate(loss_parts)
+            # the per-chunk loss fetches block until each chunk's program
+            # finished (ONE fetch per epoch when unchunked), so this is
+            # the whole device phase — the cached path has no separate
+            # data wait (the dataset lives in HBM)
             tracer.complete_span("step_compute", time.perf_counter() - t0,
                                  steps=int(losses.size))
             t_eval = time.perf_counter()

@@ -1,0 +1,252 @@
+"""Deterministic fault injection — the chaos layer the resilience paths
+are tested against.
+
+The framework carries several failure-handling claims: `cli/train.py`'s
+outage retry + re-exec resume, `parallel/wireup.py`'s hang-bounded probe
+loop, the checkpoint manager's crash consistency (`train/ckpt_manager.py`),
+and the loaders' stall accounting. At scale those paths run MORE often than
+the happy path (arXiv:1711.00705: failures are the norm across large
+distributed systems) — so they must be *injectable on demand*, not waited
+for. This module is the single switchboard: a fault spec names a failure,
+the instrumented code paths ask `fire(point, ...)` at their fault points,
+and a matching spec performs the failure deterministically.
+
+Spec syntax (comma-separated specs; `key=value` constraints after the kind):
+
+    PDMT_FAULT="kill:rank=2:step=5"              # SIGKILL this process
+    PDMT_FAULT="ckpt_save_io:step=3"             # OSError inside ckpt save
+    PDMT_FAULT="loader_stall:batch=3:delay_s=0.5"  # sleep in the loader
+    PDMT_FAULT="collective_timeout:rank=1"       # DEADLINE_EXCEEDED barrier
+
+or `--fault SPEC` on the trainer CLI (env and flag merge). Each spec fires
+at its own fault point:
+
+    kind                fires at           action
+    ----                --------           ------
+    kill                "step"             flight-dump + SIGKILL (no cleanup,
+                                           no atexit — a real preemption)
+    ckpt_save_io        "ckpt_save"        raise OSError before the payload
+                                           rename (save fails, nothing torn)
+    loader_stall        "loader_next"      time.sleep(delay_s) (default 0.5)
+    collective_timeout  "barrier"          raise a DEADLINE_EXCEEDED-shaped
+                                           RuntimeError (matches
+                                           wireup.looks_like_backend_loss —
+                                           the signature triage sees exactly
+                                           what a dead collective produces)
+
+Determinism contract: a spec with `step=K` fires at the FIRST fault-point
+crossing where the reported step is >= K (the epoch-scanned trainer only
+surfaces steps at checkpoint-chunk boundaries, so equality alone could
+never match); `epoch=`/`batch=` match exactly; `rank=` gates on the
+injecting process's rank (set by the CLI after wireup, seeded from $RANK
+before it). Every spec fires at most `times=` times (default 1). Every
+fired fault lands in the telemetry flight recorder as a `fault_injected`
+entry BEFORE the failure happens, so a post-mortem shows what was injected
+even when the action is SIGKILL.
+
+`fire()` with no faults installed is a few-ns no-op (one attribute test) —
+the instrumented hot paths pay nothing in production.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+FAULT_ENV = "PDMT_FAULT"
+
+# kind -> the fault point it fires at. One place to extend.
+POINTS = {
+    "kill": "step",
+    "ckpt_save_io": "ckpt_save",
+    "loader_stall": "loader_next",
+    "collective_timeout": "barrier",
+}
+
+# constraint keys with first-crossing (>=) semantics; all others match ==
+_THRESHOLD_KEYS = ("step",)
+_KNOWN_KEYS = ("step", "epoch", "batch", "rank", "delay_s", "times")
+
+
+class FaultSpecError(ValueError):
+    """A malformed fault spec — named so the CLI can fail at parse time."""
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    point: str
+    where: Dict[str, float] = field(default_factory=dict)  # constraint keys
+    delay_s: float = 0.5
+    times: int = 1
+    fired: int = 0
+
+    def matches(self, rank: int, ctx: Dict[str, float]) -> bool:
+        if self.fired >= self.times:
+            return False
+        if "rank" in self.where and int(self.where["rank"]) != int(rank):
+            return False
+        for key, want in self.where.items():
+            if key == "rank":
+                continue
+            got = ctx.get(key)
+            if got is None:
+                return False
+            if key in _THRESHOLD_KEYS:
+                if got < want:
+                    return False
+            elif got != want:
+                return False
+        return True
+
+    def describe(self) -> str:
+        cons = ":".join(f"{k}={int(v) if float(v).is_integer() else v}"
+                        for k, v in sorted(self.where.items()))
+        return self.kind + (f":{cons}" if cons else "")
+
+
+def parse_faults(text: Optional[str]) -> List[FaultSpec]:
+    """Parse a comma-separated fault-spec string; [] for empty/None.
+
+    Unknown kinds and malformed constraints raise FaultSpecError by name —
+    a chaos run with a typo'd spec must refuse to start, not silently run
+    fault-free and "pass"."""
+    specs: List[FaultSpec] = []
+    for raw in (text or "").split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        kind = parts[0].strip()
+        if kind not in POINTS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} in {raw!r}; known: "
+                f"{sorted(POINTS)}")
+        spec = FaultSpec(kind=kind, point=POINTS[kind])
+        for item in parts[1:]:
+            if "=" not in item:
+                raise FaultSpecError(
+                    f"fault constraint {item!r} in {raw!r} is not key=value")
+            key, _, val = item.partition("=")
+            key = key.strip()
+            if key not in _KNOWN_KEYS:
+                raise FaultSpecError(
+                    f"unknown fault constraint {key!r} in {raw!r}; known: "
+                    f"{_KNOWN_KEYS}")
+            try:
+                num = float(val)
+            except ValueError:
+                raise FaultSpecError(
+                    f"fault constraint {item!r} in {raw!r}: {val!r} is not "
+                    f"a number") from None
+            if key == "delay_s":
+                spec.delay_s = num
+            elif key == "times":
+                spec.times = int(num)
+            else:
+                spec.where[key] = num
+        specs.append(spec)
+    return specs
+
+
+class FaultInjector:
+    """Holds the parsed specs + this process's rank; `fire` is the one
+    entry point the instrumented paths call."""
+
+    def __init__(self, specs: List[FaultSpec], rank: int = 0):
+        self.specs = list(specs)
+        self.rank = int(rank)
+
+    def fire(self, point: str, **ctx) -> None:
+        for spec in self.specs:
+            if spec.point != point or not spec.matches(self.rank, ctx):
+                continue
+            spec.fired += 1
+            self._act(spec, ctx)
+
+    def _act(self, spec: FaultSpec, ctx: Dict[str, float]) -> None:
+        # flight first: the record must exist before the failure does,
+        # because two of the actions never return control.
+        from ..telemetry import flight
+        flight.record("fault_injected", fault=spec.describe(),
+                      point=spec.point, rank=self.rank,
+                      **{k: v for k, v in ctx.items()
+                         if k not in ("fault", "point", "rank")})
+        if spec.kind == "kill":
+            # a real preemption: dump the ring (SIGKILL outruns any atexit),
+            # then die uncleanly — no flushes, no context managers.
+            flight.dump(reason=f"injected fault: {spec.describe()}")
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif spec.kind == "ckpt_save_io":
+            raise OSError(f"injected fault: {spec.describe()} "
+                          f"(simulated checkpoint I/O failure)")
+        elif spec.kind == "loader_stall":
+            time.sleep(spec.delay_s)
+        elif spec.kind == "collective_timeout":
+            # the exact failure class wireup's signature triage handles:
+            # looks_like_backend_loss matches "deadline exceeded"
+            raise RuntimeError(
+                f"DEADLINE_EXCEEDED: injected fault: {spec.describe()} "
+                f"(simulated collective timeout)")
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def _env_injector() -> FaultInjector:
+    rank = 0
+    try:
+        rank = int(os.environ.get("RANK", "0"))
+    except ValueError:
+        pass
+    return FaultInjector(parse_faults(os.environ.get(FAULT_ENV)), rank=rank)
+
+
+def install(extra: Optional[str] = None, rank: Optional[int] = None) -> "FaultInjector":
+    """(Re)build the process-wide injector: $PDMT_FAULT specs + `extra`
+    (the CLI --fault value), rank-gated to `rank` when given. Returns the
+    injector (tests hold it to inspect fired counts)."""
+    global _INJECTOR
+    inj = _env_injector()
+    inj.specs.extend(parse_faults(extra))
+    if rank is not None:
+        inj.rank = int(rank)
+    _INJECTOR = inj
+    return inj
+
+
+def set_rank(rank: int) -> None:
+    """Late rank binding: the CLI learns its process index only after
+    wireup; specs parsed earlier start gating on the real rank from here."""
+    get_injector().rank = int(rank)
+
+
+def get_injector() -> FaultInjector:
+    global _INJECTOR
+    if _INJECTOR is None:
+        _INJECTOR = _env_injector()
+    return _INJECTOR
+
+
+def fire(point: str, **ctx) -> None:
+    """Ask the switchboard whether a fault is due at `point`. The no-fault
+    fast path is one None-check plus an empty-list check — safe on hot
+    per-step paths."""
+    inj = _INJECTOR
+    if inj is None:
+        if FAULT_ENV not in os.environ:
+            return  # never configured: stay lazy, stay free
+        inj = get_injector()
+    if inj.specs:
+        inj.fire(point, **ctx)
+
+
+def active() -> bool:
+    """True when any spec is installed (cheap gate for optional plumbing)."""
+    inj = _INJECTOR
+    if inj is None and FAULT_ENV in os.environ:
+        inj = get_injector()
+    return bool(inj and inj.specs)

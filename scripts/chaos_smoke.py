@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Chaos smoke: kill a 4-process training run at a seeded step, resume it,
+and prove bitwise parity with the unbroken run.
+
+    python scripts/chaos_smoke.py [--workdir DIR] [--chaos_seed N]
+                                  [--world 4] [--epochs 3] ...
+
+The front door of docs/ROBUSTNESS.md (`make chaos-smoke`). One invocation
+runs the whole chaos matrix on fake CPU devices:
+
+  1. BASELINE — an unbroken `--parallel --cached` world with
+     `--ckpt_every_steps`, producing the golden final checkpoint;
+  2. CHAOS    — the same world with `PDMT_FAULT=kill:rank=R:step=K`
+     (R and K drawn from --chaos_seed: random-but-seeded, reproducible):
+     rank R SIGKILLs itself mid-epoch at the first step boundary >= K,
+     the survivors are reaped (a gang scheduler killing the job), and the
+     step-checkpoint directory is left exactly as the crash left it;
+  3. RESUME   — a fresh world relaunched with `--resume <ckpt dir>`: every
+     rank restores the newest INTACT checkpoint (falling back past a torn
+     one if the kill interrupted a save) and finishes the run;
+  4. VERDICT  — the resumed final checkpoint must be BYTE-IDENTICAL to the
+     baseline's, and the resumed run's telemetry must schema-validate and
+     carry the checkpoint.* metrics (`check_telemetry --require checkpoint.`).
+
+Exit codes: 0 = parity held; 1 = any phase failed (with the failing rank's
+output on stderr); 75 = skipped, this jax has no CPU multiprocess
+collectives (same convention as measure_hw.sh's skipped phase).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, port: int, argv, world: int, extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(world),
+        "RANK": str(rank),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train", *argv],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _run_world(argv, world: int, timeout: float, extra_env=None):
+    """Run a world to completion; returns [(rc, out, err)] per rank."""
+    procs = [_spawn(r, _port_box["port"], argv, world, extra_env)
+             for r in range(world)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, err = p.communicate()
+            outs.append((None, out, err))
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+    return outs
+
+
+_port_box = {"port": 0}
+
+
+def _run_chaos_world(argv, world: int, kill_rank: int, timeout: float,
+                     fault: str):
+    """Run a world expecting rank `kill_rank` to die by SIGKILL; once it
+    does, reap the survivors (the gang-scheduler model: one task dead ==
+    job dead). Returns the killed rank's returncode (-9 expected)."""
+    procs = [_spawn(r, _port_box["port"], argv, world,
+                    {"PDMT_FAULT": fault})
+             for r in range(world)]
+    deadline = time.monotonic() + timeout
+    victim = procs[kill_rank]
+    while victim.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.25)
+    rc = victim.poll()
+    # reap the survivors: they are blocked in a collective whose peer died
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.communicate()
+    return rc
+
+
+def _final_params(path: str):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="4-process kill/resume chaos parity smoke")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh temp dir)")
+    ap.add_argument("--chaos_seed", type=int, default=0,
+                    help="seeds the (kill rank, kill step) draw")
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--limit", type=int, default=1024)
+    ap.add_argument("--batch_size", type=int, default=32)
+    ap.add_argument("--ckpt_every_steps", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=420.0,
+                    help="per-world wall bound (seconds)")
+    ap.add_argument("--keep_workdir", action="store_true")
+    a = ap.parse_args(argv)
+
+    # CPU multiprocess collectives need jax >= 0.5 (same gate as
+    # tests/test_multiprocess.py): absent capability = skip, not failure.
+    # A --world 1 run has no cross-process collective and stays valid
+    # everywhere (the driver-mechanics fallback for older jaxlibs).
+    import jax
+    if (a.world > 1
+            and tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5)):
+        print("chaos_smoke: SKIP — this jaxlib has no CPU multiprocess "
+              "collectives (needs jax >= 0.5)", file=sys.stderr)
+        return 75
+
+    work = a.workdir or tempfile.mkdtemp(prefix="pdmt_chaos_")
+    os.makedirs(work, exist_ok=True)
+    golden = os.path.join(work, "golden.msgpack")
+    flaky = os.path.join(work, "flaky.msgpack")
+    steps_dir = flaky + ".steps"
+    telemetry = os.path.join(work, "telemetry")
+
+    # the per-rank steps per epoch: ceil(limit / (batch * world)) — kill
+    # somewhere strictly inside the run, never in the final epoch's tail
+    # (a kill after the last checkpoint would still pass, but killing
+    # mid-epoch is the property this smoke exists to exercise)
+    steps_per_epoch = -(-a.limit // (a.batch_size * a.world))
+    total = steps_per_epoch * a.epochs
+    rng = random.Random(a.chaos_seed)
+    kill_rank = rng.randrange(a.world)
+    # the draw needs a checkpoint BEFORE the kill (lo >= first save) and
+    # the kill strictly inside the run; refuse impossible geometry by name
+    # rather than crashing on an empty randrange
+    lo = max(1, a.ckpt_every_steps)
+    if lo >= total:
+        print(f"chaos_smoke: ERROR — ckpt_every_steps={a.ckpt_every_steps} "
+              f">= the run's {total} total steps ({steps_per_epoch}/epoch x "
+              f"{a.epochs} epochs): no step checkpoint would ever commit "
+              f"before the kill. Lower --ckpt_every_steps or raise "
+              f"--epochs/--limit.", file=sys.stderr)
+        return 2
+    kill_step = rng.randrange(lo, max(lo + 1, total - steps_per_epoch))
+    fault = f"kill:rank={kill_rank}:step={kill_step}"
+    print(f"chaos_smoke: world={a.world} steps/epoch={steps_per_epoch} "
+          f"chaos_seed={a.chaos_seed} -> {fault}")
+
+    base = ["--parallel", "--cached", "--wireup_method", "env",
+            "--n_epochs", str(a.epochs), "--limit", str(a.limit),
+            "--batch_size", str(a.batch_size), "--lr", "0.1",
+            "--path", os.path.join(work, "data"),
+            "--ckpt_every_steps", str(a.ckpt_every_steps)]
+
+    def fail(phase, outs):
+        print(f"chaos_smoke: FAIL in {phase}", file=sys.stderr)
+        for rank, (rc, out, err) in enumerate(outs):
+            print(f"--- rank {rank} rc={rc}\n{out}\n{err}",
+                  file=sys.stderr)
+        return 1
+
+    # 1. baseline
+    _port_box["port"] = _free_port()
+    outs = _run_world(base + ["--checkpoint", golden], a.world, a.timeout)
+    if any(rc != 0 for rc, _, _ in outs):
+        return fail("baseline", outs)
+
+    # 2. chaos: seeded SIGKILL mid-run
+    _port_box["port"] = _free_port()
+    rc = _run_chaos_world(base + ["--checkpoint", flaky], a.world,
+                          kill_rank, a.timeout, fault)
+    if rc != -9:
+        print(f"chaos_smoke: FAIL — killed rank exited rc={rc}, "
+              f"expected SIGKILL (-9)", file=sys.stderr)
+        return 1
+    if not os.path.isdir(steps_dir) or not os.listdir(steps_dir):
+        print(f"chaos_smoke: FAIL — no step checkpoints under {steps_dir}",
+              file=sys.stderr)
+        return 1
+
+    # 3. resume from the crash-consistent directory, telemetry on
+    _port_box["port"] = _free_port()
+    outs = _run_world(base + ["--checkpoint", flaky,
+                              "--resume", steps_dir,
+                              "--telemetry", telemetry],
+                      a.world, a.timeout)
+    if any(rc != 0 for rc, _, _ in outs):
+        return fail("resume", outs)
+    if "[ckpt] resuming from" not in outs[0][2]:
+        return fail("resume (no restore line on rank 0)", outs)
+
+    # 4a. bitwise parity of the final checkpoints
+    if _final_params(golden) != _final_params(flaky):
+        print("chaos_smoke: FAIL — resumed final checkpoint differs from "
+              "the unbroken baseline", file=sys.stderr)
+        return 1
+
+    # 4b. telemetry schema + checkpoint.* metric gate
+    check = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "check_telemetry.py"),
+         "--require", "checkpoint.", telemetry],
+        capture_output=True, text=True)
+    if check.returncode != 0:
+        print(f"chaos_smoke: FAIL — telemetry gate:\n{check.stdout}"
+              f"\n{check.stderr}", file=sys.stderr)
+        return 1
+
+    print(json.dumps({
+        "chaos_smoke": "ok", "world": a.world, "chaos_seed": a.chaos_seed,
+        "kill_rank": kill_rank, "kill_step": kill_step,
+        "steps_per_epoch": steps_per_epoch,
+        "parity": "bitwise", "telemetry": "validated",
+    }))
+    if not a.keep_workdir and a.workdir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

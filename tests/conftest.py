@@ -50,6 +50,7 @@ _INTEGRATION_FILES = {
     "test_cli.py",            # full trainer CLI configs end-to-end
     "test_measure_scripts.py",  # measure_hw.sh / hw_window.sh shell runs
     "test_outage_resume.py",  # repeated full training runs + re-exec paths
+    "test_chaos.py",          # SIGKILL/resume chaos worlds via subprocess
 }
 
 

@@ -1,0 +1,127 @@
+"""Chaos matrix: REAL SIGKILLs at seeded steps, relaunch, bitwise parity.
+
+The crash-consistency claims of train/ckpt_manager.py are only claims until
+a process actually dies mid-run: these tests kill trainer processes with
+SIGKILL (no cleanup, no atexit — a real preemption) at a
+random-but-seeded step via utils/faultpoints, relaunch with
+`--resume <ckpt dir>`, and assert the finished params are byte-identical
+to an unbroken run's. The 4-process version drives `scripts/chaos_smoke.py`
+(the `make chaos-smoke` front door); the multi-seed soak is `slow`.
+"""
+
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_JAX_V = tuple(int(x) for x in jax.__version__.split(".")[:2])
+
+
+def _run_cli(args, extra_env=None, timeout=240):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _ckpt_bytes(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def test_serial_kill_at_seeded_step_resumes_bitwise(tmp_path):
+    """Kill-at-step-k, serial: SIGKILL at a seeded mid-epoch step, relaunch
+    with --resume <steps dir>, finish — final checkpoint byte-identical to
+    the unbroken run. Then deliberately TRUNCATE the newest checkpoint and
+    resume again from an earlier intact one: parity must still hold and
+    the relaunch must log the fallback (acceptance criterion #3)."""
+    base = ["--limit", "512", "--batch_size", "64", "--lr", "0.1",
+            "--cached", "--n_epochs", "3", "--path", str(tmp_path / "data"),
+            "--ckpt_every_steps", "2"]
+    steps_per_epoch = 8                      # 512 / 64
+    rng = random.Random(42)
+    kill_step = rng.randrange(2, 2 * steps_per_epoch)  # seeded, mid-run
+
+    golden = tmp_path / "golden.msgpack"
+    r = _run_cli(base + ["--checkpoint", str(golden)])
+    assert r.returncode == 0, r.stderr
+
+    flaky = tmp_path / "flaky.msgpack"
+    r = _run_cli(base + ["--checkpoint", str(flaky)],
+                 extra_env={"PDMT_FAULT": f"kill:step={kill_step}"})
+    assert r.returncode == -9, (r.returncode, r.stderr[-2000:])
+    steps_dir = tmp_path / "flaky.msgpack.steps"
+    saved = sorted(p for p in os.listdir(steps_dir) if p.endswith(".json"))
+    assert saved, "the killed run left no committed step checkpoints"
+
+    r = _run_cli(base + ["--checkpoint", str(flaky),
+                         "--resume", str(steps_dir)])
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "[ckpt] resuming from" in r.stderr
+    assert _ckpt_bytes(golden) == _ckpt_bytes(flaky)
+
+    # -- torn-newest leg: truncate the newest payload, resume again --------
+    newest = sorted(p for p in os.listdir(steps_dir)
+                    if p.endswith(".msgpack"))[-1]
+    blob = (steps_dir / newest).read_bytes()
+    (steps_dir / newest).write_bytes(blob[: len(blob) // 2])
+    torn = tmp_path / "torn.msgpack"
+    r = _run_cli(base + ["--checkpoint", str(torn),
+                         "--resume", str(steps_dir)])
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "skipping torn checkpoint" in r.stderr      # the fallback, named
+    assert "[ckpt] resuming from" in r.stderr
+    assert _ckpt_bytes(golden) == _ckpt_bytes(torn)
+
+
+@pytest.mark.skipif(_JAX_V < (0, 5),
+                    reason="CPU multiprocess collectives need jax >= 0.5")
+def test_four_process_kill_chaos_via_smoke_script(tmp_path):
+    """THE acceptance run, through the front door: scripts/chaos_smoke.py
+    SIGKILLs a seeded rank of a 4-process world at a seeded mid-epoch
+    step, reaps the survivors, relaunches with --resume, and asserts
+    bitwise parity + telemetry (`check_telemetry --require checkpoint.`)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join("scripts", "chaos_smoke.py"),
+         "--workdir", str(tmp_path), "--keep_workdir",
+         "--chaos_seed", "7", "--limit", "512"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode == 75:
+        pytest.skip("chaos_smoke skipped: no CPU multiprocess collectives")
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert '"parity": "bitwise"' in r.stdout
+    assert '"telemetry": "validated"' in r.stdout
+    # the chaos world really did kill a rank mid-run and leave evidence
+    assert (tmp_path / "flaky.msgpack.steps").is_dir()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(_JAX_V < (0, 5),
+                    reason="CPU multiprocess collectives need jax >= 0.5")
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_soak_multi_seed(tmp_path, seed):
+    """The long chaos soak: the same 4-process kill/resume matrix across
+    several seeds (different kill rank AND kill step each time). Marked
+    slow — tier-1 runs the single-seed smoke above."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join("scripts", "chaos_smoke.py"),
+         "--workdir", str(tmp_path), "--chaos_seed", str(seed),
+         "--limit", "512"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    if r.returncode == 75:
+        pytest.skip("chaos_smoke skipped: no CPU multiprocess collectives")
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"

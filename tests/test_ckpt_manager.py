@@ -1,0 +1,319 @@
+"""Crash-consistent step-granular checkpointing (train/ckpt_manager.py).
+
+Unit tier: manifest/CRC/rotation/fallback semantics, the CheckpointError
+wrap on torn msgpack files, the step-position normalization shared by both
+trainers, and in-process mid-epoch resume parity through fit/fit_cached
+(the subprocess SIGKILL versions live in tests/test_chaos.py)."""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_ddp_mnist_tpu.models import init_mlp
+from pytorch_ddp_mnist_tpu.telemetry import get_registry
+from pytorch_ddp_mnist_tpu.telemetry.flight import get_flight_recorder
+from pytorch_ddp_mnist_tpu.train.checkpoint import (CheckpointError,
+                                                    load_checkpoint,
+                                                    save_checkpoint)
+from pytorch_ddp_mnist_tpu.train.ckpt_manager import CheckpointManager
+from pytorch_ddp_mnist_tpu.train.loop import step_ckpt_positions
+
+
+def _params(seed=0):
+    return init_mlp(jax.random.key(seed))
+
+
+def _key_data(seed=1):
+    return np.asarray(jax.random.key_data(jax.random.key(seed)))
+
+
+def _save(mgr, step, epoch=0, offset=0, seed=0):
+    return mgr.save(_params(seed), _key_data(), "threefry2x32",
+                    step=step, epoch=epoch, offset=offset)
+
+
+def _flight_kinds():
+    return [e["kind"] for e in get_flight_recorder().snapshot()]
+
+
+def test_save_restore_roundtrip_carries_full_state(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=7, epoch=1, offset=3, seed=5)
+    got = mgr.restore_latest(_params(0))
+    assert (got.step, got.epoch, got.offset) == (7, 1, 3)
+    assert got.impl == "threefry2x32"
+    np.testing.assert_array_equal(got.key_data, _key_data())
+    for a, b in zip(jax.tree_util.tree_leaves(got.params),
+                    jax.tree_util.tree_leaves(_params(5))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_payload_is_plain_save_checkpoint_format(tmp_path):
+    """A manager payload is byte-identical to what save_checkpoint writes —
+    load_checkpoint reads it directly (one format, two front doors)."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=1, seed=3)
+    via_plain = tmp_path / "plain.msgpack"
+    save_checkpoint(str(via_plain), _params(3))
+    payload = tmp_path / "s" / "step_00000001.msgpack"
+    assert payload.read_bytes() == via_plain.read_bytes()
+    loaded = load_checkpoint(str(payload), _params(0))
+    for a, b in zip(jax.tree_util.tree_leaves(loaded),
+                    jax.tree_util.tree_leaves(_params(3))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rotation_keeps_last_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=2)
+    for s in (2, 4, 6, 8):
+        _save(mgr, step=s)
+    assert mgr.steps() == [6, 8]
+    names = sorted(os.listdir(tmp_path / "s"))
+    assert names == ["step_00000006.json", "step_00000006.msgpack",
+                     "step_00000008.json", "step_00000008.msgpack"]
+
+
+def test_truncated_newest_falls_back_and_records_flight(tmp_path):
+    """THE acceptance property: newest payload truncated -> restore returns
+    the previous intact checkpoint and the fallback lands in the flight
+    recorder."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=2, seed=1)
+    _save(mgr, step=4, epoch=0, offset=4, seed=2)
+    newest = tmp_path / "s" / "step_00000004.msgpack"
+    newest.write_bytes(newest.read_bytes()[: newest.stat().st_size // 2])
+    before = len(get_flight_recorder().snapshot())
+    got = mgr.restore_latest(_params(0))
+    assert got.step == 2
+    for a, b in zip(jax.tree_util.tree_leaves(got.params),
+                    jax.tree_util.tree_leaves(_params(1))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tail = get_flight_recorder().snapshot()[before:]
+    kinds = [e["kind"] for e in tail]
+    assert "checkpoint_fallback" in kinds
+    fb = tail[kinds.index("checkpoint_fallback")]
+    assert fb["step"] == 4 and "truncated" in fb["error"]
+    restore = tail[kinds.index("checkpoint_restore")]
+    assert restore["step"] == 2 and restore["fallbacks"] == 1
+
+
+def test_crc_mismatch_falls_back(tmp_path):
+    """Same-length corruption (bit rot) passes the size check and must be
+    caught by the CRC32 stamp."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=2, seed=1)
+    _save(mgr, step=4, seed=2)
+    newest = tmp_path / "s" / "step_00000004.msgpack"
+    blob = bytearray(newest.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    newest.write_bytes(bytes(blob))
+    # ensure the corruption is not a CRC no-op
+    rec = json.loads((tmp_path / "s" / "step_00000004.json").read_text())
+    assert zlib.crc32(bytes(blob)) != rec["crc32"]
+    assert mgr.restore_latest(_params(0)).step == 2
+
+
+def test_missing_payload_and_bad_manifest_fall_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=4)
+    _save(mgr, step=2, seed=1)
+    _save(mgr, step=4, seed=2)
+    _save(mgr, step=6, seed=3)
+    os.unlink(tmp_path / "s" / "step_00000006.msgpack")   # payload gone
+    (tmp_path / "s" / "step_00000004.json").write_text("{not json")
+    assert mgr.restore_latest(_params(0)).step == 2
+
+
+def test_manifest_missing_fields_falls_back_not_keyerror(tmp_path):
+    """Valid JSON missing a required field must surface as a
+    CheckpointError (so restore_latest's fallback walk absorbs it), never
+    a KeyError crashing the relaunch."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=2, seed=1)
+    _save(mgr, step=4, seed=2)
+    m = tmp_path / "s" / "step_00000004.json"
+    rec = json.loads(m.read_text())
+    del rec["bytes"]
+    m.write_text(json.dumps(rec))
+    assert mgr.restore_latest(_params(0)).step == 2
+    with pytest.raises(CheckpointError, match="missing fields"):
+        mgr._load_intact(4, _params(0))
+
+
+def test_geometry_meta_roundtrips_and_cli_refuses_mismatch(tmp_path):
+    """The manifest stamps run geometry; a directory resume under a
+    different global batch is refused by name (a silently re-interpreted
+    (epoch, offset) would walk off the bitwise trajectory)."""
+    from pytorch_ddp_mnist_tpu.cli.train import main
+
+    base = ["--limit", "512", "--lr", "0.1", "--cached", "--n_epochs", "1",
+            "--path", str(tmp_path)]
+    ckpt = tmp_path / "m.msgpack"
+    assert main(base + ["--batch_size", "64", "--checkpoint", str(ckpt),
+                        "--ckpt_every_steps", "3"]) == 0
+    mgr = CheckpointManager(str(tmp_path / "m.msgpack.steps"))
+    assert mgr.restore_latest(_params(0)).meta == {
+        "global_batch": 64, "limit": 512, "sampler_rng": "pcg64"}
+    with pytest.raises(SystemExit, match="global_batch"):
+        main(base + ["--batch_size", "32", "--checkpoint", str(ckpt),
+                     "--resume", str(tmp_path / "m.msgpack.steps")])
+
+
+def test_no_intact_checkpoint_raises_naming_every_tried(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=2)
+    _save(mgr, step=4)
+    for s in (2, 4):
+        (tmp_path / "s" / f"step_{s:08d}.msgpack").write_bytes(b"xx")
+    with pytest.raises(CheckpointError) as ei:
+        mgr.restore_latest(_params(0))
+    msg = str(ei.value)
+    assert "step_00000002.msgpack" in msg and "step_00000004.msgpack" in msg
+
+
+def test_empty_directory_raises_named(tmp_path):
+    with pytest.raises(CheckpointError, match="no committed step"):
+        CheckpointManager(str(tmp_path / "nothing")).restore_latest(
+            _params(0))
+
+
+def test_rotation_sweeps_crash_debris(tmp_path):
+    """A SIGKILL mid-save leaves .tmp strays / manifest-less payloads from
+    the DEAD process; the next successful save sweeps them (each chaos
+    cycle would otherwise grow the directory by one full-size orphan)."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=2, seed=1)
+    (tmp_path / "s" / "step_00000005.msgpack.tmp.99999").write_bytes(b"x")
+    (tmp_path / "s" / "step_00000005.msgpack").write_bytes(b"uncommitted")
+    _save(mgr, step=6, seed=2)
+    assert sorted(os.listdir(tmp_path / "s")) == [
+        "step_00000002.json", "step_00000002.msgpack",
+        "step_00000006.json", "step_00000006.msgpack"]
+
+
+def test_uncommitted_payload_is_invisible(tmp_path):
+    """A payload without its manifest (crash between the two renames) is an
+    uncommitted checkpoint: restore never considers it."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    _save(mgr, step=2, seed=1)
+    # fake a crash: payload landed, manifest did not
+    (tmp_path / "s" / "step_00000009.msgpack").write_bytes(b"partial")
+    assert mgr.steps() == [2]
+    assert mgr.restore_latest(_params(0)).step == 2
+
+
+def test_injected_save_io_fault_fails_cleanly(tmp_path, monkeypatch):
+    """PDMT_FAULT=ckpt_save_io:step=K: save K raises CheckpointError, no
+    torn state is left behind, and prior checkpoints stay restorable."""
+    from pytorch_ddp_mnist_tpu.utils import faultpoints
+    monkeypatch.setenv("PDMT_FAULT", "ckpt_save_io:step=4")
+    faultpoints.install()
+    try:
+        mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+        _save(mgr, step=2, seed=1)
+        with pytest.raises(CheckpointError, match="step 4"):
+            _save(mgr, step=4, seed=2)
+        assert mgr.steps() == [2]
+        assert not [n for n in os.listdir(tmp_path / "s") if ".tmp" in n]
+        assert mgr.restore_latest(_params(0)).step == 2
+        _save(mgr, step=6, seed=3)      # the fault fired once; saves resume
+        assert mgr.steps() == [2, 6]
+    finally:
+        monkeypatch.delenv("PDMT_FAULT")
+        faultpoints.install()
+
+
+def test_save_publishes_registry_metrics(tmp_path):
+    reg = get_registry()
+    hist = reg.histogram("checkpoint.save_s")
+    ctr = reg.counter("checkpoint.bytes")
+    h0, c0 = hist.n, ctr.value
+    _save(CheckpointManager(str(tmp_path / "s")), step=1)
+    assert hist.n == h0 + 1
+    assert ctr.value > c0
+
+
+def test_load_checkpoint_wraps_torn_msgpack(tmp_path):
+    """Satellite: a truncated/corrupt msgpack surfaces as CheckpointError
+    naming the path and byte size, not a raw flax/msgpack traceback."""
+    good = tmp_path / "good.msgpack"
+    save_checkpoint(str(good), _params(0))
+    torn = tmp_path / "torn.msgpack"
+    torn.write_bytes(good.read_bytes()[:100])
+    with pytest.raises(CheckpointError) as ei:
+        load_checkpoint(str(torn), _params(0))
+    assert "torn.msgpack" in str(ei.value) and "100 bytes" in str(ei.value)
+    assert isinstance(ei.value, RuntimeError)  # old except-clauses still work
+
+
+def test_step_ckpt_positions_normalizes_epoch_final_step():
+    assert step_ckpt_positions(8, epoch=2, i=3) == (2, 4)
+    # the state after an epoch's last step IS the next epoch's start
+    assert step_ckpt_positions(8, epoch=2, i=7) == (3, 0)
+
+
+@pytest.mark.parametrize("cached", [True, False], ids=["cached", "streaming"])
+def test_midepoch_resume_is_bitwise_identical(tmp_path, cached):
+    """In-process resume parity for BOTH trainers: restore a mid-epoch step
+    checkpoint and replay the remaining steps — final params bitwise equal
+    to the unbroken run. (The SIGKILL versions are tests/test_chaos.py.)"""
+    from pytorch_ddp_mnist_tpu.cli.train import main
+    from pytorch_ddp_mnist_tpu.train.checkpoint import load_checkpoint
+
+    base = ["--limit", "512", "--batch_size", "64", "--lr", "0.1",
+            "--n_epochs", "2", "--path", str(tmp_path)] + (
+                ["--cached"] if cached else [])
+    golden = tmp_path / "golden.msgpack"
+    assert main(base + ["--checkpoint", str(golden)]) == 0
+
+    work = tmp_path / "work.msgpack"
+    assert main(base + ["--checkpoint", str(work),
+                        "--ckpt_every_steps", "3"]) == 0
+    steps_dir = tmp_path / "work.msgpack.steps"
+    mgr = CheckpointManager(str(steps_dir))
+    # drop back to a MID-epoch checkpoint (8 steps/epoch; keep-last-3 of
+    # the 2-epoch run holds steps 11, 14, 16 — 14 is (epoch 1, offset 6))
+    mid = [s for s in mgr.steps() if mgr._load_intact(s, _params(0)).offset]
+    assert mid, mgr.steps()
+    for s in mgr.steps():
+        if s > mid[-1]:
+            os.unlink(steps_dir / f"step_{s:08d}.json")
+            os.unlink(steps_dir / f"step_{s:08d}.msgpack")
+    resumed = tmp_path / "resumed.msgpack"
+    assert main(base + ["--checkpoint", str(resumed),
+                        "--ckpt_every_steps", "3",
+                        "--resume", str(steps_dir)]) == 0
+    for name in ("work.msgpack", "resumed.msgpack"):
+        got = load_checkpoint(str(tmp_path / name), _params(0))
+        want = load_checkpoint(str(golden), _params(0))
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cli_flag_rejections(tmp_path):
+    """--ckpt_every_steps composition limits + --fault parse errors fail at
+    the CLI boundary by name."""
+    from pytorch_ddp_mnist_tpu.cli.train import main
+
+    base = ["--path", str(tmp_path)]
+    with pytest.raises(SystemExit, match="fused"):
+        main(base + ["--cached", "--fused", "--ckpt_every_steps", "2"])
+    with pytest.raises(SystemExit, match="pallas_epoch"):
+        main(base + ["--cached", "--kernel", "pallas_epoch",
+                     "--ckpt_every_steps", "2"])
+    with pytest.raises(SystemExit, match="checkpoint"):
+        main(base + ["--ckpt_every_steps", "2", "--checkpoint", ""])
+    with pytest.raises(SystemExit, match="ckpt_keep"):
+        main(base + ["--ckpt_every_steps", "2", "--ckpt_keep", "0"])
+    with pytest.raises(SystemExit, match="unknown fault kind"):
+        main(base + ["--fault", "explode:step=1"])
+    with pytest.raises(SystemExit, match="start_epoch conflicts"):
+        d = tmp_path / "steps"
+        d.mkdir()
+        main(base + ["--resume", str(d), "--start_epoch", "1",
+                     "--n_epochs", "2"])

@@ -96,6 +96,29 @@ def test_batch_loader_static_shapes_and_coverage():
         assert by.dtype == np.int32  # uint8 -> int32 cast (SURVEY §7 item 9)
 
 
+def test_batch_loader_iter_from_skips_without_gathering():
+    """iter_from(n) (the mid-epoch resume path) yields exactly the tail of
+    a full iteration — and never indexes the skipped batches' rows."""
+    split = synthetic_mnist(130, seed=3)
+    x = normalize_images(split.images)
+    sampler = ShardedSampler(130, num_replicas=2, rank=0, shuffle=True)
+    loader = BatchLoader(x, split.labels, sampler, batch_size=32)
+    full = list(loader)
+    tail = list(loader.iter_from(2))
+    assert len(tail) == len(full) - 2
+    for (fx, fy), (tx, ty) in zip(full[2:], tail):
+        np.testing.assert_array_equal(fx, tx)
+        np.testing.assert_array_equal(fy, ty)
+
+    class Booby(np.ndarray):
+        def __getitem__(self, idx):
+            raise AssertionError("skipped batches must never be gathered")
+
+    # skipping EVERYTHING must touch no rows at all
+    loader.images = np.asarray(x).view(Booby)
+    assert list(loader.iter_from(len(full))) == []
+
+
 def test_device_prefetch_order_and_edges():
     """device_prefetch must yield every batch, in order, with one batch of
     lookahead — including the 1-batch and 0-batch edge cases."""

@@ -183,6 +183,70 @@ def test_download_module_cli(mirror, tmp_path, monkeypatch):
         assert (dest / name).exists()
 
 
+@pytest.fixture()
+def truncating_mirror(mirror, tmp_path):
+    """A mirror serving TRUNCATED copies of the fixture artifacts (the
+    injected fault: a connection dropped mid-body that still delivers
+    HTTP 200 — half the bytes, no gzip trailer). Yields its URL."""
+    url, manifest = mirror
+    docroot = tmp_path / "truncated"
+    docroot.mkdir()
+    import urllib.request
+    for name in manifest:
+        with urllib.request.urlopen(url + name) as r:
+            payload = r.read()
+        (docroot / name).write_bytes(payload[: len(payload) // 2])
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+        *a, directory=str(docroot), **kw)
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_port}/"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_truncated_first_mirror_fails_over_to_intact_second(
+        truncating_mirror, mirror, tmp_path):
+    """Mirror-failover under an injected truncation fault: mirror 1 serves
+    half the payload (checksum rejects it), mirror 2 is intact — the fetch
+    must succeed with verified bytes and leave no .part litter."""
+    url, manifest = mirror
+    name = "train-images-idx3-ubyte.gz"
+    dest = tmp_path / "dst"
+    out = download_file(name, str(dest),
+                        mirrors=[truncating_mirror, url],
+                        md5=manifest[name])
+    assert os.path.exists(out)
+    with open(out, "rb") as f:
+        payload = f.read()
+    assert hashlib.md5(payload).hexdigest() == manifest[name]
+    assert [p for p in os.listdir(dest) if p.endswith(".part")] == []
+
+
+def test_all_mirrors_failing_names_every_mirror_tried(
+        truncating_mirror, mirror, tmp_path):
+    """Total failure must produce ONE error naming every mirror and its
+    individual defect — the evidence an operator needs to tell 'my network
+    is down' from 'one mirror is corrupt'."""
+    url, manifest = mirror
+    name = "t10k-images-idx3-ubyte.gz"
+    dead = "http://127.0.0.1:9/"
+    with pytest.raises(DownloadError) as ei:
+        download_file(name, str(tmp_path / "dst"),
+                      mirrors=[truncating_mirror, dead],
+                      md5=manifest[name])
+    msg = str(ei.value)
+    assert truncating_mirror + name in msg
+    assert dead + name in msg
+    assert "checksum mismatch" in msg        # the truncated mirror's defect
+    # and the whole-manifest front door surfaces the same failure
+    with pytest.raises(DownloadError, match="could not download"):
+        download_mnist(str(tmp_path / "dst2"),
+                       mirrors=[dead], files=manifest)
+
+
 def test_real_manifest_and_mirrors_shape():
     """The production manifest lists the four canonical artifacts with
     32-hex digests, and mirror URLs are well-formed."""

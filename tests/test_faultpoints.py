@@ -1,0 +1,188 @@
+"""Deterministic fault injection (utils/faultpoints.py): spec parsing,
+matching semantics (first-crossing steps, rank gating, fire-once), each
+action's behavior, flight-recorder evidence, and the instrumented fault
+points in the loader and wireup barrier."""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.telemetry.flight import get_flight_recorder
+from pytorch_ddp_mnist_tpu.utils import faultpoints
+from pytorch_ddp_mnist_tpu.utils.faultpoints import (FaultInjector,
+                                                     FaultSpecError,
+                                                     parse_faults)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector(monkeypatch):
+    """Each test builds its own injector; none leaks into the next."""
+    monkeypatch.delenv(faultpoints.FAULT_ENV, raising=False)
+    faultpoints.install()
+    yield
+    faultpoints.install()
+
+
+# -- parsing ----------------------------------------------------------------
+
+def test_parse_empty_and_none():
+    assert parse_faults(None) == []
+    assert parse_faults("") == []
+    assert parse_faults(" , ") == []
+
+
+def test_parse_full_specs():
+    specs = parse_faults("kill:rank=2:step=5,"
+                         "loader_stall:batch=3:delay_s=0.25:times=2")
+    assert [s.kind for s in specs] == ["kill", "loader_stall"]
+    assert specs[0].point == "step"
+    assert specs[0].where == {"rank": 2, "step": 5}
+    assert specs[1].point == "loader_next"
+    assert specs[1].delay_s == 0.25 and specs[1].times == 2
+
+
+@pytest.mark.parametrize("bad, match", [
+    ("explode:step=1", "unknown fault kind"),
+    ("kill:when=5", "unknown fault constraint"),
+    ("kill:step", "not key=value"),
+    ("kill:step=soon", "not a number"),
+])
+def test_parse_rejects_by_name(bad, match):
+    with pytest.raises(FaultSpecError, match=match):
+        parse_faults(bad)
+
+
+# -- matching ---------------------------------------------------------------
+
+def test_step_is_first_crossing_and_fires_once():
+    """step=K fires at the FIRST crossing >= K (the epoch-scanned trainer
+    only surfaces chunk boundaries), then never again (times=1)."""
+    inj = FaultInjector(parse_faults("ckpt_save_io:step=5"))
+    inj.fire("ckpt_save", step=4)              # below: no fire
+    with pytest.raises(OSError, match="ckpt_save_io"):
+        inj.fire("ckpt_save", step=6)          # first crossing
+    inj.fire("ckpt_save", step=7)              # already fired: no-op
+    assert inj.specs[0].fired == 1
+
+
+def test_times_budget():
+    inj = FaultInjector(parse_faults("ckpt_save_io:times=2"))
+    for _ in range(2):
+        with pytest.raises(OSError):
+            inj.fire("ckpt_save", step=0)
+    inj.fire("ckpt_save", step=0)
+    assert inj.specs[0].fired == 2
+
+
+def test_rank_gating():
+    spec = "collective_timeout:rank=2"
+    FaultInjector(parse_faults(spec), rank=1).fire("barrier")  # wrong rank
+    with pytest.raises(RuntimeError, match="DEADLINE_EXCEEDED"):
+        FaultInjector(parse_faults(spec), rank=2).fire("barrier")
+
+
+def test_wrong_point_never_matches():
+    inj = FaultInjector(parse_faults("ckpt_save_io"))
+    inj.fire("step", step=1)
+    inj.fire("barrier")
+    assert inj.specs[0].fired == 0
+
+
+# -- actions ----------------------------------------------------------------
+
+def test_collective_timeout_matches_backend_loss_triage():
+    """The injected barrier failure must look EXACTLY like the failure
+    class the outage machinery triages on."""
+    from pytorch_ddp_mnist_tpu.parallel.wireup import looks_like_backend_loss
+    inj = FaultInjector(parse_faults("collective_timeout"))
+    with pytest.raises(RuntimeError) as ei:
+        inj.fire("barrier")
+    assert looks_like_backend_loss(ei.value)
+
+
+def test_loader_stall_sleeps():
+    inj = FaultInjector(parse_faults("loader_stall:batch=1:delay_s=0.2"))
+    t0 = time.perf_counter()
+    inj.fire("loader_next", batch=0)
+    assert time.perf_counter() - t0 < 0.1      # wrong batch: no stall
+    inj.fire("loader_next", batch=1)
+    assert time.perf_counter() - t0 >= 0.2
+
+
+def test_kill_dumps_flight_then_sigkills(tmp_path, monkeypatch):
+    killed = {}
+    monkeypatch.setattr(faultpoints.os, "kill",
+                        lambda pid, sig: killed.update(pid=pid, sig=sig))
+    rec = get_flight_recorder()
+    monkeypatch.setattr(rec, "dump_dir", str(tmp_path))
+    inj = FaultInjector(parse_faults("kill:step=3"))
+    inj.fire("step", step=3, epoch=0)
+    assert killed == {"pid": os.getpid(), "sig": signal.SIGKILL}
+    # the dump landed BEFORE the (stubbed) SIGKILL, with the fault in it
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight.")]
+    assert len(dumps) == 1
+    import json
+    payload = json.loads((tmp_path / dumps[0]).read_text())
+    assert "injected fault: kill:step=3" in payload["reason"]
+
+
+def test_every_fired_fault_lands_in_flight_recorder():
+    before = len(get_flight_recorder().snapshot())
+    inj = FaultInjector(parse_faults("loader_stall:delay_s=0.0"), rank=3)
+    inj.fire("loader_next", batch=7)
+    tail = get_flight_recorder().snapshot()[before:]
+    assert [e["kind"] for e in tail] == ["fault_injected"]
+    assert tail[0]["fault"] == "loader_stall"
+    assert tail[0]["rank"] == 3 and tail[0]["batch"] == 7
+
+
+# -- module-level switchboard ----------------------------------------------
+
+def test_fire_is_noop_without_config():
+    faultpoints.fire("step", step=1)           # nothing installed: no-op
+    assert not faultpoints.active()
+
+
+def test_env_driven_lazy_install(monkeypatch):
+    monkeypatch.setenv(faultpoints.FAULT_ENV, "ckpt_save_io:step=1")
+    faultpoints._INJECTOR = None               # simulate fresh process
+    with pytest.raises(OSError, match="injected fault"):
+        faultpoints.fire("ckpt_save", step=1)
+    assert faultpoints.active()
+
+
+def test_install_merges_env_and_cli(monkeypatch):
+    monkeypatch.setenv(faultpoints.FAULT_ENV, "loader_stall")
+    inj = faultpoints.install("collective_timeout", rank=2)
+    assert [s.kind for s in inj.specs] == ["loader_stall",
+                                           "collective_timeout"]
+    assert inj.rank == 2
+    faultpoints.set_rank(0)
+    assert inj.rank == 0
+
+
+# -- instrumented fault points ----------------------------------------------
+
+def test_batch_loader_threads_loader_stall(monkeypatch):
+    from pytorch_ddp_mnist_tpu.data.loader import BatchLoader
+    from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
+    monkeypatch.setenv(faultpoints.FAULT_ENV,
+                       "loader_stall:batch=1:delay_s=0.3")
+    faultpoints.install()
+    loader = BatchLoader(np.zeros((8, 4), np.float32),
+                         np.zeros(8, np.uint8),
+                         ShardedSampler(8, shuffle=False), batch_size=4)
+    t0 = time.perf_counter()
+    assert len(list(loader)) == 2
+    assert time.perf_counter() - t0 >= 0.3
+
+
+def test_runtime_barrier_threads_collective_timeout(monkeypatch):
+    from pytorch_ddp_mnist_tpu.parallel.wireup import Runtime
+    monkeypatch.setenv(faultpoints.FAULT_ENV, "collective_timeout")
+    faultpoints.install()
+    with pytest.raises(RuntimeError, match="DEADLINE_EXCEEDED"):
+        Runtime(method="single").barrier()     # size=1: no real collective

@@ -155,6 +155,30 @@ def test_netcdf_shard_loader_readahead_parity(tmp_path):
             np.testing.assert_array_equal(sy, ay)
 
 
+def test_netcdf_shard_loader_iter_from_skips_disk_reads(tmp_path):
+    """iter_from(n) drops skipped batches BEFORE any disk gather (both the
+    sync path and the readahead workers), and yields the exact tail."""
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+
+    split = synthetic_mnist(200, seed=3)
+    path = str(tmp_path / "m.nc")
+    write_mnist_netcdf(path, split.images, split.labels)
+    for nw in (0, 2):
+        ldr = NetCDFShardLoader(path, batch_size=16, num_workers=nw)
+        ldr.sampler = ShardedSampler(200, num_replicas=1, rank=0, seed=42)
+        full = list(ldr)
+        loads = []
+        orig = ldr._load
+        ldr._load = lambda b: loads.append(len(b)) or orig(b)
+        tail = list(ldr.iter_from(10))
+        assert len(tail) == len(full) - 10
+        assert len(loads) == len(tail)     # skipped batches never loaded
+        for (fx, fy), (tx, ty) in zip(full[10:], tail):
+            np.testing.assert_array_equal(fx, tx)
+            np.testing.assert_array_equal(fy, ty)
+
+
 def test_netcdf_shard_loader_readahead_overlaps(tmp_path):
     """With a busy consumer, readahead workers hide the load time: the
     overlapped run must beat the synchronous run (VERDICT r1 item 4

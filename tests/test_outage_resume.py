@@ -171,6 +171,52 @@ def test_wedged_client_persists_and_reexecs_then_completes(
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_default_stash_cleaned_up_after_successful_resume(
+        tmp_path, monkeypatch, capsys):
+    """With --checkpoint '' the wedged-client re-exec stashes progress to
+    the DEFAULT 'outage_resume.msgpack' in the cwd — a file no final save
+    ever overwrites/consumes. A completed resume must remove it and its
+    RNG sidecar instead of leaving them behind forever."""
+    import os
+    import sys
+
+    monkeypatch.chdir(tmp_path)
+    from pytorch_ddp_mnist_tpu.parallel import wireup
+
+    def wedged(max_wait_s):
+        raise wireup.BackendWedgedError("client wedged (simulated)")
+
+    monkeypatch.setattr(wireup, "wait_for_backend", wedged)
+    execs = []
+    monkeypatch.setattr(os, "execv",
+                        lambda exe, argv: execs.append(argv) or (
+                            _ for _ in ()).throw(SystemExit(99)))
+    cli_args = ["--limit", "512", "--batch_size", "64", "--cached",
+                "--n_epochs", "3", "--path", str(tmp_path),
+                "--checkpoint", "", "--outage_retries", "1"]
+    _bomb_fit_cached(monkeypatch, fail_epoch=1)
+    monkeypatch.delenv("PDMT_NO_REEXEC", raising=False)
+    monkeypatch.setattr(sys, "argv", ["train.py"] + cli_args)
+    try:
+        with pytest.raises(SystemExit) as ei:
+            main(None)
+        assert ei.value.code == 99 and len(execs) == 1
+        tail = execs[0][3:]
+        stash = tail[tail.index("--resume") + 1]
+        assert os.path.basename(stash) == "outage_resume.msgpack"
+        assert os.path.exists(stash)
+        assert os.path.exists(stash + ".rng.npz")
+        # run the re-exec'd command line for real: it must complete AND
+        # sweep the now-consumed default stash pair from the cwd
+        monkeypatch.setattr(wireup, "wait_for_backend",
+                            lambda max_wait_s: [])
+        assert main(tail) == 0
+        assert not os.path.exists(stash)
+        assert not os.path.exists(stash + ".rng.npz")
+    finally:
+        os.environ.pop("PDMT_NO_REEXEC", None)
+
+
 def test_program_error_not_retried_on_healthy_backend(tmp_path, monkeypatch):
     """A deterministic program error (no backend-loss signature) on a
     HEALTHY backend must surface immediately instead of burning the retry
