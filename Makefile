@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -83,6 +83,15 @@ chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py || \
 		{ rc=$$?; [ $$rc -eq 75 ] && \
 		JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py --world 1; }
+
+# Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
+# nan:step=K into a short CPU run under --health checkpoint-and-warn and
+# assert the full round trip — a fatal `nan` health event in the trace
+# (check_telemetry --require health.), an INTACT finite checkpoint at a
+# pre-NaN step (the rescue save), and a mid-run Prometheus /metrics
+# scrape answering the registry + health_* gauges.
+health-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/health_smoke.py
 
 native:
 	$(MAKE) -C pytorch_ddp_mnist_tpu/data/native

@@ -111,6 +111,11 @@ def registry_stamp(registry=None) -> dict:
     dev = snap["gauges"].get("device.peak_bytes_in_use")
     if dev is not None:  # absent off-accelerator (CPU has no memory_stats)
         out["device_peak_bytes"] = dev
+    # What degraded, not just that something did: detector fire counts +
+    # worst severity from any watchdog that observed this process (the
+    # device-mode bench runs one over its measured loss curves). A round
+    # that died mid-measure still stamps the signals seen up to the death.
+    out["health_summary"] = telemetry.health_summary(reg)
     return out
 
 
@@ -593,7 +598,7 @@ def _emit_backend_error(e: Exception, tag: str = "backend_unavailable") -> None:
     alone instead of the opaque tails of BENCH_r01-r05. Null when nothing
     was recorded (the failure predates the first probe) or the dump could
     not be written."""
-    from pytorch_ddp_mnist_tpu.telemetry import flight
+    from pytorch_ddp_mnist_tpu.telemetry import flight, health_summary
     dump_path = flight.dump(reason=f"bench {tag}: {str(e)[:200]}")
     print(json.dumps({
         "metric": "mnist_train_images_per_sec_per_chip",
@@ -602,6 +607,10 @@ def _emit_backend_error(e: Exception, tag: str = "backend_unavailable") -> None:
         "vs_baseline": None,
         "error": f"{tag}: {e}",
         "flight_recorder": dump_path,
+        # a failed round names what the watchdog saw degrade before the
+        # death (empty when nothing fired / no watchdog ran) — the
+        # BENCH_r02-r05 tails were opaque precisely for lack of this
+        "health_summary": health_summary(),
     }))
 
 
@@ -945,6 +954,19 @@ def main(argv=None) -> None:
 
     p, k = fresh()
     losses = np.asarray(run_fn(p, k, x_all, y_all, idxs)[2])  # compile + sync
+    # Health watchdog over the measured loss curves (warn policy — a bench
+    # never aborts): NaN/spike/throughput signals land in the registry, so
+    # every artifact line's health_summary stamp (and a failed round's
+    # error line) says WHAT degraded. The hard assert stays the last line
+    # of defense for the artifact's validity.
+    from pytorch_ddp_mnist_tpu.telemetry import HealthConfig, Watchdog
+    # loss-spike detection is off here: every window restarts from FRESH
+    # params, so each curve's full fresh-training dynamic range (first-step
+    # loss >> converged loss) is expected, not an anomaly — NaN and
+    # throughput anomalies are what a bench round can actually degrade on
+    wd = Watchdog(HealthConfig(policy="warn",
+                               loss_spike_ratio=float("inf")))
+    wd.observe(losses, epoch=0, step=losses.size)
     assert np.isfinite(losses).all()
 
     from pytorch_ddp_mnist_tpu.utils import Timer
@@ -953,12 +975,15 @@ def main(argv=None) -> None:
     # 400-epoch default); the tunneled chip shows ~15% invocation-to-
     # invocation swing (docs/PERF.md), so extra windows buy a tighter
     # floor nearly for free.
-    for _ in range(5):
+    for w in range(5):
         p, k = fresh()
         with Timer("window") as t:
             out = run_fn(p, k, x_all, y_all, idxs)
             t.sync(out[2])        # timer exit blocks on the loss curve
         best = min(best, t.seconds)
+        wd.observe(np.asarray(out[2]), epoch=w + 1,
+                   step=(w + 2) * out[2].size,
+                   dt_s=t.seconds, imgs=idxs.size)
 
     imgs = idxs.size  # FUSED_EPOCHS * nbatches * batch
     imgs_per_sec = imgs / best

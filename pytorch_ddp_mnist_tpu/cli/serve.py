@@ -10,7 +10,9 @@ Two front doors over the same `serve.ServeService` request path:
   `{"op": "metrics"}` -> the serving dashboard snapshot; `{"op": "stats"}`
   -> the unified telemetry registry snapshot (serve counters + latency
   histogram, XLA compile counter, memory gauges — docs/OBSERVABILITY.md)
-  alongside the dashboard; backpressure rejections
+  alongside the dashboard; `{"op": "health"}` -> the live SLO view
+  (rolling-window p99 + observed service rate + queue depth — the inputs
+  SLO-aware admission will consume); backpressure rejections
   answer `{"ok": false, "error": ..., "retry_after_ms": ...}` without
   closing the connection. `--port 0` binds an ephemeral port and prints
   `serving on HOST:PORT` (stderr) so a harness can connect. SIGINT/SIGTERM
@@ -67,6 +69,11 @@ async def handle_request(service, req: dict) -> dict:
                                    snapshot — serve.* counters/histograms,
                                    compile counter, memory gauges>,
                                    "serve": <dashboard snapshot>}
+      {"op": "health"}         -> the LIVE health view: the rolling-window
+                                   SLO monitor (rolling p50/p99, observed
+                                   service rate over the recent window —
+                                   what SLO-aware admission will consume)
+                                   plus the instantaneous queue depth
     """
     op = req.get("op")
     if op == "metrics":
@@ -77,6 +84,11 @@ async def handle_request(service, req: dict) -> dict:
         collect_memory(reg)  # stats reads the instant, not construction time
         return {"ok": True, "registry": reg.snapshot(),
                 "serve": service.metrics.snapshot()}
+    if op == "health":
+        return {"ok": True,
+                "health": {**service.metrics.slo.snapshot(),
+                           "queue_depth": service.admission.depth,
+                           "draining": service.admission.draining}}
     pixels = np.asarray(req["pixels"])
     return {"ok": True, "pred": await service.handle(pixels)}
 

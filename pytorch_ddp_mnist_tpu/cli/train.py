@@ -315,6 +315,19 @@ def main(argv=None) -> int:
         raise SystemExit("--ckpt_every_steps must be >= 0")
     if tcfg["ckpt_keep"] < 1:
         raise SystemExit("--ckpt_keep must be >= 1")
+    if tcfg["metrics_port"] is not None and tcfg["metrics_port"] < 0:
+        raise SystemExit("--metrics_port must be >= 0 (0 = ephemeral)")
+    if tcfg["health"] != "off":
+        if tcfg["fused"]:
+            raise SystemExit(
+                "--health observes at the chunk/epoch boundaries the host "
+                "controls; --fused runs all epochs as ONE device program "
+                "with no live host — use plain --cached")
+        if tcfg["health"] == "checkpoint-and-warn" and not tcfg["checkpoint"]:
+            raise SystemExit(
+                "--health checkpoint-and-warn saves the last known-good "
+                "state under <--checkpoint>.steps/; pass a non-empty "
+                "--checkpoint to derive the directory from")
     if tcfg["ckpt_every_steps"]:
         if tcfg["fused"]:
             raise SystemExit(
@@ -457,7 +470,11 @@ def main(argv=None) -> int:
                 train_step = make_dp_train_step(
                     mesh, tcfg["lr"], dtype=tcfg["dtype"],
                     comm=tcfg["ddp_comm"],
-                    bf16_rounding=tcfg["bf16_rounding"])
+                    bf16_rounding=tcfg["bf16_rounding"],
+                    # fold the watchdog's grad-norm/finite-check aux into
+                    # the step program (telemetry/health.py) — rides the
+                    # existing per-epoch loss fetch, zero extra syncs
+                    health=tcfg["health"] != "off")
         put = lambda b: global_batch_from_local(mesh, b)  # noqa: E731
         num_shards = mesh.devices.size  # data sharding is per-device
         local_shards = len(jax.local_devices())
@@ -569,6 +586,7 @@ def main(argv=None) -> int:
             sidecar_box["sidecar"] = None
 
     start_offset = 0           # mid-epoch resume position (directory resume)
+    start_step = 0             # global step at the resume point (watchdog seed)
     if tcfg["resume"] and os.path.isdir(tcfg["resume"]):
         # Step-granular resume: --resume points at a ckpt_manager directory
         # (the <--checkpoint>.steps/ that --ckpt_every_steps writes). The
@@ -632,6 +650,7 @@ def main(argv=None) -> int:
             jax.numpy.asarray(restored.key_data), impl=restored.impl))
         tcfg["start_epoch"] = restored.epoch
         start_offset = restored.offset
+        start_step = restored.step
         # the manifest's PRNG engine is authoritative for the restored key
         # chain; everything downstream (stash keys, sidecars, new step
         # checkpoints) describes THAT key, so the config follows it
@@ -655,6 +674,54 @@ def main(argv=None) -> int:
     if mesh is not None:
         state = TrainState(replicate_state(mesh, state.params),
                            replicate_state(mesh, state.key))
+
+    # --health: the live training-health watchdog (telemetry/health.py).
+    # Detectors run on every rank (each rank's health events land in ITS
+    # trace file, proc-stamped — the cross-process story); the
+    # checkpoint-and-warn RESCUE hook is rank-0-gated like every other
+    # checkpoint write, saving the last known-good state through the same
+    # step-checkpoint manager (atomic, CRC-stamped, geometry-stamped) so
+    # a NaN'd run always leaves an intact pre-poison resume point.
+    watchdog = None
+    if tcfg["health"] != "off":
+        from ..telemetry.health import HealthConfig, Watchdog
+        on_fatal = None
+        if tcfg["health"] == "checkpoint-and-warn" and process_index == 0:
+            from ..train.ckpt_manager import CheckpointManager
+            rescue_mgr = CheckpointManager(tcfg["checkpoint"] + ".steps",
+                                           keep=tcfg["ckpt_keep"])
+
+            def on_fatal(stash):
+                # pin=True: the rescue must survive keep-last-N rotation —
+                # the run keeps training (warn semantics) and its routine
+                # saves would otherwise rotate the one good state away
+                path = rescue_mgr.save(
+                    stash["params"], stash["key"], tcfg["impl"],
+                    step=stash["step"], epoch=stash["epoch"],
+                    offset=stash["offset"],
+                    meta=_run_geometry(tcfg, dcfg, global_batch), pin=True)
+                print(f"[health] rescue checkpoint committed: {path}",
+                      file=sys.stderr, flush=True)
+        watchdog = Watchdog(HealthConfig(policy=tcfg["health"]),
+                            lr=tcfg["lr"], on_fatal=on_fatal,
+                            rank=process_index)
+        watchdog.seed_good(state, epoch=tcfg["start_epoch"],
+                           offset=start_offset, step=start_step)
+
+    # --metrics_port: the live pull endpoint (telemetry/prom.py) — the
+    # unified registry as Prometheus text at GET /metrics, the health
+    # verdict at GET /healthz, from a stdlib daemon thread. Rank 0 only
+    # (one scrape target per host run; every rank's state is visible in
+    # the trace). Started AFTER the watchdog exists so the very first
+    # scrape already shows the health_* gauges (worst severity 0 =
+    # healthy), and before training so a scraper watches the run come up.
+    metrics_server = None
+    if tcfg["metrics_port"] is not None and process_index == 0:
+        from ..telemetry.prom import start_metrics_server
+        metrics_server = start_metrics_server(tcfg["metrics_port"])
+        mhost, mport = metrics_server.server_address[:2]
+        print(f"metrics on http://{mhost}:{mport}/metrics",
+              file=sys.stderr, flush=True)
 
     if process_index == 0:
         print(f"pytorch_ddp_mnist_tpu: devices={jax.device_count()} "
@@ -781,7 +848,8 @@ def main(argv=None) -> int:
                                             else 0),
                               ckpt_every_steps=tcfg["ckpt_every_steps"],
                               step_hook=step_hook,
-                              eval_perm=eval_perm)
+                              eval_perm=eval_perm,
+                              watchdog=watchdog)
     else:
         if tcfg["dropout_rng"] == "torch":
             # Masks stream from torch's bitwise CPU bernoulli stream
@@ -812,9 +880,17 @@ def main(argv=None) -> int:
                                      else 0),
                        ckpt_every_steps=tcfg["ckpt_every_steps"],
                        step_hook=step_hook,
-                       eval_perm=eval_perm)
-    state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
-                                     argv, process_index=process_index)
+                       eval_perm=eval_perm,
+                       watchdog=watchdog)
+    from ..telemetry.health import TrainingHealthError
+    try:
+        state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
+                                         argv, process_index=process_index)
+    except TrainingHealthError as e:
+        # --health abort: the watchdog already emitted the health events
+        # and dumped the flight ring; exit by name, not by traceback (a
+        # diverged model is a diagnosed outcome, not a crash)
+        raise SystemExit(f"[health] {e}")
 
     if tcfg["telemetry"]:
         # End of run: stamp the memory gauges, write the final registry
@@ -860,6 +936,8 @@ def main(argv=None) -> int:
                 os.remove(stale)
             except FileNotFoundError:
                 pass
+    if metrics_server is not None:
+        metrics_server.shutdown()   # daemon thread; explicit close anyway
     return 0
 
 

@@ -65,7 +65,8 @@ def _mesh_axis_size(mesh) -> int:
 
 
 def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
-                    comm: str = "pmean", bf16_rounding: str = "nearest"):
+                    comm: str = "pmean", bf16_rounding: str = "nearest",
+                    health: bool = False):
     """The un-jitted SPMD step program: (params, key, x, y) ->
     (params', key', loss) over `mesh` (a Mesh, or an AbstractMesh for
     client-side export lowering — tests/test_export_lowering.py).
@@ -77,8 +78,20 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
     (compressed allreduce: bf16 wire + reduction, f32 mean/update).
     `bf16_rounding='stochastic'` opts the bf16 cast into unbiased
     stochastic rounding (per-step per-replica keys off the dropout chain).
+
+    `health=True` folds the training-health auxiliary vector
+    (`telemetry.health.device_health_aux`: global grad norm, finite flag,
+    param norm) into the step's outputs — (params', key', loss, aux) —
+    computed IN-program from values the step already holds, so the health
+    watchdog's per-step signals ride the existing dispatch and the
+    existing once-per-epoch fetch: zero extra host syncs (the invariant
+    tests/test_health.py pins). The pmean strategy reports the exact norm
+    of the averaged grads; the sharded/bf16 strategies (which never
+    materialize them) pmean the local sum-of-squares instead — a
+    scale-faithful proxy.
     """
     from . import collectives
+    from ..telemetry.health import device_health_aux
     collectives.validate_comm(comm)
     collectives.validate_bf16_rounding(bf16_rounding, comm)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
@@ -116,19 +129,26 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
             # (distinct per replica so cast errors decorrelate in the sum)
             rnd = (jax.random.fold_in(rkey, 7)
                    if bf16_rounding == "stochastic" else None)
-            params = collectives.apply_gradients(
+            new_params = collectives.apply_gradients(
                 params, grads, lr, DATA_AXIS, comm, n_dev,
                 rounding_key=rnd)
-            return params, loss
+            if health:
+                # the averaged grads never exist under these strategies;
+                # pmean the local sum-of-squares inside the shard instead
+                aux = device_health_aux(loss, grads, new_params,
+                                        axis_name=DATA_AXIS)
+                return new_params, loss, aux
+            return new_params, loss
 
     # check_vma only on the pmean path: the sharded/bf16 bodies end in
     # all_gather/psum programs whose outputs are value-replicated but not
     # provably so to the static replication checker; their cross-strategy
     # parity (and therefore replication) is pinned by test instead.
+    n_out = 3 if (health and comm != "pmean") else 2
     sharded = shard_map(
         _shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(), P()), check_vma=comm == "pmean")
+        out_specs=(P(),) * n_out, check_vma=comm == "pmean")
 
     if comm == "pmean":
         def program(params, key, x, y):
@@ -137,10 +157,19 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
             # Redundant-per-replica optimizer (DDP semantics): params and
             # grads are both replicated, XLA fuses this update into the
             # step program.
-            return sgd_step(params, grads, lr), key, loss
+            new_params = sgd_step(params, grads, lr)
+            if health:
+                # grads here ARE the pmean'd global grads: the aux vector
+                # carries the exact global grad norm, fused into the step
+                return (new_params, key, loss,
+                        device_health_aux(loss, grads, new_params))
+            return new_params, key, loss
     else:
         def program(params, key, x, y):
             key, sub = jax.random.split(key)
+            if health:
+                new_params, loss, aux = sharded(params, sub, x, y)
+                return new_params, key, loss, aux
             new_params, loss = sharded(params, sub, x, y)
             return new_params, key, loss
 
@@ -149,21 +178,25 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
 
 def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
                        comm: str = "pmean",
-                       bf16_rounding: str = "nearest"):
+                       bf16_rounding: str = "nearest",
+                       health: bool = False):
     """Build the jitted SPMD step: (params, key, x, y) -> (params', key', loss).
 
     x: (global_batch, 784) sharded over 'dp'; params replicated; returned loss
     is the global batch mean (= mean of per-replica means at equal local batch,
     exactly DDP's effective loss). `comm` selects the gradient-communication
-    strategy (see dp_step_program / parallel/collectives.py).
+    strategy (see dp_step_program / parallel/collectives.py). `health=True`
+    appends the watchdog's in-program auxiliary vector to the outputs
+    (see dp_step_program).
 
     The returned step carries metadata the train loop's telemetry reads:
     `.ddp_comm` (strategy), `.ddp_mesh`, `.ddp_devices` — the
     `ddp.bytes_on_wire` / `ddp.collective_s` wiring in train/loop.py keys
-    off these without the loop having to know about meshes.
+    off these without the loop having to know about meshes — and
+    `.health_aux` (whether the step returns the 4th aux output).
     """
     program = dp_step_program(mesh, lr, dtype=dtype, comm=comm,
-                              bf16_rounding=bf16_rounding)
+                              bf16_rounding=bf16_rounding, health=health)
     jitted = jax.jit(program, donate_argnums=(0, 1))
 
     def step(params, key, x, y):
@@ -172,6 +205,7 @@ def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
     step.ddp_comm = comm
     step.ddp_mesh = mesh
     step.ddp_devices = _mesh_axis_size(mesh)
+    step.health_aux = health
     return step
 
 

@@ -22,7 +22,7 @@ import asyncio
 from .admission import AdmissionController, Rejected  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
 from .engine import InferenceEngine, bucket_ladder  # noqa: F401
-from .metrics import LatencyHistogram, ServeMetrics  # noqa: F401
+from .metrics import LatencyHistogram, ServeMetrics, SLOWindow  # noqa: F401
 
 
 class ServeService:

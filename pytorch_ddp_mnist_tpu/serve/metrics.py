@@ -17,10 +17,78 @@ dashboard in one dict — unchanged.
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Callable, Optional
 
 from ..telemetry.registry import Histogram, MetricsRegistry
+
+# Rolling SLO window length: big enough for a stable p99 (>=100 samples
+# past the 99th percentile boundary), small enough that the monitor
+# reflects the CURRENT regime, not the whole run — which is the point:
+# the cumulative histogram answers "how was the run", this answers "how
+# is the service RIGHT NOW".
+SLO_WINDOW = 512
+
+
+class SLOWindow:
+    """Rolling latency/throughput monitor over the most recent
+    completions: exact p99 over a bounded window, and the observed
+    service rate (completions per second across the window's wall span).
+
+    This is the live half the admission layer needs next (ROADMAP item 4:
+    reject on PREDICTED p99 = queue depth x observed service rate instead
+    of raw queue length): the cumulative `serve.latency_s` histogram
+    cannot answer it — a morning of fast traffic forever dilutes an
+    afternoon collapse. Constant memory (two bounded deques), O(window)
+    only when a percentile is actually read (a snapshot/scrape, never the
+    request path)."""
+
+    def __init__(self, window: int = SLO_WINDOW):
+        if window < 2:
+            raise ValueError(f"window must be >= 2; got {window}")
+        self.window = int(window)
+        self._lat: "collections.deque[float]" = collections.deque(
+            maxlen=self.window)
+        self._done_t: "collections.deque[float]" = collections.deque(
+            maxlen=self.window)
+
+    def record(self, latency_s: float, t_done: float) -> None:
+        self._lat.append(float(latency_s))
+        self._done_t.append(float(t_done))
+
+    @property
+    def n(self) -> int:
+        return len(self._lat)
+
+    def percentile(self, q: float) -> float:
+        """Exact q-quantile over the window (nearest-rank); 0.0 empty."""
+        if not self._lat:
+            return 0.0
+        ordered = sorted(self._lat)
+        rank = max(0, min(len(ordered) - 1,
+                          int(q * len(ordered) + 0.5) - 1))
+        return ordered[rank]
+
+    def service_rate(self) -> Optional[float]:
+        """Completions/sec over the window's first..last completion wall
+        span; None until two completions exist or when the span is zero
+        (injected clocks)."""
+        if len(self._done_t) < 2:
+            return None
+        span = self._done_t[-1] - self._done_t[0]
+        if span <= 0:
+            return None
+        return (len(self._done_t) - 1) / span
+
+    def snapshot(self) -> dict:
+        rate = self.service_rate()
+        return {
+            "window_n": self.n,
+            "rolling_p50_ms": round(self.percentile(0.50) * 1e3, 3),
+            "rolling_p99_ms": round(self.percentile(0.99) * 1e3, 3),
+            "service_rate_rps": round(rate, 2) if rate is not None else None,
+        }
 
 
 class LatencyHistogram(Histogram):
@@ -84,6 +152,17 @@ class ServeMetrics:
         self.clock = clock
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        # Rolling SLO monitor (live p99 + observed service rate): always
+        # on — two bounded deques cost nothing — and published as registry
+        # gauges so the Prometheus endpoint and {"op": "stats"}/"health"
+        # read the same live numbers. set_fn (not set) so a scrape reads
+        # the instant; a second ServeMetrics on the same registry rebinds
+        # the gauges to its own window, same get-or-adopt story as above.
+        self.slo = SLOWindow()
+        self.registry.gauge("serve.rolling_p99_s").set_fn(
+            lambda: self.slo.percentile(0.99) if self.slo.n else None)
+        self.registry.gauge("serve.service_rate_rps").set_fn(
+            self.slo.service_rate)
 
     # counter values under their historical attribute names
     @property
@@ -120,6 +199,7 @@ class ServeMetrics:
         self.latency.record(latency_s)
         self._completed.inc()
         self._t_last = self.clock()
+        self.slo.record(latency_s, self._t_last)
 
     def record_reject(self) -> None:
         self._rejected.inc()
@@ -174,4 +254,7 @@ class ServeMetrics:
             "mean_batch_size": round(self.batched_rows / self.batches, 2)
                                if self.batches else None,
             "queue_depth": self.depth_fn() if self.depth_fn else None,
+            # the rolling SLO view (recent window), beside the cumulative
+            # percentiles above — "right now" vs "the whole run"
+            "slo": self.slo.snapshot(),
         }

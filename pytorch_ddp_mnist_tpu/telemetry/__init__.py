@@ -30,13 +30,25 @@ shared by train, serve, and bench alike:
     `parallel/wireup.py`'s probe/retry loop and `serve/admission.py`'s
     reject path; dumped to disk on failure/SIGTERM, stamped into bench
     `backend_unavailable` artifacts.
+  * `health.py`    — the LIVE side: training-health watchdog (rolling
+    EWMA detectors over the values the loop already fetches — loss
+    spike, NaN/Inf, grad-norm explosion, update-ratio drift, throughput
+    collapse, straggler drift), severity-leveled `health` events into
+    trace + flight recorder + `health.*` metrics, and the
+    warn / checkpoint-and-warn / abort fatal-signal policy.
+  * `prom.py`      — pull-based live metrics: Prometheus text-format
+    exposition of the registry (plus the `health_*` gauges), served from
+    a stdlib HTTP thread (`/metrics`, `/healthz`) on `--metrics_port`.
 
 Front doors: `cli/train.py --telemetry DIR` (JSONL + rank-0 end-of-run
-summary), `python -m pytorch_ddp_mnist_tpu trace report|export` (analysis +
-Perfetto export + regression gate), `cli/serve.py`'s `{"op": "stats"}` TCP
-op (live registry snapshot), `bench.py` artifact stamps, `make obs-smoke` /
-`make trace-smoke` + `scripts/check_telemetry.py` (schema + span-structure
-validation). See docs/OBSERVABILITY.md.
+summary) / `--health POLICY` / `--metrics_port N`, `python -m
+pytorch_ddp_mnist_tpu trace report|export` (analysis + Perfetto export +
+regression gate), `cli/serve.py`'s `{"op": "stats"}` / `{"op": "health"}`
+TCP ops (live registry snapshot, rolling p99 + service rate), `bench.py`
+artifact stamps (incl. `health_summary`), `make obs-smoke` /
+`make trace-smoke` / `make health-smoke` + `scripts/check_telemetry.py`
+(schema + span-structure + health-event validation). See
+docs/OBSERVABILITY.md.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
@@ -51,3 +63,7 @@ from .analysis import (analyze, compare, load_trace,  # noqa: F401
 from .export import chrome_trace, profiler_trace, write_chrome_trace  # noqa: F401
 from .flight import (FlightRecorder, get_flight_recorder)  # noqa: F401
 from . import flight  # noqa: F401
+from .health import (HealthConfig, HealthEvent, TrainingHealthError,  # noqa: F401
+                     Watchdog, device_health_aux, health_summary)
+from .prom import (metric_name, render_prometheus,  # noqa: F401
+                   start_metrics_server)

@@ -43,6 +43,22 @@ PHASES = ("data_wait", "step_compute", "eval", "fused_run")
 _EPS = 1e-6
 
 
+def skew(values) -> Tuple[float, float]:
+    """(spread, spread as % of mean) of a set of durations — THE straggler
+    math: max - min, and that spread relative to the mean. One function so
+    the offline cross-process report below and the ONLINE drift detector
+    (`telemetry/health.py`, which watches a rolling window of this
+    process's own step times) can never disagree about what "skew" means.
+    Empty/zero-mean input reads as no skew."""
+    vals = list(values)
+    if not vals:
+        return 0.0, 0.0
+    lo, hi = min(vals), max(vals)
+    mean = sum(vals) / len(vals)
+    spread = hi - lo
+    return spread, (100.0 * spread / mean if mean > 0 else 0.0)
+
+
 # ---------------------------------------------------------------------------
 # loading
 # ---------------------------------------------------------------------------
@@ -330,10 +346,7 @@ def analyze(paths: List[str]) -> dict:
         if len(durs) < 2:
             continue
         straggler["epochs_compared"] += 1
-        lo, hi = min(durs.values()), max(durs.values())
-        mean = sum(durs.values()) / len(durs)
-        skew_s = hi - lo
-        skew_pct = 100.0 * skew_s / mean if mean > 0 else 0.0
+        skew_s, skew_pct = skew(durs.values())
         skew_pcts.append(skew_pct)
         if skew_s > straggler["max_skew_s"]:
             straggler.update(max_skew_s=skew_s, max_skew_pct=skew_pct,

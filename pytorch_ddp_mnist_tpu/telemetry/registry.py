@@ -190,13 +190,24 @@ class MetricsRegistry:
             table[name] = metric
 
     def snapshot(self) -> dict:
-        """The whole process's metric state as one JSON-able dict."""
+        """The whole process's metric state as one JSON-able dict.
+
+        The table LISTING is taken under the lock and rendered outside it:
+        since the live `/metrics` scrape thread (telemetry/prom.py), a
+        snapshot can run concurrently with another thread lazily creating
+        metrics — a Python-level comprehension over the live dicts would
+        die with "dictionary changed size during iteration". Rendering
+        outside the lock keeps gauge provider callables (which may touch
+        arbitrary code, including metric creation) deadlock-free; the
+        per-metric reads are attribute math, worst case one update stale."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            histograms = sorted(self._histograms.items())
         return {
-            "counters": {n: c.value
-                         for n, c in sorted(self._counters.items())},
-            "gauges": {n: g.value for n, g in sorted(self._gauges.items())},
-            "histograms": {n: h.snapshot()
-                           for n, h in sorted(self._histograms.items())},
+            "counters": {n: c.value for n, c in counters},
+            "gauges": {n: g.value for n, g in gauges},
+            "histograms": {n: h.snapshot() for n, h in histograms},
         }
 
 

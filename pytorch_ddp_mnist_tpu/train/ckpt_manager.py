@@ -70,6 +70,22 @@ _NAME_RE = re.compile(r"^step_(\d{8})\.json$")
 _PAYLOAD_RE = re.compile(r"^step_(\d{8})\.msgpack$")
 
 
+def _nonfinite_leaves(tree, prefix: str = "") -> List[str]:
+    """Paths of float leaves holding NaN/Inf — the restore-time divergence
+    check (params are plain nested dicts of numpy arrays here; integer
+    leaves are exempt by dtype)."""
+    if isinstance(tree, dict):
+        out: List[str] = []
+        for k, v in tree.items():
+            out.extend(_nonfinite_leaves(v, f"{prefix}/{k}"))
+        return out
+    arr = np.asarray(tree)
+    if (np.issubdtype(arr.dtype, np.floating)
+            and not np.isfinite(arr).all()):
+        return [prefix or "/"]
+    return []
+
+
 def _manifest_name(step: int) -> str:
     return f"step_{step:08d}.json"
 
@@ -113,13 +129,21 @@ class CheckpointManager:
     # -- write side ---------------------------------------------------------
 
     def save(self, params, key_data, impl: str, *, step: int, epoch: int,
-             offset: int, meta: dict | None = None) -> str:
+             offset: int, meta: dict | None = None,
+             pin: bool = False) -> str:
         """Commit one step checkpoint; returns the manifest path.
 
         Fetches params to host (this is the one deliberate device sync of a
         checkpoint save). Raises CheckpointError on any I/O failure, with
         the temp file cleaned up and prior checkpoints untouched — a failed
-        save never costs existing durability."""
+        save never costs existing durability.
+
+        `pin=True` marks the checkpoint exempt from keep-last-N rotation
+        (the health watchdog's rescue save uses it: a last-known-good
+        pre-divergence checkpoint must not be rotated away by the routine
+        saves of a run that keeps training — possibly on garbage — after
+        the fatal signal). A pinned checkpoint persists until deleted by
+        hand or overwritten by a save at the same step."""
         import jax
         from flax import serialization
         from ..telemetry import get_registry
@@ -149,6 +173,8 @@ class CheckpointManager:
                 "meta": dict(meta or {}),
                 "t_wall": time.time(),
             }
+            if pin:
+                record["pinned"] = True
             mtmp = f"{manifest}.tmp.{os.getpid()}"
             with open(mtmp, "w") as f:
                 json.dump(record, f)
@@ -184,22 +210,45 @@ class CheckpointManager:
         reg.counter("checkpoint.bytes").inc(len(blob))
         return manifest
 
+    def _pinned(self, steps: List[int]) -> set:
+        """Which of `steps` carry a pinned manifest. Only rotation
+        CANDIDATES are checked (one small JSON read each), so the common
+        no-pin rotation stays the same few unlinks it always was; an
+        unreadable manifest reads as unpinned (it is torn anyway)."""
+        out = set()
+        for step in steps:
+            try:
+                with open(os.path.join(self.directory,
+                                       _manifest_name(step))) as f:
+                    if json.load(f).get("pinned"):
+                        out.add(step)
+            except (OSError, ValueError):
+                pass
+        return out
+
     def _rotate(self) -> None:
         """Drop committed checkpoints beyond keep-last-N — manifest first
         (uncommit), then payload, so a crash mid-rotation can only leave an
-        uncommitted orphan, never a manifest pointing at nothing. Then
-        sweep crash debris: `.tmp.<pid>` files from DEAD writers (a SIGKILL
-        mid-save never reaches save's cleanup) and payloads whose manifest
-        never committed — both invisible to restore, but each kill/resume
-        cycle would otherwise leave one full-size orphan behind forever."""
+        uncommitted orphan, never a manifest pointing at nothing. Pinned
+        checkpoints (the watchdog's rescue saves) sit OUTSIDE the keep-N
+        budget: never deleted here, and their payloads are never swept as
+        strays. Then sweep crash debris: `.tmp.<pid>` files from DEAD
+        writers (a SIGKILL mid-save never reaches save's cleanup) and
+        payloads whose manifest never committed — both invisible to
+        restore, but each kill/resume cycle would otherwise leave one
+        full-size orphan behind forever."""
         committed = self.steps()
-        for step in committed[:-self.keep]:
+        doomed = committed[:-self.keep]
+        pinned = self._pinned(doomed)
+        for step in doomed:
+            if step in pinned:
+                continue
             for name in (_manifest_name(step), _payload_name(step)):
                 try:
                     os.unlink(os.path.join(self.directory, name))
                 except OSError:
                     pass
-        live = set(committed[-self.keep:])
+        live = set(committed[-self.keep:]) | pinned
         my_suffix = f".{os.getpid()}"
         try:
             names = os.listdir(self.directory)
@@ -280,7 +329,17 @@ class CheckpointManager:
             path=manifest, meta=dict(rec.get("meta") or {}))
 
     def restore_latest(self, template) -> StepCheckpoint:
-        """Newest INTACT checkpoint, falling back past torn/corrupt ones.
+        """Newest INTACT + FINITE checkpoint, falling back past torn,
+        corrupt, and non-finite ones.
+
+        The finiteness walk is new with the health watchdog: a run whose
+        params truly diverged keeps committing intact-by-CRC checkpoints
+        full of NaN — resuming from one trains garbage forever, so restore
+        prefers the newest checkpoint whose float leaves are all finite
+        (the watchdog's pinned rescue save, typically). When NO finite
+        candidate exists, the newest intact one is returned anyway with a
+        loud warning (behavior-preserving: refusing outright would strand
+        resumes that predate the watchdog).
 
         Every rejected candidate lands in the flight recorder (kind
         `checkpoint_fallback`, with the path and the named defect) and on
@@ -296,6 +355,7 @@ class CheckpointManager:
                 f"{self.directory}: no committed step checkpoints "
                 f"(no step_*.json manifests)")
         tried = []
+        nonfinite_newest: StepCheckpoint | None = None
         for step in reversed(steps):
             try:
                 ckpt = self._load_intact(step, template)
@@ -306,10 +366,33 @@ class CheckpointManager:
                 print(f"[ckpt] skipping torn checkpoint at step {step}: {e}",
                       file=sys.stderr, flush=True)
                 continue
+            bad = _nonfinite_leaves(ckpt.params)
+            if bad:
+                msg = (f"{ckpt.path}: params contain non-finite values "
+                       f"(e.g. {bad[0]}) — a diverged run's checkpoint")
+                tried.append(msg)
+                flight.record("checkpoint_fallback", step=step,
+                              error=msg[:500])
+                print(f"[ckpt] skipping non-finite checkpoint at step "
+                      f"{step} (looking for the newest finite one)",
+                      file=sys.stderr, flush=True)
+                if nonfinite_newest is None:
+                    nonfinite_newest = ckpt
+                continue
             flight.record("checkpoint_restore", step=ckpt.step,
                           epoch=ckpt.epoch, offset=ckpt.offset,
                           fallbacks=len(tried))
             return ckpt
+        if nonfinite_newest is not None:
+            print(f"[ckpt] WARNING: every intact checkpoint holds "
+                  f"non-finite params; restoring the newest anyway "
+                  f"(step {nonfinite_newest.step}) — expect the resumed "
+                  f"run to stay diverged", file=sys.stderr, flush=True)
+            flight.record("checkpoint_restore", step=nonfinite_newest.step,
+                          epoch=nonfinite_newest.epoch,
+                          offset=nonfinite_newest.offset,
+                          fallbacks=len(tried), nonfinite=True)
+            return nonfinite_newest
         raise CheckpointError(
             f"{self.directory}: no intact step checkpoint; tried "
             f"{len(tried)}:\n" + "\n".join(f"  {t}" for t in tried))

@@ -176,6 +176,29 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "scripts/check_telemetry.py DIR. Off by default — "
                         "disabled telemetry adds no per-step host sync. See "
                         "docs/OBSERVABILITY.md")
+    t.add_argument("--health", choices=("off", "warn", "checkpoint-and-warn",
+                                        "abort"),
+                   default="off",
+                   help="live training-health watchdog "
+                        "(telemetry/health.py): rolling detectors for loss "
+                        "spikes, NaN/Inf, grad-norm explosion, update-ratio "
+                        "drift, throughput collapse and straggler drift, "
+                        "over the values the loop already fetches (zero "
+                        "extra per-step host syncs). The choice is the "
+                        "FATAL-signal policy: warn (log + record), "
+                        "checkpoint-and-warn (additionally save the last "
+                        "known-good state via the step-checkpoint manager "
+                        "— needs a non-empty --checkpoint), or abort "
+                        "(flight-dump + stop the run). Off by default")
+    t.add_argument("--metrics_port", type=int, default=None, metavar="PORT",
+                   help="serve a live pull endpoint from a stdlib HTTP "
+                        "thread on this port (rank 0; 0 = ephemeral, the "
+                        "bound address prints to stderr): GET /metrics is "
+                        "the unified registry in Prometheus text format "
+                        "(plus the health_* gauges when --health is on), "
+                        "GET /healthz the JSON health verdict. Binds "
+                        "127.0.0.1 ONLY — scrape a remote run through an "
+                        "ssh tunnel (the endpoint is unauthenticated)")
     t.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace of the training run "
                         "into LOGDIR (view in TensorBoard/XProf); restores "
@@ -261,6 +284,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,
             "telemetry": a.telemetry,
+            "health": a.health, "metrics_port": a.metrics_port,
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,

@@ -53,19 +53,36 @@ def _loss_fn(params, x, y, dropout_key):
     return cross_entropy(logits, y)
 
 
-def make_train_step(lr: float) -> Callable:
+def make_train_step(lr: float, *, health: bool = False) -> Callable:
     """One jitted SGD step: (params, key, x, y) -> (params', key', mean_loss).
 
     The RNG key is split inside the step (traced, so it stays on device); the
     dropout mask is drawn per call, matching torch Dropout's fresh mask per
     forward. Params are donated — the update is in-place in HBM.
+
+    `health=True` appends the watchdog's auxiliary vector
+    (`telemetry.health.device_health_aux`: grad norm, finite flag, param
+    norm) to the outputs — computed in-program from the grads the step
+    already holds, fetched once per epoch with the losses (no extra host
+    sync). The returned step carries `.health_aux` so the loop knows the
+    output arity.
     """
+    from ..telemetry.health import device_health_aux
+
     @partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, key, x, y):
+    def _step(params, key, x, y):
         key, sub = jax.random.split(key)
         loss, grads = jax.value_and_grad(_loss_fn)(params, x, y, sub)
-        return sgd_step(params, grads, lr), key, loss
+        new_params = sgd_step(params, grads, lr)
+        if health:
+            return (new_params, key, loss,
+                    device_health_aux(loss, grads, new_params))
+        return new_params, key, loss
 
+    def step(params, key, x, y):
+        return _step(params, key, x, y)
+
+    step.health_aux = health
     return step
 
 
@@ -377,7 +394,8 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         epoch_hook: Callable | None = None, start_epoch: int = 0,
         start_offset: int = 0, ckpt_every_steps: int = 0,
         step_hook: Callable | None = None,
-        eval_perm: Callable | None = None) -> TrainState:
+        eval_perm: Callable | None = None,
+        watchdog=None) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -403,7 +421,16 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     at each epoch end (see step_ckpt_positions) — the save cadence of
     `train/ckpt_manager.py`. Each step is also a `kill` fault point
     (utils/faultpoints), fired AFTER the hook so an injected kill at step
-    K never races the step-K checkpoint.
+    K never races the step-K checkpoint; each step's reported loss is a
+    `nan` POISON point (`faultpoints.poison` — the watchdog's
+    deterministic chaos input).
+
+    `watchdog` (telemetry.health.Watchdog) observes once per epoch, over
+    exactly the values the loop fetches anyway — the per-step loss curve,
+    the epoch timers, and (when the step was built with `health=True`,
+    which this loop does itself on the lr path) the per-step health aux
+    vectors, stacked and fetched WITH the losses. A healthy or absent
+    watchdog adds zero extra host syncs (pinned by tests/test_health.py).
     """
     from ..utils import faultpoints
 
@@ -413,7 +440,12 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         raise ValueError(f"start_epoch={start_epoch} outside [0, {epochs}]")
     if start_offset < 0:
         raise ValueError(f"start_offset={start_offset} must be >= 0")
-    step = train_step if train_step is not None else make_train_step(lr)
+    step = (train_step if train_step is not None
+            else make_train_step(lr, health=watchdog is not None))
+    # health-enabled steps return a 4th per-step aux output (grad norm /
+    # finite flag / param norm) that rides the loss fetch — see
+    # telemetry/health.py
+    step_health = bool(getattr(step, "health_aux", False))
     eval_step = make_eval_step()
     # Hoist the test set to device ONCE — the reference re-materializes its
     # test tensors per batch per epoch (ddp_tutorial_multi_gpu.py:105-106);
@@ -448,6 +480,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             step_timer = CumulativeTimer("step-dispatch")
             train_loader.sampler.set_epoch(epoch)
             losses = []
+            aux_list = []
             offset = start_offset if epoch == start_epoch else 0
             src = (train_loader if offset == 0
                    else _skip_batches(train_loader, offset))
@@ -464,7 +497,17 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                     break
                 x, y = batch
                 with step_timer:
-                    params, key, loss = step(params, key, x, y)
+                    if step_health:
+                        params, key, loss, aux = step(params, key, x, y)
+                        aux_list.append(aux)
+                    else:
+                        params, key, loss = step(params, key, x, y)
+                # the nan value-fault point: poisons only this REPORTED
+                # loss (params untouched), staying on device — the
+                # watchdog's detection path, deterministically testable
+                loss = faultpoints.poison("loss", loss,
+                                          step=epoch * nsteps + i + 1,
+                                          epoch=epoch)
                 losses.append(loss)
                 _fire_step_hook(step_hook, ckpt_every_steps, nsteps,
                                 epoch, i, params, key)
@@ -488,10 +531,22 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             tracer.complete_span("eval", time.perf_counter() - t_eval)
             if ddp_record is not None:
                 ddp_record(len(losses), params)
+            dt = time.perf_counter() - t0
             log(epoch_summary(epoch, losses, batch_size, val,
-                              time.perf_counter() - t0,
-                              io_seconds=io_timer.total))
+                              dt, io_seconds=io_timer.total))
             state = TrainState(params, key)
+            if watchdog is not None:
+                # one observation per epoch, over the already-fetched loss
+                # curve (+ the aux vectors, stacked in the same style — a
+                # second fetch of finished values, never a drain). May
+                # raise TrainingHealthError under the abort policy.
+                aux_np = (np.asarray(jnp.stack(aux_list))
+                          if aux_list else None)
+                watchdog.observe(
+                    losses, aux=aux_np, state=state, epoch=epoch,
+                    step=(epoch + 1) * nsteps,
+                    ckpt_epoch=epoch + 1, ckpt_offset=0,
+                    dt_s=dt, imgs=losses.size * batch_size)
             if epoch_hook is not None:
                 epoch_hook(epoch, state)
     return state

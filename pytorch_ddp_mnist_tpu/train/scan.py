@@ -46,7 +46,7 @@ from ..parallel.mesh import DATA_AXIS
 from ..telemetry.events import get_tracer
 from .loop import (TrainState, _fire_step_hook, epoch_summary, evaluate,
                    make_ddp_comm_recorder, make_eval_step,
-                   make_snapshot_eval_step, val_summary)
+                   make_snapshot_eval_step, step_ckpt_positions, val_summary)
 
 
 def _gathered_x(x_all, batch_idx, compute_dt):
@@ -604,7 +604,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                start_epoch: int = 0, start_offset: int = 0,
                ckpt_every_steps: int = 0,
                step_hook: Callable | None = None,
-               eval_perm: Callable | None = None) -> TrainState:
+               eval_perm: Callable | None = None,
+               watchdog=None) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
 
     `batch_size` is the GLOBAL batch (sampler shards rows per process; with a
@@ -635,6 +636,16 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     trajectory. `kernel='pallas_epoch'` splits its key once per EPOCH, so
     chunking would fork its dropout stream: rejected by name. `fused=True`
     has no mid-run host control at all: likewise rejected.
+
+    `watchdog` (telemetry.health.Watchdog) observes at every chunk
+    boundary — the granularity at which this trainer already fetches its
+    per-step losses, so live health detection costs no extra host syncs
+    (with `ckpt_every_steps=N` the detection window is N steps; unchunked,
+    one epoch). The scan programs carry no per-step health aux (the aux
+    fold lives in the streaming steps), so detection here is loss- and
+    timing-based. Each fetched chunk is also the `nan` value-fault point
+    (`faultpoints.poison_array`). `fused=True` rejects a watchdog by name:
+    one whole-run device program has no live host to watch from.
     """
     import time
 
@@ -649,6 +660,11 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             "step-granular checkpointing (ckpt_every_steps/step_hook/"
             "start_offset) needs per-chunk host control; fused=True runs "
             "all epochs as ONE device program — use plain cached mode")
+    if fused and watchdog is not None:
+        raise ValueError(
+            "live health monitoring (watchdog) observes at chunk/epoch "
+            "boundaries the host controls; fused=True runs all epochs as "
+            "ONE device program with no live host — use plain cached mode")
     if kernel == "pallas_epoch" and (ckpt_every_steps or start_offset):
         raise ValueError(
             "step-granular checkpointing chunks the epoch scan, but kernel "
@@ -762,6 +778,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             loss_parts = []
             c0 = offset
             while c0 < nb:
+                t_chunk = time.perf_counter()
                 c1 = (min(nb, (c0 // ckpt_every_steps + 1) * ckpt_every_steps)
                       if ckpt_every_steps else nb)
                 part = idx[c0:c1]
@@ -770,12 +787,32 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                         part.shape, idx_sharding, lambda s, _i=part: _i[s])
                 params, key, part_losses = epoch_fn(params, key,
                                                     x_all, y_all, part)
-                loss_parts.append(np.asarray(part_losses))  # chunk sync
+                part_np = np.asarray(part_losses)           # chunk sync
+                # the nan value-fault point, chunk form: poisons only the
+                # fetched loss curve (params untouched) — the watchdog's
+                # deterministic chaos input
+                part_np = faultpoints.poison_array(
+                    "loss", part_np, first_step=epoch * nb + c0 + 1,
+                    epoch=epoch)
+                loss_parts.append(part_np)
                 _fire_step_hook(step_hook, ckpt_every_steps, nb, epoch,
                                 c1 - 1, params, key)
                 # hook BEFORE the kill point: an injected kill at step K
                 # must never race the step-K checkpoint it tests
                 faultpoints.fire("step", step=epoch * nb + c1, epoch=epoch)
+                if watchdog is not None:
+                    # chunk-granular live health: the losses are already on
+                    # host (the chunk sync above); positions follow
+                    # step_ckpt_positions so a checkpoint-and-warn rescue
+                    # records exactly what a step checkpoint would. May
+                    # raise TrainingHealthError under the abort policy.
+                    ck_ep, ck_off = step_ckpt_positions(nb, epoch, c1 - 1)
+                    watchdog.observe(
+                        part_np, state=TrainState(params, key), epoch=epoch,
+                        step=epoch * nb + c1,
+                        ckpt_epoch=ck_ep, ckpt_offset=ck_off,
+                        dt_s=time.perf_counter() - t_chunk,
+                        imgs=part_np.size * batch_size)
                 c0 = c1
             losses = np.concatenate(loss_parts)
             # the per-chunk loss fetches block until each chunk's program

@@ -17,6 +17,7 @@ Spec syntax (comma-separated specs; `key=value` constraints after the kind):
     PDMT_FAULT="ckpt_save_io:step=3"             # OSError inside ckpt save
     PDMT_FAULT="loader_stall:batch=3:delay_s=0.5"  # sleep in the loader
     PDMT_FAULT="collective_timeout:rank=1"       # DEADLINE_EXCEEDED barrier
+    PDMT_FAULT="nan:step=5"                      # NaN the step-5 loss
 
 or `--fault SPEC` on the trainer CLI (env and flag merge). Each spec fires
 at its own fault point:
@@ -33,6 +34,15 @@ at its own fault point:
                                            wireup.looks_like_backend_loss —
                                            the signature triage sees exactly
                                            what a dead collective produces)
+    nan                 "loss"             poison the reported per-step loss
+                                           with NaN (params stay finite —
+                                           the health watchdog's detection
+                                           path becomes deterministically
+                                           testable, and a rescue
+                                           checkpoint stays intact). Fired
+                                           through `poison`/`poison_array`,
+                                           which RETURN the (possibly
+                                           NaN'd) value instead of acting.
 
 Determinism contract: a spec with `step=K` fires at the FIRST fault-point
 crossing where the reported step is >= K (the epoch-scanned trainer only
@@ -50,6 +60,7 @@ the instrumented hot paths pay nothing in production.
 
 from __future__ import annotations
 
+import math
 import os
 import signal
 import time
@@ -64,6 +75,7 @@ POINTS = {
     "ckpt_save_io": "ckpt_save",
     "loader_stall": "loader_next",
     "collective_timeout": "barrier",
+    "nan": "loss",
 }
 
 # constraint keys with first-crossing (>=) semantics; all others match ==
@@ -162,22 +174,73 @@ class FaultInjector:
 
     def fire(self, point: str, **ctx) -> None:
         for spec in self.specs:
-            if spec.point != point or not spec.matches(self.rank, ctx):
+            # value faults ("nan") only fire through poison()/poison_array()
+            # — they must RETURN a poisoned value, which fire() cannot do
+            if (spec.kind == "nan" or spec.point != point
+                    or not spec.matches(self.rank, ctx)):
                 continue
             spec.fired += 1
             self._act(spec, ctx)
 
-    def _act(self, spec: FaultSpec, ctx: Dict[str, float]) -> None:
-        # flight first: the record must exist before the failure does,
-        # because two of the actions never return control.
+    def _record(self, spec: FaultSpec, ctx: Dict[str, float]) -> None:
+        # flight first: the record must exist before the failure does
         from ..telemetry import flight
         flight.record("fault_injected", fault=spec.describe(),
                       point=spec.point, rank=self.rank,
                       **{k: v for k, v in ctx.items()
                          if k not in ("fault", "point", "rank")})
+
+    def poison(self, point: str, value, **ctx):
+        """Value-fault twin of `fire`: returns `value`, NaN-poisoned when a
+        matching value spec (kind 'nan') is due at `point`. Works on jax
+        scalars and numpy values alike (`value * nan` stays on device for a
+        traced/device value — the poison never forces a host sync)."""
+        for spec in self.specs:
+            if (spec.kind != "nan" or spec.point != point
+                    or not spec.matches(self.rank, ctx)):
+                continue
+            spec.fired += 1
+            self._record(spec, ctx)
+            value = value * float("nan")
+        return value
+
+    def poison_array(self, point: str, values, *, first_step: int, **ctx):
+        """Chunk form of `poison` for per-step value arrays fetched in one
+        go (the epoch-scanned trainer): `values[i]` is the value of global
+        step `first_step + i`. The FIRST index crossing a matching spec's
+        `step` threshold is NaN'd (the same first-crossing >= K semantics
+        as every step-gated spec). Returns the (possibly copied) array."""
+        import numpy as np
+        n = len(values)
+        if n == 0:
+            return values
+        for spec in self.specs:
+            if spec.kind != "nan" or spec.point != point:
+                continue
+            want = spec.where.get("step")
+            if want is None:
+                idx = 0
+            else:
+                if first_step + n - 1 < want:   # threshold not reached yet
+                    continue
+                idx = max(0, int(math.ceil(want)) - int(first_step))
+            step_at = int(first_step) + idx
+            if not spec.matches(self.rank, {**ctx, "step": step_at}):
+                continue
+            spec.fired += 1
+            self._record(spec, {**ctx, "step": step_at})
+            values = np.array(values, copy=True)
+            values[idx] = float("nan")
+        return values
+
+    def _act(self, spec: FaultSpec, ctx: Dict[str, float]) -> None:
+        # flight first: the record must exist before the failure does,
+        # because two of the actions never return control.
+        self._record(spec, ctx)
         if spec.kind == "kill":
             # a real preemption: dump the ring (SIGKILL outruns any atexit),
             # then die uncleanly — no flushes, no context managers.
+            from ..telemetry import flight
             flight.dump(reason=f"injected fault: {spec.describe()}")
             os.kill(os.getpid(), signal.SIGKILL)
         elif spec.kind == "ckpt_save_io":
@@ -242,6 +305,32 @@ def fire(point: str, **ctx) -> None:
         inj = get_injector()
     if inj.specs:
         inj.fire(point, **ctx)
+
+
+def poison(point: str, value, **ctx):
+    """Value-fault entry point: return `value`, NaN-poisoned when a 'nan'
+    spec is due at `point`. Same few-ns no-fault fast path as `fire` —
+    safe on per-step hot paths."""
+    inj = _INJECTOR
+    if inj is None:
+        if FAULT_ENV not in os.environ:
+            return value
+        inj = get_injector()
+    if inj.specs:
+        return inj.poison(point, value, **ctx)
+    return value
+
+
+def poison_array(point: str, values, *, first_step: int, **ctx):
+    """Chunk form of `poison` (see FaultInjector.poison_array)."""
+    inj = _INJECTOR
+    if inj is None:
+        if FAULT_ENV not in os.environ:
+            return values
+        inj = get_injector()
+    if inj.specs:
+        return inj.poison_array(point, values, first_step=first_step, **ctx)
+    return values
 
 
 def active() -> bool:

@@ -9,7 +9,10 @@
 
 Exit 0 when every `events*.jsonl` is schema-valid; nonzero (with one line
 per violation on stderr) on malformed JSON, unknown schema version or kind,
-missing required fields, OUT-OF-ORDER records (t_mono must be
+missing required fields, malformed `health` events (a health point must
+carry a non-empty string detector and a known severity —
+`--require health.` gates on the watchdog's registry metrics being present,
+the obs/ddp-smoke pattern), OUT-OF-ORDER records (t_mono must be
 non-decreasing within a run segment — the writer stamps emission time
 exactly so this holds; an appended file holds one segment per
 `trace_start` record), negative span durations, or span-STRUCTURE
@@ -36,6 +39,11 @@ import sys
 SCHEMA_VERSION = 1
 KINDS = ("meta", "span", "point", "snapshot")
 REQUIRED = ("v", "kind", "name", "t_wall", "t_mono", "proc")
+# `health` point records (telemetry/health.py watchdog): the detector and
+# severity fields are the contract every reader keys on — a record that
+# lost either is noise pretending to be signal, so the checker rejects it.
+HEALTH_SEVERITIES = ("info", "warn", "fatal")
+HEALTH_REQUIRED = ("detector", "severity")
 
 
 def _load_analysis():
@@ -141,6 +149,22 @@ def check_file(path: str, errors: list) -> int:
                 errors.append(f"{where}: out of order (t_mono "
                               f"{rec['t_mono']} < previous {last_mono})")
             last_mono = rec["t_mono"]
+            if rec["kind"] == "point" and rec["name"] == "health":
+                attrs = rec.get("attrs") or {}
+                missing_h = [k for k in HEALTH_REQUIRED if k not in attrs]
+                if missing_h:
+                    errors.append(f"{where}: health event missing attrs "
+                                  f"{missing_h}")
+                else:
+                    if not (isinstance(attrs["detector"], str)
+                            and attrs["detector"]):
+                        errors.append(f"{where}: health detector must be a "
+                                      f"non-empty string; got "
+                                      f"{attrs['detector']!r}")
+                    if attrs["severity"] not in HEALTH_SEVERITIES:
+                        errors.append(f"{where}: unknown health severity "
+                                      f"{attrs['severity']!r}; known: "
+                                      f"{HEALTH_SEVERITIES}")
             if rec["kind"] == "span":
                 for k in ("span", "dur_s"):
                     if k not in rec:

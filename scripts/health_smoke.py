@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Live-health smoke: one command proving the watchdog round trip.
+
+Launches a short `--cached` CPU training run with
+
+  * an injected `nan:step=K` fault (utils/faultpoints.py) poisoning the
+    reported loss at step K,
+  * `--health checkpoint-and-warn` + step checkpoints every C steps,
+  * `--telemetry DIR` and `--metrics_port 0` (ephemeral),
+
+and asserts the three promises of the live-health layer round-trip:
+
+  1. DURING the run, `GET /metrics` answers Prometheus text format
+     covering the unified registry plus the `health_*` gauges (the live
+     pull endpoint actually serves while training runs);
+  2. the JSONL trace carries a schema-valid `health` event trail — the
+     fatal `nan` detection — and the final registry snapshot carries the
+     `health.*` metrics (`scripts/check_telemetry.py --require health.`);
+  3. the step-checkpoint directory holds an INTACT checkpoint at a
+     PRE-NaN step (the checkpoint-and-warn rescue): CRC-verified,
+     decodable, every parameter finite.
+
+Exit 0 on success; nonzero with the failed promise named on stderr.
+`make health-smoke` is the committed entry point (JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import glob
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NAN_STEP = 6          # poison the step-6 loss...
+CKPT_EVERY = 4        # ...so the chunk-4 boundary state is the rescue
+
+
+def fail(why: str, proc_out: str = "") -> "NoReturn":  # noqa: F821
+    print(f"health_smoke: FAIL — {why}", file=sys.stderr)
+    if proc_out:
+        print(proc_out, file=sys.stderr)
+    raise SystemExit(1)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="pdmt_health_smoke_")
+    obs = os.path.join(tmp, "obs")
+    ckpt = os.path.join(tmp, "model.msgpack")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    cmd = [sys.executable, "-m", "pytorch_ddp_mnist_tpu", "train",
+           "--cached", "--epochs", "2", "--limit", "512",
+           "--batch_size", "64", "--path", os.path.join(tmp, "nodata"),
+           "--checkpoint", ckpt, "--ckpt_every_steps", str(CKPT_EVERY),
+           # default --ckpt_keep on purpose: the rescue save is PINNED, so
+           # it must survive the later routine saves' keep-last-N rotation
+           "--health", "checkpoint-and-warn",
+           "--fault", f"nan:step={NAN_STEP}",
+           "--telemetry", obs, "--metrics_port", "0"]
+    proc = subprocess.Popen(cmd, cwd=tmp, env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+    # -- 1. live /metrics scrape -----------------------------------------
+    # The CLI prints `metrics on http://HOST:PORT/metrics` (stderr) before
+    # training starts; scrape as soon as it appears — mid-run by
+    # construction, since training hasn't finished warmup by then.
+    # select() guards every read: a trainer that wedges pre-announcement
+    # with stderr open (the hung-backend-init mode) must fail the smoke at
+    # the deadline, not hang it forever in a blocking readline().
+    import select
+    stderr_lines = []
+    url = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        ready, _, _ = select.select([proc.stderr], [], [],
+                                    max(0.0, deadline - time.monotonic()))
+        if not ready:
+            break
+        line = proc.stderr.readline()
+        if not line:
+            break
+        stderr_lines.append(line)
+        if line.startswith("metrics on "):
+            url = line.split("metrics on ", 1)[1].strip()
+            break
+    if url is None:
+        proc.kill()
+        fail("the CLI never announced its --metrics_port endpoint "
+             "within 120s", "".join(stderr_lines))
+    try:
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+    except Exception as e:
+        proc.kill()
+        fail(f"live scrape of {url} failed: {e}")
+    for needle in ("# TYPE", "health_worst_severity_level"):
+        if needle not in body:
+            proc.kill()
+            fail(f"live /metrics scrape lacks {needle!r}:\n{body[:500]}")
+    print(f"health_smoke: live scrape OK ({len(body.splitlines())} lines "
+          f"from {url})")
+
+    out, err = proc.communicate(timeout=600)
+    transcript = err + "".join(stderr_lines) + out
+    if proc.returncode != 0:
+        fail(f"training run exited rc={proc.returncode} (checkpoint-and-"
+             f"warn must keep the run alive)", transcript)
+
+    # -- 2. the health event trail ---------------------------------------
+    rc = subprocess.call([sys.executable,
+                          os.path.join(REPO, "scripts", "check_telemetry.py"),
+                          "--require", "health.", obs], env=env)
+    if rc != 0:
+        fail(f"check_telemetry --require health. exited {rc}")
+    events = []
+    with open(os.path.join(obs, "events.jsonl")) as f:
+        for raw in f:
+            rec = json.loads(raw)
+            if rec.get("kind") == "point" and rec.get("name") == "health":
+                events.append(rec["attrs"])
+    nans = [e for e in events if e["detector"] == "nan"]
+    if not nans or nans[0]["severity"] != "fatal":
+        fail(f"no fatal 'nan' health event in the trace; saw {events}")
+    print(f"health_smoke: health event trail OK ({len(events)} event(s), "
+          f"nan detected at step {nans[0].get('step')})")
+
+    # -- 3. the pre-NaN rescue checkpoint --------------------------------
+    from flax import serialization
+    import numpy as np
+    steps_dir = ckpt + ".steps"
+    pre_nan = []
+    for man_path in sorted(glob.glob(os.path.join(steps_dir, "*.json"))):
+        with open(man_path) as f:
+            man = json.load(f)
+        if man["step"] < NAN_STEP:
+            pre_nan.append((man_path, man))
+    if not pre_nan:
+        fail(f"no pre-NaN (< step {NAN_STEP}) checkpoint under {steps_dir}; "
+             f"have {os.listdir(steps_dir) if os.path.isdir(steps_dir) else 'no dir'}")
+    man_path, man = pre_nan[-1]
+    with open(os.path.join(steps_dir, man["payload"]), "rb") as f:
+        blob = f.read()
+    if len(blob) != man["bytes"] or zlib.crc32(blob) != man["crc32"]:
+        fail(f"pre-NaN checkpoint {man_path} failed its size/CRC check")
+    params = serialization.msgpack_restore(blob)
+    bad = [k for k, v in _flat(params)
+           if not np.isfinite(np.asarray(v)).all()]
+    if bad:
+        fail(f"pre-NaN checkpoint {man_path} holds non-finite leaves: {bad}")
+    print(f"health_smoke: OK — intact finite rescue checkpoint at step "
+          f"{man['step']} (< nan step {NAN_STEP}), "
+          f"{len(events)} health event(s), live scrape served")
+    return 0
+
+
+def _flat(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _flat(v, f"{prefix}/{k}")
+    else:
+        yield prefix, tree
+
+
+if __name__ == "__main__":
+    sys.exit(main())

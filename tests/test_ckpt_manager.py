@@ -78,6 +78,69 @@ def test_rotation_keeps_last_n(tmp_path):
                      "step_00000008.json", "step_00000008.msgpack"]
 
 
+def _nan_params(seed=0):
+    p = _params(seed)
+    leaves, treedef = jax.tree_util.tree_flatten(p)
+    leaves = [np.asarray(a) for a in leaves]
+    leaves[0] = np.full_like(leaves[0], np.nan)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def test_pinned_checkpoint_survives_rotation(tmp_path):
+    """The health watchdog's rescue save (pin=True) sits outside the
+    keep-last-N budget: later routine saves never rotate it away, and the
+    stray-payload sweep never collects its payload."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=2)
+    mgr.save(_params(9), _key_data(), "threefry2x32",
+             step=4, epoch=0, offset=4, pin=True)
+    for s in (8, 12, 16, 20):
+        _save(mgr, step=s)
+    assert mgr.steps() == [4, 16, 20]
+    assert (tmp_path / "s" / "step_00000004.msgpack").exists()
+    with open(tmp_path / "s" / "step_00000004.json") as f:
+        assert json.load(f)["pinned"] is True
+    # the pinned state is still fully restorable
+    got = mgr._load_intact(4, _params(0))
+    for a, b in zip(jax.tree_util.tree_leaves(got.params),
+                    jax.tree_util.tree_leaves(_params(9))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_prefers_newest_finite_over_nan_checkpoints(tmp_path):
+    """A diverged run commits intact-by-CRC checkpoints full of NaN;
+    restore must land on the newest FINITE one (the rescue), recording
+    each skipped non-finite candidate to the flight recorder."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=5)
+    mgr.save(_params(7), _key_data(), "threefry2x32",
+             step=4, epoch=0, offset=4, pin=True)
+    for s in (8, 12):
+        mgr.save(_nan_params(), _key_data(), "threefry2x32",
+                 step=s, epoch=0, offset=s)
+    before = len(get_flight_recorder().snapshot())
+    got = mgr.restore_latest(_params(0))
+    assert got.step == 4
+    assert all(np.isfinite(np.asarray(a)).all()
+               for a in jax.tree_util.tree_leaves(got.params))
+    tail = get_flight_recorder().snapshot()[before:]
+    assert [e["kind"] for e in tail] == ["checkpoint_fallback",
+                                        "checkpoint_fallback",
+                                        "checkpoint_restore"]
+    assert "non-finite" in tail[0]["error"]
+
+
+def test_restore_all_nonfinite_falls_back_to_newest_with_warning(tmp_path,
+                                                                 capsys):
+    """No finite candidate at all: restore returns the newest intact one
+    anyway (refusing would strand pre-watchdog resumes) — loudly."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    for s in (2, 4):
+        mgr.save(_nan_params(), _key_data(), "threefry2x32",
+                 step=s, epoch=0, offset=s)
+    got = mgr.restore_latest(_params(0))
+    assert got.step == 4
+    assert "non-finite" in capsys.readouterr().err
+
+
 def test_truncated_newest_falls_back_and_records_flight(tmp_path):
     """THE acceptance property: newest payload truncated -> restore returns
     the previous intact checkpoint and the fallback lands in the flight

@@ -184,6 +184,40 @@ def test_pallas_epoch_cli_guards(capsys):
         main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "2048"])
 
 
+def test_health_cli_guards(tmp_path):
+    """--health guard rails fail by name at parse/validate time: a fused
+    run has no live host to watch from, and checkpoint-and-warn needs a
+    checkpoint path to derive its rescue directory."""
+    with pytest.raises(SystemExit, match="--fused"):
+        main(["--health", "warn", "--cached", "--fused", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="--checkpoint"):
+        main(["--health", "checkpoint-and-warn", "--checkpoint", "",
+              "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="--metrics_port"):
+        main(["--metrics_port", "-1", "--n_epochs", "1"])
+
+
+def test_health_warn_end_to_end_with_injected_nan(tmp_path, capsys):
+    """--health warn + --fault nan:step=K: the run finishes (rc 0), the
+    epoch line shows the poisoned loss curve, and the health event landed
+    in the trace."""
+    import json
+    obs = tmp_path / "obs"
+    assert main(["--n_epochs", "1", "--limit", "256", "--batch_size", "64",
+                 "--path", str(tmp_path / "nodata"), "--checkpoint", "",
+                 "--health", "warn", "--fault", "nan:step=2",
+                 "--telemetry", str(obs)]) == 0
+    _out, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and "nan" in lines[0]
+    recs = [json.loads(ln) for ln in
+            open(obs / "events.jsonl").read().splitlines()]
+    health = [r for r in recs
+              if r["kind"] == "point" and r["name"] == "health"]
+    assert [h["attrs"]["detector"] for h in health] == ["nan"]
+    snap = [r for r in recs if r["kind"] == "snapshot"][-1]
+    assert snap["attrs"]["counters"]["health.fired.nan"] == 1
+
+
 def test_ddp_comm_cli_guards_and_training(tmp_path, capsys):
     """--ddp_comm guard rails (serial and pallas_epoch rejected by name)
     and an end-to-end --parallel --ddp_comm run per non-default strategy

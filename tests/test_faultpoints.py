@@ -139,6 +139,69 @@ def test_every_fired_fault_lands_in_flight_recorder():
     assert tail[0]["rank"] == 3 and tail[0]["batch"] == 7
 
 
+# -- the nan value fault (the health watchdog's chaos input) ---------------
+
+def test_nan_parses_and_points_at_loss():
+    (spec,) = parse_faults("nan:step=5")
+    assert spec.kind == "nan" and spec.point == "loss"
+    assert spec.where == {"step": 5}
+
+
+def test_poison_scalar_first_crossing_fires_once():
+    inj = FaultInjector(parse_faults("nan:step=5"))
+    assert inj.poison("loss", 1.5, step=4) == 1.5          # below threshold
+    out = inj.poison("loss", 1.5, step=7)                  # first crossing
+    assert np.isnan(out)
+    assert inj.poison("loss", 1.5, step=8) == 1.5          # fired once
+
+
+def test_poison_records_flight_before_poisoning():
+    before = len(get_flight_recorder().snapshot())
+    inj = FaultInjector(parse_faults("nan:step=2"), rank=1)
+    inj.poison("loss", 3.0, step=2, epoch=0)
+    tail = get_flight_recorder().snapshot()[before:]
+    assert [e["kind"] for e in tail] == ["fault_injected"]
+    assert tail[0]["fault"] == "nan:step=2" and tail[0]["rank"] == 1
+
+
+def test_poison_array_hits_the_crossing_index():
+    inj = FaultInjector(parse_faults("nan:step=6"))
+    # chunk covering steps 1..4: threshold not reached, array untouched
+    a = np.ones(4)
+    out = inj.poison_array("loss", a, first_step=1)
+    assert np.isfinite(out).all() and inj.specs[0].fired == 0
+    # chunk covering steps 5..8: step 6 is index 1
+    b = np.ones(4)
+    out = inj.poison_array("loss", b, first_step=5)
+    assert np.isnan(out[1]) and np.isfinite(np.delete(out, 1)).all()
+    assert np.isfinite(b).all()                 # caller's array untouched
+    # spent: later chunks stay clean
+    assert np.isfinite(inj.poison_array("loss", np.ones(4),
+                                        first_step=9)).all()
+
+
+def test_poison_array_threshold_already_passed_hits_first_index():
+    # first-crossing >= K: a chunk starting past K poisons its first step
+    inj = FaultInjector(parse_faults("nan:step=3"))
+    out = inj.poison_array("loss", np.ones(4), first_step=7)
+    assert np.isnan(out[0])
+
+
+def test_poison_is_noop_without_config():
+    assert faultpoints.poison("loss", 2.5, step=1) == 2.5
+    arr = np.ones(3)
+    assert faultpoints.poison_array("loss", arr, first_step=1) is arr
+
+
+def test_fire_never_acts_on_nan_specs():
+    # value faults only fire through poison(): fire() at the same point
+    # must neither act nor consume the budget
+    inj = FaultInjector(parse_faults("nan:step=1"))
+    inj.fire("loss", step=5)
+    assert inj.specs[0].fired == 0
+    assert np.isnan(inj.poison("loss", 1.0, step=5))
+
+
 # -- module-level switchboard ----------------------------------------------
 
 def test_fire_is_noop_without_config():

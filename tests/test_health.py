@@ -1,0 +1,662 @@
+"""Live health monitoring (telemetry/health.py + telemetry/prom.py): the
+watchdog's detector matrix (spike / NaN / grad-norm / update-ratio /
+throughput / straggler), the fatal-signal policies (warn,
+checkpoint-and-warn rescue of the last known-good state, abort), the
+zero-host-sync invariant (the NullTracer-test technique), the Prometheus
+text-format exposition (golden) and its stdlib HTTP endpoint, the serve
+`{"op": "health"}` SLO op, and the end-to-end nan:step=K chaos path
+through both trainers."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from pytorch_ddp_mnist_tpu import telemetry
+from pytorch_ddp_mnist_tpu.telemetry import MetricsRegistry
+from pytorch_ddp_mnist_tpu.telemetry.health import (AUX_FIELDS, HealthConfig,
+                                                    TrainingHealthError,
+                                                    Watchdog,
+                                                    device_health_aux,
+                                                    health_summary)
+from pytorch_ddp_mnist_tpu.telemetry.prom import (metric_name,
+                                                  render_prometheus,
+                                                  start_metrics_server)
+from pytorch_ddp_mnist_tpu.utils import faultpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faultpoints.FAULT_ENV, raising=False)
+    faultpoints.install()
+    yield
+    faultpoints.install()
+
+
+def _wd(policy="warn", **cfg):
+    reg = MetricsRegistry()
+    return Watchdog(HealthConfig(policy=policy, **cfg), registry=reg,
+                    lr=0.1, log=lambda _m: None), reg
+
+
+def _warm(wd, n=4, loss=1.0, dt=1.0):
+    """Feed `n` healthy observations so the ratio detectors arm."""
+    for e in range(n):
+        ev = wd.observe(np.full(8, loss), epoch=e, step=(e + 1) * 8,
+                        dt_s=dt, imgs=8 * 64)
+        assert ev == []
+
+
+# ---------------------------------------------------------------------------
+# the detector matrix
+# ---------------------------------------------------------------------------
+
+def test_healthy_run_fires_nothing():
+    wd, reg = _wd()
+    _warm(wd, n=8)
+    assert wd.events == []
+    assert health_summary(reg) == {"fired": {}, "worst_severity": "ok"}
+
+
+def test_nan_loss_is_fatal():
+    from pytorch_ddp_mnist_tpu.telemetry.flight import get_flight_recorder
+    before = len(get_flight_recorder().snapshot())
+    wd, reg = _wd()
+    (ev,) = wd.observe(np.array([1.0, float("nan"), 1.0]), epoch=0, step=3)
+    assert (ev.detector, ev.severity) == ("nan", "fatal")
+    assert reg.snapshot()["counters"]["health.fired.nan"] == 1
+    assert reg.snapshot()["gauges"]["health.worst_severity_level"] == 2
+    assert health_summary(reg)["worst_severity"] == "fatal"
+    # acceptance: the event reaches the flight recorder too (the
+    # post-mortem ring), not just the trace + registry
+    tail = [e for e in get_flight_recorder().snapshot()[before:]
+            if e["kind"] == "health"]
+    assert tail and tail[0]["detector"] == "nan" \
+        and tail[0]["severity"] == "fatal"
+
+
+def test_inf_loss_is_fatal():
+    wd, _reg = _wd()
+    (ev,) = wd.observe(np.array([float("inf")]), epoch=0, step=1)
+    assert ev.detector == "nan" and ev.severity == "fatal"
+
+
+def test_aux_finite_flag_trips_nan_detector():
+    wd, _ = _wd()
+    aux = np.array([[1.0, 0.0, 10.0]])     # finite flag 0: in-program trip
+    (ev,) = wd.observe(np.array([1.0]), aux=aux, epoch=0, step=1)
+    assert ev.detector == "nan" and "finite-check" in ev.message
+
+
+def test_loss_spike_after_warmup_only():
+    wd, _ = _wd()
+    # during warmup a 10x loss must NOT fire (no baseline yet)
+    assert wd.observe(np.full(8, 10.0), epoch=0, step=8) == []
+    wd2, _ = _wd()
+    _warm(wd2)
+    (ev,) = wd2.observe(np.full(8, 10.0), epoch=4, step=40)
+    assert (ev.detector, ev.severity) == ("loss_spike", "warn")
+    assert ev.value == pytest.approx(10.0)
+
+
+def test_grad_norm_explosion():
+    wd, _ = _wd()
+    good = np.tile([2.0, 1.0, 100.0], (8, 1))
+    for e in range(4):
+        assert wd.observe(np.full(8, 1.0), aux=good, epoch=e,
+                          step=(e + 1) * 8) == []
+    boom = np.tile([50.0, 1.0, 100.0], (8, 1))
+    (ev,) = wd.observe(np.full(8, 1.0), aux=boom, epoch=4, step=40)
+    assert (ev.detector, ev.severity) == ("grad_norm", "warn")
+
+
+def test_update_ratio_outside_band():
+    wd, _ = _wd()   # lr=0.1; band default (1e-9, 1e-1)
+    # ratio = lr * g / p = 0.1 * 60 / 10 = 0.6 > 0.1
+    aux = np.tile([60.0, 1.0, 10.0], (4, 1))
+    events = wd.observe(np.full(4, 1.0), aux=aux, epoch=0, step=4)
+    assert [e.detector for e in events] == ["update_ratio"]
+
+
+def test_throughput_collapse():
+    wd, _ = _wd()
+    _warm(wd, n=4, dt=1.0)                          # ~512 img/s baseline
+    (ev,) = wd.observe(np.full(8, 1.0), epoch=4, step=40,
+                       dt_s=20.0, imgs=8 * 64)      # ~26 img/s: collapse
+    assert (ev.detector, ev.severity) == ("throughput", "warn")
+
+
+def test_straggler_drift_uses_shared_skew_math():
+    from pytorch_ddp_mnist_tpu.telemetry.analysis import skew
+    wd, _ = _wd(straggler_skew_pct=50.0)
+    _warm(wd, n=4, dt=1.0)                          # warmup windows dropped
+    for e in range(4, 7):                           # steady post-warmup
+        assert wd.observe(np.full(8, 1.0), epoch=e, step=(e + 1) * 8,
+                          dt_s=1.0, imgs=8 * 64) == []
+    events = wd.observe(np.full(8, 1.0), epoch=7, step=64,
+                        dt_s=3.0, imgs=8 * 64)      # one 3x-slow window
+    names = [e.detector for e in events]
+    assert "straggler" in names
+    ev = events[names.index("straggler")]
+    # the online detector reports exactly analysis.skew over its window
+    # (the window opened at the last warmup observation: 4 steady values
+    # of 1/8 s/step before the 3/8 slow one)
+    _, expect_pct = skew([1.0 / 8] * 4 + [3.0 / 8])
+    assert ev.value == pytest.approx(expect_pct)
+
+
+def test_compile_heavy_first_window_not_a_straggler(caplog):
+    # the first observations carry XLA compile time; the straggler window
+    # must open after warmup or every run would begin with a false alarm
+    wd, _ = _wd(straggler_skew_pct=50.0)
+    assert wd.observe(np.full(8, 1.0), epoch=0, step=8,
+                      dt_s=30.0, imgs=8 * 64) == []      # compile window
+    for e in range(1, 8):
+        ev = wd.observe(np.full(8, 1.0), epoch=e, step=(e + 1) * 8,
+                        dt_s=1.0, imgs=8 * 64)
+        assert "straggler" not in [x.detector for x in ev]
+
+
+# ---------------------------------------------------------------------------
+# policy: warn / checkpoint-and-warn / abort
+# ---------------------------------------------------------------------------
+
+def test_abort_raises_training_health_error():
+    wd, _ = _wd(policy="abort")
+    with pytest.raises(TrainingHealthError, match="nan"):
+        wd.observe(np.array([float("nan")]), epoch=2, step=17)
+    # the events were recorded BEFORE the raise
+    assert [e.detector for e in wd.events] == ["nan"]
+
+
+def test_training_health_error_is_not_a_runtime_error():
+    # the outage-retry machinery triages RuntimeErrors for backend-loss
+    # signatures; a diverged model must never enter that path
+    assert not issubclass(TrainingHealthError, RuntimeError)
+
+
+class _FakeState:
+    def __init__(self, params):
+        self.params = params
+        self.key = jax.random.key(0)
+
+
+def test_checkpoint_and_warn_rescues_pre_nan_state():
+    saved = []
+    reg = MetricsRegistry()
+    wd = Watchdog(HealthConfig(policy="checkpoint-and-warn"), registry=reg,
+                  on_fatal=saved.append, log=lambda _m: None)
+    good = _FakeState({"w": np.full(3, 7.0)})
+    wd.seed_good(_FakeState({"w": np.zeros(3)}), epoch=0, offset=0, step=0)
+    wd.observe(np.full(4, 1.0), state=good, epoch=0, step=4,
+               ckpt_epoch=0, ckpt_offset=4)               # healthy: stashed
+    poisoned = _FakeState({"w": np.full(3, float("nan"))})
+    wd.observe(np.array([float("nan")]), state=poisoned, epoch=0, step=8)
+    (stash,) = saved
+    # the rescue got the LAST KNOWN-GOOD state and positions, not the
+    # poisoned one observed at detection time
+    assert stash["step"] == 4 and (stash["epoch"], stash["offset"]) == (0, 4)
+    np.testing.assert_array_equal(stash["params"]["w"], np.full(3, 7.0))
+
+
+def test_checkpoint_and_warn_first_window_rescues_the_seed():
+    saved = []
+    wd = Watchdog(HealthConfig(policy="checkpoint-and-warn"),
+                  registry=MetricsRegistry(), on_fatal=saved.append,
+                  log=lambda _m: None)
+    wd.seed_good(_FakeState({"w": np.ones(2)}), epoch=0, offset=0, step=0)
+    wd.observe(np.array([float("nan")]), epoch=0, step=4)
+    assert saved and saved[0]["step"] == 0
+
+
+def test_rescue_hook_failure_never_raises():
+    def explode(_stash):
+        raise OSError("disk died")
+    wd = Watchdog(HealthConfig(policy="checkpoint-and-warn"),
+                  registry=MetricsRegistry(), on_fatal=explode,
+                  log=lambda _m: None)
+    wd.seed_good(_FakeState({"w": np.ones(2)}), epoch=0, offset=0, step=0)
+    wd.observe(np.array([float("nan")]), epoch=0, step=1)   # must not raise
+
+
+def test_stash_skipped_without_rescue_hook():
+    # non-rank-0 watchdogs must not pay the per-observation params copy
+    wd, _ = _wd(policy="checkpoint-and-warn")
+    assert wd.on_fatal is None
+    wd.observe(np.full(4, 1.0), state=_FakeState({"w": np.ones(2)}),
+               epoch=0, step=4)
+    assert wd._last_good is None
+
+
+# ---------------------------------------------------------------------------
+# the device-side aux fold + zero-host-sync invariant
+# ---------------------------------------------------------------------------
+
+def test_device_health_aux_values():
+    loss = jnp.float32(1.0)
+    grads = {"a": jnp.array([3.0, 4.0])}            # |g| = 5
+    params = {"a": jnp.array([0.0, 12.0, 5.0])}     # |p| = 13
+    aux = np.asarray(device_health_aux(loss, grads, params))
+    assert aux.shape == (len(AUX_FIELDS),)
+    assert aux[0] == pytest.approx(5.0)
+    assert aux[1] == 1.0
+    assert aux[2] == pytest.approx(13.0)
+    bad = np.asarray(device_health_aux(
+        jnp.float32(float("nan")), grads, params))
+    assert bad[1] == 0.0
+
+
+def test_health_step_matches_plain_step_trajectory():
+    """health=True only APPENDS an output: params/key/loss bitwise match
+    the plain step."""
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.train.loop import make_train_step
+
+    x = np.random.default_rng(0).random((8, 784)).astype(np.float32)
+    y = np.arange(8) % 10
+    plain = make_train_step(0.1)
+    health = make_train_step(0.1, health=True)
+    assert not getattr(plain, "health_aux") and health.health_aux
+    p1, k1, l1 = plain(init_mlp(jax.random.key(0)), jax.random.key(1), x, y)
+    p2, k2, l2, aux = health(init_mlp(jax.random.key(0)),
+                             jax.random.key(1), x, y)
+    assert float(l1) == float(l2)
+    np.testing.assert_array_equal(np.asarray(jax.random.key_data(k1)),
+                                  np.asarray(jax.random.key_data(k2)))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    aux = np.asarray(aux)
+    assert aux[1] == 1.0 and aux[0] > 0 and aux[2] > 0
+
+
+def test_dp_health_step_returns_aux():
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel.ddp import dp_mesh, make_dp_train_step
+
+    mesh = dp_mesh()
+    step = make_dp_train_step(mesh, 0.1, health=True)
+    assert step.health_aux
+    n = mesh.devices.size
+    x = np.random.default_rng(0).random((8 * n, 784)).astype(np.float32)
+    y = np.arange(8 * n) % 10
+    params, key, loss, aux = step(init_mlp(jax.random.key(0)),
+                                  jax.random.key(1), x, y)
+    aux = np.asarray(aux)
+    assert aux.shape == (3,) and aux[1] == 1.0 and aux[0] > 0
+
+
+def _tiny_fit(watchdog=None):
+    from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
+                                            synthetic_mnist)
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    train = synthetic_mnist(128, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(train.images), train.labels,
+                         sampler, batch_size=32)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    return fit(state, loader, normalize_images(test.images),
+               test.labels.astype(np.int32), epochs=2, batch_size=32,
+               lr=0.1, log=lambda _m: None, watchdog=watchdog)
+
+
+def test_watchdog_healthy_path_never_forces_block_until_ready(monkeypatch):
+    """Acceptance: an ENABLED watchdog on a healthy run — with the
+    health-aux step fold active — adds zero block_until_ready-forcing
+    calls, exactly like the NullTracer invariant (the detectors consume
+    only already-fetched values; the aux rides the loss fetch)."""
+    calls = []
+    real = jax.block_until_ready
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda t: calls.append(1) or real(t))
+    wd, _ = _wd()
+    _tiny_fit(watchdog=wd)
+    assert calls == []
+    assert wd.events == [] or all(e.severity != "fatal" for e in wd.events)
+
+
+def test_watchdog_fetches_stay_epoch_granular(monkeypatch):
+    """The block_until_ready pin above cannot see np.asarray-style fetches
+    — so additionally count device->host conversions of jax Arrays during
+    a watchdog-enabled run: they must scale with EPOCHS (one loss + one
+    aux fetch per epoch, plus the eval fetch), never with STEPS."""
+    from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
+                                            synthetic_mnist)
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    train = synthetic_mnist(128, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(train.images), train.labels,
+                         sampler, batch_size=8)       # 16 steps/epoch
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    wd, _ = _wd()
+
+    real = np.asarray
+    fetches = []
+
+    def counting(a, *args, **kw):
+        if isinstance(a, jax.Array):
+            fetches.append(1)
+        return real(a, *args, **kw)
+
+    monkeypatch.setattr(np, "asarray", counting)
+    fit(state, loader, normalize_images(test.images),
+        test.labels.astype(np.int32), epochs=2, batch_size=8,
+        lr=0.1, log=lambda _m: None, watchdog=wd)
+    # 2 epochs x 16 steps: a per-step fetch regression would show >= 32
+    # conversions; the epoch-granular contract allows a handful per epoch
+    # (loss curve, aux, eval outputs)
+    assert len(fetches) <= 2 * 6, len(fetches)
+
+
+def test_fit_detects_injected_nan_and_emits_trace_event(tmp_path):
+    faultpoints.install("nan:step=2")
+    telemetry.enable(str(tmp_path))
+    try:
+        wd, reg = _wd()
+        _tiny_fit(watchdog=wd)
+    finally:
+        telemetry.disable()
+    nan_events = [e for e in wd.events if e.detector == "nan"]
+    assert nan_events and nan_events[0].severity == "fatal"
+    # detection at the fetch boundary: the window END is epoch 0's last
+    # step, the poisoned step is inside it
+    assert nan_events[0].epoch == 0 and nan_events[0].step == 4
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "events.jsonl").read().splitlines()]
+    health_pts = [r for r in recs
+                  if r["kind"] == "point" and r["name"] == "health"]
+    assert [p["attrs"]["detector"] for p in health_pts] == ["nan"]
+    assert health_pts[0]["attrs"]["severity"] == "fatal"
+
+
+def test_fit_cached_chunk_rescue_saves_pre_nan_chunk_boundary():
+    """The scanned trainer detects at checkpoint-chunk granularity: a NaN
+    in chunk 2 rescues the chunk-1-boundary state (the acceptance
+    'intact checkpoint at the pre-NaN step')."""
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train import TrainState
+    from pytorch_ddp_mnist_tpu.train.scan import fit_cached
+
+    faultpoints.install("nan:step=6")           # chunk 2 (steps 5..8)
+    saved = []
+    wd = Watchdog(HealthConfig(policy="checkpoint-and-warn"),
+                  registry=MetricsRegistry(), on_fatal=saved.append,
+                  log=lambda _m: None)
+    train = synthetic_mnist(512, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    wd.seed_good(state, epoch=0, offset=0, step=0)
+    sampler = ShardedSampler(512, num_replicas=1, rank=0, seed=42)
+    fit_cached(state, train.images, train.labels, sampler,
+               (test.images.reshape(64, -1) / 255.0).astype(np.float32),
+               test.labels.astype(np.int32), epochs=1, batch_size=64,
+               lr=0.1, ckpt_every_steps=4, log=lambda _m: None,
+               watchdog=wd)
+    (stash,) = saved
+    assert stash["step"] == 4                    # the pre-NaN boundary
+    assert (stash["epoch"], stash["offset"]) == (0, 4)
+    assert all(np.isfinite(leaf).all()
+               for leaf in jax.tree_util.tree_leaves(stash["params"]))
+    nan_events = [e for e in wd.events if e.detector == "nan"]
+    assert nan_events and nan_events[0].step == 8
+
+
+def test_fit_cached_fused_rejects_watchdog_by_name():
+    from pytorch_ddp_mnist_tpu.data import synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train import TrainState
+    from pytorch_ddp_mnist_tpu.train.scan import fit_cached
+
+    train = synthetic_mnist(128, seed=0)
+    wd, _ = _wd()
+    with pytest.raises(ValueError, match="fused"):
+        fit_cached(TrainState(init_mlp(jax.random.key(0)),
+                              jax.random.key(1)),
+                   train.images, train.labels,
+                   ShardedSampler(128, num_replicas=1, rank=0, seed=42),
+                   np.zeros((8, 784), np.float32),
+                   np.zeros(8, np.int32), epochs=1, batch_size=64,
+                   lr=0.1, fused=True, watchdog=wd)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: golden + endpoint
+# ---------------------------------------------------------------------------
+
+def test_metric_name_mapping():
+    assert metric_name("serve.latency_s") == "serve_latency_s"
+    assert metric_name("health.worst_severity_level") == \
+        "health_worst_severity_level"
+    assert metric_name("a-b c") == "a_b_c"
+    assert metric_name("9lives") == "_9lives"
+
+
+def test_render_prometheus_golden():
+    reg = MetricsRegistry()
+    reg.counter("train.steps").inc(42)
+    reg.gauge("queue.depth").set(3)
+    reg.gauge("dead.provider").set_fn(lambda: None)   # omitted, not lied
+    h = reg.histogram("lat_s")
+    h.record(0.001)
+    h.record(0.001)
+    text = render_prometheus(reg)
+    lines = text.splitlines()
+    assert lines[0] == "# TYPE train_steps counter"
+    assert lines[1] == "train_steps 42"
+    assert "# TYPE queue_depth gauge" in lines
+    assert "queue_depth 3" in lines
+    assert not any("dead_provider" in ln for ln in lines)
+    i = lines.index("# TYPE lat_s summary")
+    q50 = lines[i + 1]
+    assert q50.startswith('lat_s{quantile="0.5"} ')
+    # percentile clamps to the recorded max (the registry's contract)
+    assert float(q50.split()[-1]) == pytest.approx(0.001)
+    assert f"lat_s_count 2" in lines
+    assert any(ln.startswith("lat_s_sum ") for ln in lines)
+    assert "# TYPE lat_s_max gauge" in lines
+    assert text.endswith("\n")
+
+
+def test_render_covers_every_registry_metric_plus_health():
+    """Acceptance: the exposition covers every registry metric plus the
+    health_* gauges once a watchdog exists."""
+    reg = MetricsRegistry()
+    wd = Watchdog(HealthConfig(), registry=reg, log=lambda _m: None)
+    reg.counter("xla.compiles").inc(5)
+    reg.histogram("serve.latency_s").record(0.01)
+    wd.observe(np.array([float("nan")]), epoch=0, step=1)
+    text = render_prometheus(reg)
+    snap = reg.snapshot()
+    for name in (list(snap["counters"]) + list(snap["histograms"])
+                 + [n for n, v in snap["gauges"].items() if v is not None]):
+        assert metric_name(name) in text, name
+    assert "health_worst_severity_level 2" in text
+    assert "health_fired_nan 1" in text
+
+
+def test_render_safe_under_concurrent_metric_creation():
+    """The scrape thread renders while the training thread lazily creates
+    metrics (health.fired.<detector> on first firing, timer histograms):
+    snapshot() must list the tables under the registry lock or a scrape
+    dies with 'dictionary changed size during iteration'."""
+    import threading
+
+    reg = MetricsRegistry()
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set() and i < 20000:
+            reg.counter(f"c{i}").inc()
+            reg.histogram(f"h{i}").record(0.001)
+            i += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(60):
+            render_prometheus(reg)        # raised RuntimeError pre-fix
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_metrics_http_endpoint_and_healthz():
+    reg = MetricsRegistry()
+    wd = Watchdog(HealthConfig(), registry=reg, log=lambda _m: None)
+    reg.counter("train.steps").inc(7)
+    srv = start_metrics_server(0, registry=reg)
+    try:
+        port = srv.server_address[1]
+        resp = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                      timeout=10)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        body = resp.read().decode()
+        assert "train_steps 7" in body
+        assert "health_worst_severity_level 0" in body
+        hz = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                    timeout=10)
+        assert json.loads(hz.read()) == {"fired": {}, "worst_severity": "ok"}
+        with pytest.raises(urllib.error.HTTPError) as e404:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+        assert e404.value.code == 404
+        # a fatal signal flips /healthz to 503 — the liveness-probe story
+        wd.observe(np.array([float("nan")]), epoch=0, step=1)
+        with pytest.raises(urllib.error.HTTPError) as e503:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=10)
+        assert e503.value.code == 503
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serve: the rolling SLO monitor + {"op": "health"}
+# ---------------------------------------------------------------------------
+
+def test_slo_window_exact_percentile_and_rate():
+    from pytorch_ddp_mnist_tpu.serve import SLOWindow
+    w = SLOWindow(window=100)
+    assert w.percentile(0.99) == 0.0 and w.service_rate() is None
+    for i in range(100):
+        w.record(0.001 * (i + 1), t_done=float(i))
+    assert w.percentile(0.99) == pytest.approx(0.099)
+    assert w.percentile(0.50) == pytest.approx(0.050)
+    assert w.service_rate() == pytest.approx(1.0)   # 99 completions / 99 s
+    # the window ROLLS: a regime change is fully visible after `window`
+    for i in range(100):
+        w.record(0.5, t_done=100.0 + i * 0.01)      # collapse to 100 rps...
+    assert w.percentile(0.99) == pytest.approx(0.5)
+    assert w.service_rate() == pytest.approx(100.0, rel=0.02)
+
+
+def test_serve_health_op_answers_rolling_slo(monkeypatch):
+    import asyncio
+    from pytorch_ddp_mnist_tpu.cli.serve import handle_request
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.serve import InferenceEngine, ServeService
+
+    eng = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=4)
+    svc = ServeService(eng, max_delay_ms=1.0)
+
+    async def scenario():
+        for _ in range(5):
+            await handle_request(svc, {"pixels": [0.1] * 784})
+        return await handle_request(svc, {"op": "health"})
+
+    h = asyncio.run(scenario())
+    assert h["ok"]
+    health = h["health"]
+    assert health["window_n"] == 5
+    assert health["rolling_p99_ms"] > 0
+    assert health["service_rate_rps"] is not None
+    assert health["queue_depth"] == 0 and health["draining"] is False
+    # the same live numbers are registry gauges (the /metrics surface)
+    gauges = svc.metrics.registry.snapshot()["gauges"]
+    # the op rounds to 3 decimals of a millisecond; the gauge is exact
+    assert gauges["serve.rolling_p99_s"] == pytest.approx(
+        health["rolling_p99_ms"] / 1e3, abs=1e-6)
+    assert gauges["serve.service_rate_rps"] is not None
+
+
+# ---------------------------------------------------------------------------
+# health_summary + the bench stamp shape
+# ---------------------------------------------------------------------------
+
+def test_health_summary_empty_process():
+    assert health_summary(MetricsRegistry()) == {"fired": {},
+                                                 "worst_severity": None}
+
+
+def test_registry_stamp_carries_health_summary():
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "bench", pathlib.Path(__file__).resolve().parents[1] / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    reg = MetricsRegistry()
+    wd = Watchdog(HealthConfig(), registry=reg, log=lambda _m: None)
+    wd.observe(np.array([float("nan")]), epoch=0, step=1)
+    stamp = bench.registry_stamp(reg)
+    assert stamp["health_summary"] == {"fired": {"nan": 1},
+                                       "worst_severity": "fatal"}
+    json.dumps(stamp)                            # artifact-line JSON-able
+
+
+# ---------------------------------------------------------------------------
+# the checker's health-event schema
+# ---------------------------------------------------------------------------
+
+def _check(path_args):
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry",
+        pathlib.Path(__file__).resolve().parents[1] / "scripts"
+        / "check_telemetry.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(path_args)
+
+
+def _trace_with(tmp_path, attrs):
+    recs = [{"v": 1, "kind": "meta", "name": "trace_start", "t_wall": 1.0,
+             "t_mono": 1.0, "proc": 0},
+            {"v": 1, "kind": "point", "name": "health", "t_wall": 2.0,
+             "t_mono": 2.0, "proc": 0, "attrs": attrs}]
+    p = tmp_path / "events.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(p)
+
+
+def test_checker_accepts_wellformed_health_event(tmp_path):
+    assert _check([_trace_with(tmp_path, {"detector": "nan",
+                                          "severity": "fatal",
+                                          "value": 1.0})]) == 0
+
+
+@pytest.mark.parametrize("attrs", [
+    {"severity": "warn"},                         # detector missing
+    {"detector": "nan"},                          # severity missing
+    {"detector": "", "severity": "warn"},         # empty detector
+    {"detector": "nan", "severity": "nuclear"},   # unknown severity
+])
+def test_checker_rejects_malformed_health_events(tmp_path, attrs):
+    assert _check([_trace_with(tmp_path, attrs)]) == 1
