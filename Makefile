@@ -51,26 +51,34 @@ trace-smoke:
 		d = json.load(open('/tmp/pdmt_trace_smoke/trace.chrome.json')); \
 		assert d['traceEvents'], 'empty chrome trace'"
 
-# DDP comms smoke: the 3-strategy parity matrix on an 8-fake-device CPU
-# mesh — one telemetry-instrumented --parallel epoch per strategy, each
-# trace schema-validated AND gated on the ddp.* metrics being present
+# DDP comms smoke: the FULL strategy matrix (pmean/sharded/bf16/int8,
+# each with and without --overlap bucket-pipelining) on an 8-fake-device
+# CPU mesh — one telemetry-instrumented --parallel epoch per combination,
+# each trace schema-validated AND gated on the ddp.* metrics being present
 # (a run that silently dropped ddp.bytes_on_wire / ddp.collective_s
 # fails), then `bench.py --mode ddp` emits the per-strategy artifact
-# lines (throughput + scaling efficiency + parity drift vs pmean).
+# lines (throughput + scaling efficiency + parity drift vs pmean) at a
+# model scale where the strategies actually separate (--param_scale 2
+# keeps the smoke quick; the committed MULTICHIP artifact measures 16).
 ddp-smoke:
 	rm -rf /tmp/pdmt_ddp_smoke
-	for comm in pmean sharded bf16; do \
-		JAX_PLATFORMS=cpu \
-		XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-		$(PY) -m pytorch_ddp_mnist_tpu train --parallel \
-			--wireup_method single --ddp_comm $$comm --epochs 1 \
-			--limit 512 --batch_size 16 --checkpoint "" \
-			--telemetry /tmp/pdmt_ddp_smoke/$$comm || exit 1; \
-		$(PY) scripts/check_telemetry.py --require ddp. \
-			/tmp/pdmt_ddp_smoke/$$comm || exit 1; \
+	for comm in pmean sharded bf16 int8; do \
+		for ov in "" "--overlap"; do \
+			name=$$comm$${ov:+_overlap}; \
+			JAX_PLATFORMS=cpu \
+			XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+			$(PY) -m pytorch_ddp_mnist_tpu train --parallel \
+				--wireup_method single --ddp_comm $$comm $$ov \
+				--epochs 1 --limit 512 --batch_size 16 \
+				--checkpoint "" \
+				--telemetry /tmp/pdmt_ddp_smoke/$$name || exit 1; \
+			$(PY) scripts/check_telemetry.py --require ddp. \
+				/tmp/pdmt_ddp_smoke/$$name || exit 1; \
+		done; \
 	done
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-		$(PY) bench.py --mode ddp --epochs 3 --batch_size 16
+		$(PY) bench.py --mode ddp --epochs 3 --batch_size 16 \
+			--param_scale 2
 
 # Chaos smoke (docs/ROBUSTNESS.md): SIGKILL a seeded rank of a 4-process
 # fake-CPU-device training run at a seeded mid-epoch step, relaunch with

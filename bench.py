@@ -352,12 +352,15 @@ def _serve_bench(a) -> None:
 def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                       n_rows: int = 8192, strategies=None,
                       parity_steps: int = 3, parity_lr: float = 0.05,
-                      n_devices: int = None) -> list:
-    """Measure the DDP scan program once per gradient-communication
-    strategy on the full-device mesh, plus a 1-device baseline, and return
-    one row dict per strategy:
+                      n_devices: int = None, model: str = "mlp",
+                      param_scale: int = 1,
+                      overlap_variants=(False,)) -> list:
+    """Measure the DDP scan program once per (gradient-communication
+    strategy, overlap) combination on the full-device mesh, plus a
+    1-device baseline, and return one row dict per combination:
 
-        {strategy, n_devices, images_per_sec, per_chip_images_per_sec,
+        {strategy, overlap, model, param_scale, n_params, n_devices,
+         images_per_sec, per_chip_images_per_sec,
          scaling_efficiency_vs_1dev, bytes_on_wire_per_step_per_device,
          collective_s_p50, parity_max_rel_diff_vs_pmean,
          parity_max_abs_diff_vs_pmean}
@@ -369,10 +372,13 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
     parameter divergence vs the pmean baseline (0.0 for pmean itself — the
     bitwise pin); `parity_lr` governs ONLY that probe (deliberately larger
     than the measured program's fixed lr=0.01 so drift has signal).
-    Shared by `bench.py --mode ddp` and `scripts/multichip_smoke.py` so
-    the two artifacts can never measure different programs."""
+    `model`/`param_scale` pick the workload (models/zoo.py) — the
+    model-size axis that shows where compressed/overlapped collectives
+    cross over pmean. Shared by `bench.py --mode ddp` and
+    `scripts/multichip_smoke.py` so the two artifacts can never measure
+    different programs."""
     from pytorch_ddp_mnist_tpu.data import synthetic_mnist
-    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.models import param_count, resolve_model
     from pytorch_ddp_mnist_tpu.parallel import ShardedSampler, collectives
     from pytorch_ddp_mnist_tpu.parallel import data_parallel_mesh
     from pytorch_ddp_mnist_tpu.parallel.ddp import (batch_sharding,
@@ -386,6 +392,7 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     strategies = list(strategies or collectives.STRATEGIES)
+    spec = resolve_model(model, param_scale)
     # n_devices caps the mesh (e.g. multichip_smoke's pool holds a +1
     # spare device for the dry run's simulator that must NOT join the
     # measured mesh); default = every device, the bench-mode contract.
@@ -399,10 +406,11 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
     x_host = resident_images(split.images)
     y_host = split.labels.astype(np.int32)
     params_host = jax.tree_util.tree_map(np.asarray,
-                                         init_mlp(jax.random.key(0)))
+                                         spec.init(jax.random.key(0)))
+    n_params = param_count(params_host)
     key_host = np.asarray(jax.random.key_data(jax.random.key(1)))
 
-    def measure(mesh_m, comm):
+    def measure(mesh_m, comm, overlap=False):
         nm = int(mesh_m.devices.size)
         batch = per_chip_batch * nm
         rep = replicated(mesh_m)
@@ -415,31 +423,46 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
             idxs.append(epoch_batch_indices(sampler, batch))
         idxs = jax.device_put(np.stack(idxs),
                               NamedSharding(mesh_m, P(None, None, DATA_AXIS)))
-        run = make_dp_run_fn(mesh_m, lr=0.01, kernel="xla", comm=comm)
+        run = make_dp_run_fn(mesh_m, lr=0.01, kernel="xla", comm=comm,
+                             overlap=overlap, model=model,
+                             param_scale=param_scale)
 
         def fresh():
-            return (jax.device_put(params_host, rep),
-                    jax.random.wrap_key_data(jax.device_put(key_host, rep)))
+            # everything a window consumes is placed HERE, outside the
+            # Timer — including the int8 residual (O(n_params) host alloc
+            # + device transfer), so no strategy pays input prep on the
+            # clock that the others don't
+            args = [jax.device_put(params_host, rep),
+                    jax.random.wrap_key_data(jax.device_put(key_host, rep))]
+            if run.comm_state:
+                args.append(collectives.place_comm_state(
+                    mesh_m, params_host))
+            return args
 
-        p, k = fresh()
-        losses = np.asarray(run(p, k, x_all, y_all, idxs)[2])  # compile+sync
+        def go(args):
+            return run(args[0], args[1], x_all, y_all, idxs, *args[2:])
+
+        losses = np.asarray(go(fresh())[2])            # compile + sync
         assert np.isfinite(losses).all()
         best = float("inf")
         for _ in range(3):
-            p, k = fresh()
+            args = fresh()
             with Timer("window") as t:
-                out = run(p, k, x_all, y_all, idxs)
+                out = go(args)
                 t.sync(out[2])
             best = min(best, t.seconds)
         return idxs.size / best
 
-    def parity_params(comm):
+    def parity_params(comm, overlap=False):
         """`parity_steps` streaming DP steps on the full mesh — the
         make_dp_train_step program the acceptance pins."""
-        step = make_dp_train_step(mesh, lr=parity_lr, comm=comm)
+        step = make_dp_train_step(mesh, lr=parity_lr, comm=comm,
+                                  overlap=overlap, model=model,
+                                  param_scale=param_scale)
         p = jax.device_put(params_host, replicated(mesh))
         k = jax.random.wrap_key_data(
             jax.device_put(key_host, replicated(mesh)))
+        resid = step.place_comm_state(None, p) if step.comm_state else None
         bs = batch_sharding(mesh)
         b = per_chip_batch * n
         for s in range(parity_steps):
@@ -447,7 +470,10 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
             x = jax.device_put(
                 (x_host[rows].astype(np.float32) / 255.0), bs)
             y = jax.device_put(y_host[rows], bs)
-            p, k, _ = step(p, k, x, y)
+            if step.comm_state:
+                p, k, _, resid = step(p, k, x, y, resid)
+            else:
+                p, k, _ = step(p, k, x, y)
         return jax.tree_util.tree_map(np.asarray, p)
 
     one_dev_rate = measure(make_mesh([1], [DATA_AXIS], jax.devices()[:1]),
@@ -461,29 +487,53 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
 
     rows = []
     for comm in strategies:
-        rate = measure(mesh, comm)
-        leaves = jax.tree_util.tree_leaves(parity_params(comm))
-        # rel over near-zero params overstates drift; the abs number is
-        # the complementary view (both land in the artifact)
-        rel = max(float(np.max(np.abs(a - b) / (np.abs(b) + 1e-12)))
-                  for a, b in zip(leaves, ref_leaves))
-        absd = max(float(np.max(np.abs(a - b)))
-                   for a, b in zip(leaves, ref_leaves))
+        # The isolated comm probe is overlap-AGNOSTIC (overlap is step-
+        # program scheduling, not a different collective program), so it
+        # is measured ONCE per strategy and stamped on every overlap row
+        # — two probe runs of the same jitted program would publish
+        # run-to-run variance as a fake overlap effect.
         probe = collectives.make_comm_probe(mesh, comm)
         secs = collectives.measure_collective_seconds(
             probe, jax.device_put(params_host, replicated(mesh)))
-        rows.append({
-            "strategy": comm,
-            "n_devices": n,
-            "images_per_sec": round(rate, 1),
-            "per_chip_images_per_sec": round(rate / n, 1),
-            "scaling_efficiency_vs_1dev": round((rate / n) / one_dev_rate, 4),
-            "bytes_on_wire_per_step_per_device":
-                collectives.bytes_on_wire(params_host, n, comm),
-            "collective_s_p50": round(sorted(secs)[len(secs) // 2], 6),
-            "parity_max_rel_diff_vs_pmean": rel,
-            "parity_max_abs_diff_vs_pmean": absd,
-        })
+        coll_p50 = round(sorted(secs)[len(secs) // 2], 6)
+        for overlap in overlap_variants:
+            if overlap and comm in ("sharded", "int8"):
+                # overlap composes as the IDENTITY for bucket-structured
+                # strategies (apply_gradients never reads the flag): the
+                # step program is the same, so the overlap row reuses the
+                # base measurement — re-running a byte-identical program
+                # would publish run-to-run variance as a fake overlap
+                # effect (the same argument the probe comment makes)
+                base = next((r for r in rows if r["strategy"] == comm
+                             and not r["overlap"]), None)
+                if base is not None:
+                    rows.append({**base, "overlap": True})
+                    continue
+            rate = measure(mesh, comm, overlap)
+            leaves = jax.tree_util.tree_leaves(parity_params(comm, overlap))
+            # rel over near-zero params overstates drift; the abs number is
+            # the complementary view (both land in the artifact)
+            rel = max(float(np.max(np.abs(a - b) / (np.abs(b) + 1e-12)))
+                      for a, b in zip(leaves, ref_leaves))
+            absd = max(float(np.max(np.abs(a - b)))
+                       for a, b in zip(leaves, ref_leaves))
+            rows.append({
+                "strategy": comm,
+                "overlap": bool(overlap),
+                "model": model,
+                "param_scale": param_scale,
+                "n_params": n_params,
+                "n_devices": n,
+                "images_per_sec": round(rate, 1),
+                "per_chip_images_per_sec": round(rate / n, 1),
+                "scaling_efficiency_vs_1dev": round((rate / n)
+                                                    / one_dev_rate, 4),
+                "bytes_on_wire_per_step_per_device":
+                    collectives.bytes_on_wire(params_host, n, comm),
+                "collective_s_p50": coll_p50,
+                "parity_max_rel_diff_vs_pmean": rel,
+                "parity_max_abs_diff_vs_pmean": absd,
+            })
     return rows
 
 
@@ -499,7 +549,9 @@ def _ddp_bench(a) -> None:
     from pytorch_ddp_mnist_tpu.parallel import COMM_STRATEGIES
     strategies = (COMM_STRATEGIES if a.ddp_comm == "all" else (a.ddp_comm,))
     rows = ddp_strategy_rows(per_chip_batch=a.batch_size, epochs=a.epochs,
-                             strategies=strategies)
+                             strategies=strategies, model=a.model,
+                             param_scale=a.param_scale,
+                             overlap_variants=(a.overlap,))
     stamp = registry_stamp()
     for r in rows:
         print(json.dumps({
@@ -698,12 +750,26 @@ def main(argv=None) -> None:
                         "efficiency vs 1 device, wire bytes, parity drift "
                         "vs pmean; real chips or "
                         "--xla_force_host_platform_device_count fakes)")
-    p.add_argument("--ddp_comm", choices=("all", "pmean", "sharded", "bf16"),
+    p.add_argument("--ddp_comm", choices=("all", "pmean", "sharded", "bf16",
+                                          "int8"),
                    default="all",
                    help="ddp mode: which gradient-communication "
                         "strategy(ies) to measure (parallel/collectives.py; "
-                        "default all three — scripts/bench_matrix.py "
+                        "default all four — scripts/bench_matrix.py "
                         "selects one per row)")
+    p.add_argument("--overlap", action="store_true",
+                   help="ddp mode: measure the bucket-pipelined variant "
+                        "(one collective per gradient bucket launched off "
+                        "its own backward slice; arXiv:1711.00705) of the "
+                        "selected strategies instead of the whole-tree-"
+                        "barrier form")
+    p.add_argument("--model", choices=("mlp", "deep_mlp"), default="mlp",
+                   help="ddp mode: model family for the measured workload "
+                        "(models/zoo.py)")
+    p.add_argument("--param_scale", type=int, default=1,
+                   help="ddp mode: hidden-width multiplier (128*N units; "
+                        "the model-size axis of the strategy crossover "
+                        "table in docs/PERF.md)")
     p.add_argument("--num_workers", type=int, default=0,
                    help="stream mode: readahead threads")
     p.add_argument("--offered_rps", type=float, default=500.0,
@@ -750,9 +816,17 @@ def main(argv=None) -> None:
             if getattr(a, dest) != p.get_default(dest):
                 p.error(f"--{dest} {getattr(a, dest)} is a serve-mode "
                         f"knob; --mode {a.mode} never reads it")
-    if a.mode != "ddp" and a.ddp_comm != "all":
-        p.error(f"--ddp_comm {a.ddp_comm} is a ddp-mode knob; "
-                f"--mode {a.mode} never reads it")
+    if a.mode != "ddp":
+        for dest in ("ddp_comm", "overlap", "model", "param_scale"):
+            if getattr(a, dest) != p.get_default(dest):
+                p.error(f"--{dest} {getattr(a, dest)} is a ddp-mode knob; "
+                        f"--mode {a.mode} never reads it")
+    else:
+        from pytorch_ddp_mnist_tpu.models import validate_model
+        try:
+            validate_model(a.model, a.param_scale)
+        except ValueError as e:
+            p.error(str(e))
     if a.epochs is None:   # per-mode default, a sentinel rather than a
         # value compare so an EXPLICIT --epochs 400 in accuracy mode is
         # honored instead of silently remapped

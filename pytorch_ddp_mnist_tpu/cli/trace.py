@@ -17,6 +17,18 @@ dir/file or a saved `--json` report — and exits 3 when any phase's p50/p95
 regresses past `--threshold`x: the step-time regression gate bench.py and
 CI hang off (`make trace-smoke`).
 
+Target and baseline may also be DDP bench artifacts (MULTICHIP_r0X.json /
+anything carrying `strategies` rows): the gate then compares each
+strategy's `scaling_efficiency_vs_1dev` and exits 3 when efficiency drops
+past the same threshold — the multichip efficiency regression gate:
+
+    python -m pytorch_ddp_mnist_tpu trace report MULTICHIP_r08.json \
+        --baseline MULTICHIP_r07.json
+
+Only rows measured on the SAME workload pair up: a row's label carries
+its `--model`/`--param_scale` when non-default, so a scale-16 artifact
+never false-regresses against a scale-1 baseline (they share no rows).
+
 `export` renders the merged trace as Chrome trace-event JSON, loadable in
 Perfetto (https://ui.perfetto.dev) or `chrome://tracing`: one track per
 process, aggregate phase durations on their own thread, counter tracks from
@@ -58,6 +70,17 @@ def _load_report(target: str):
             if isinstance(nested, dict) \
                     and nested.get("report") == "trace_phase_stats":
                 return nested, None  # a saved --baseline --json document
+            if isinstance(head.get("strategies"), list):
+                # a DDP bench artifact (MULTICHIP_r0X.json): gate on its
+                # per-strategy scaling_efficiency_vs_1dev rows — the
+                # efficiency regression gate (ROADMAP item 2), same exit-3
+                # contract as the step-time phases
+                rep = analysis.efficiency_report(head, path=target)
+                if rep["records"] == 0:
+                    return None, (f"{target}: artifact carries no "
+                                  f"strategy rows with "
+                                  f"{analysis.EFFICIENCY_STAT}")
+                return rep, None
     if not paths:
         return None, f"{target}: no events*.jsonl found"
     report = analysis.analyze(paths)

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..data import BatchLoader, normalize_images
 from ..data.mnist import get_mnist
-from ..models import init_mlp, param_count
+from ..models import param_count
 from ..parallel import ShardedSampler
 from ..train import (TrainState, fit, save_checkpoint, load_checkpoint)
 from ..train.config import configure
@@ -32,10 +32,16 @@ _DEFAULT_STASH = "outage_resume.msgpack"
 
 def _run_geometry(tcfg, dcfg, global_batch: int) -> dict:
     """The config fields whose change would silently RE-INTERPRET a step
-    checkpoint's (epoch, offset) position — stamped into every manifest
-    and compared at directory resume (same values or refuse by name)."""
+    checkpoint — stamped into every manifest and compared at directory
+    resume (same values or refuse by name). (epoch, offset) only address
+    the right batches under the same global_batch/limit/sampler_rng, and
+    the params blob only restores into the right model under the same
+    --model/--param_scale (flax from_bytes matches dict KEYS, not shapes,
+    so a scale-8 blob would silently load into a scale-1 template)."""
     return {"global_batch": int(global_batch), "limit": int(dcfg["limit"]),
-            "sampler_rng": tcfg["sampler_rng"]}
+            "sampler_rng": tcfg["sampler_rng"],
+            "model": tcfg["model"],
+            "param_scale": int(tcfg["param_scale"])}
 
 
 def _persist_and_reexec(tcfg, stash, remaining: int, process_index: int,
@@ -305,6 +311,50 @@ def main(argv=None) -> int:
             f"--bf16_rounding {tcfg['bf16_rounding']} rounds the bf16 "
             f"strategy's wire cast; --ddp_comm {tcfg['ddp_comm']} never "
             f"casts — use --ddp_comm bf16")
+    # int8 / overlap / model-zoo knob hygiene: every knob that some other
+    # configuration would silently ignore is rejected by name instead
+    # (the unroll lesson) — single sources of truth in
+    # parallel/collectives.py and models/zoo.py.
+    from ..models.zoo import is_default_model, validate_model
+    from ..parallel.collectives import validate_int8_options
+    try:
+        validate_model(tcfg["model"], tcfg["param_scale"])
+        validate_int8_options(tcfg["quant_block"], tcfg["error_feedback"],
+                              tcfg["ddp_comm"])
+    except ValueError as e:
+        raise SystemExit(str(e))
+    nondefault_model = not is_default_model(tcfg["model"],
+                                            tcfg["param_scale"])
+    if tcfg["overlap"] and not tcfg["parallel"]:
+        raise SystemExit(
+            "--overlap bucket-pipelines the DDP gradient collectives; it "
+            "needs --parallel")
+    # The new strategies and the model zoo run on the XLA kernels only:
+    # the Pallas kernels hard-code the reference MLP's VMEM shapes and the
+    # fused-kernel DP step does not thread error-feedback state. An
+    # explicit conflicting --kernel is rejected by name; 'auto' (which
+    # would promote to Pallas on TPU) resolves to xla for these configs.
+    _xla_only = []
+    if tcfg["overlap"]:
+        _xla_only.append("--overlap (bucket-pipelined XLA collectives)")
+    if tcfg["ddp_comm"] == "int8":
+        _xla_only.append("--ddp_comm int8 (error-feedback state threading)")
+    if nondefault_model:
+        _xla_only.append(f"--model {tcfg['model']} --param_scale "
+                         f"{tcfg['param_scale']} (non-reference shapes)")
+    if _xla_only:
+        if tcfg["kernel"] in ("pallas", "pallas_rng", "pallas_epoch"):
+            raise SystemExit(
+                f"--kernel {tcfg['kernel']} hard-codes the reference MLP / "
+                f"owns its own comms; {'; '.join(_xla_only)} need(s) "
+                f"--kernel xla")
+        if tcfg["kernel"] == "auto":
+            tcfg["kernel"] = "xla"
+    if nondefault_model and tcfg["dropout_rng"] == "torch":
+        raise SystemExit(
+            "--dropout_rng torch streams masks sized for the reference "
+            "MLP's hidden layer; --model/--param_scale change that "
+            "geometry — use the default jax dropout stream")
     if not 0 <= tcfg["start_epoch"] <= tcfg["n_epochs"]:
         raise SystemExit(f"--start_epoch {tcfg['start_epoch']} outside "
                          f"[0, {tcfg['n_epochs']}] (n_epochs is the TOTAL "
@@ -471,6 +521,10 @@ def main(argv=None) -> int:
                     mesh, tcfg["lr"], dtype=tcfg["dtype"],
                     comm=tcfg["ddp_comm"],
                     bf16_rounding=tcfg["bf16_rounding"],
+                    overlap=tcfg["overlap"],
+                    quant_block=tcfg["quant_block"],
+                    error_feedback=tcfg["error_feedback"],
+                    model=tcfg["model"], param_scale=tcfg["param_scale"],
                     # fold the watchdog's grad-norm/finite-check aux into
                     # the step program (telemetry/health.py) — rides the
                     # existing per-epoch loss fetch, zero extra syncs
@@ -563,8 +617,12 @@ def main(argv=None) -> int:
 
     # Params init always uses threefry (bit-stable across --impl: the same
     # seed gives the same initial weights); --impl only selects the engine
-    # of the TRAIN key, i.e. the dropout stream.
-    state = TrainState(init_mlp(jax.random.key(tcfg["seed"])),
+    # of the TRAIN key, i.e. the dropout stream. The model spec
+    # (models/zoo.py) resolves --model/--param_scale; the default is
+    # literally init_mlp/mlp_apply, bit-for-bit.
+    from ..models import resolve_model
+    model_spec = resolve_model(tcfg["model"], tcfg["param_scale"])
+    state = TrainState(model_spec.init(jax.random.key(tcfg["seed"])),
                        jax.random.key(tcfg["seed"] + 1, impl=tcfg["impl"]))
     # Sidecar lifetime (ADVICE r4): the (checkpoint, .rng.npz) pair must
     # survive until the resumed run actually OVERWRITES that checkpoint —
@@ -646,8 +704,32 @@ def main(argv=None) -> int:
                    "--kernel pallas_epoch splits its dropout key once per "
                    "EPOCH")
                 + " — resume with plain --cached / --kernel xla|pallas")
+        carries_resid = (tcfg["ddp_comm"] == "int8"
+                         and tcfg["error_feedback"])
+        if restored.resid is not None and not carries_resid:
+            print("[ckpt] note: checkpoint carries an int8 error-feedback "
+                  "residual this run's comm strategy never reads "
+                  f"(--ddp_comm {tcfg['ddp_comm']}); ignoring it",
+                  file=sys.stderr, flush=True)
+        if carries_resid and restored.resid is not None and mesh is not None:
+            # Residual-geometry guard: the error-feedback state is
+            # per-DEVICE (one row per mesh device), so _run_geometry's
+            # batch/model stamp cannot catch a device-count change — an
+            # 8-device residual has no meaning on a 4-device mesh. Refuse
+            # by name here like every other geometry mismatch, instead of
+            # surfacing place_comm_state's ValueError mid-fit.
+            resid_rows = int(np.asarray(restored.resid).shape[0])
+            if resid_rows != int(mesh.devices.size):
+                raise SystemExit(
+                    f"--resume: checkpoint's int8 error-feedback residual "
+                    f"was saved on {resid_rows} device(s); this run has "
+                    f"{int(mesh.devices.size)} — per-device residuals "
+                    f"cannot be re-sharded across a different mesh size "
+                    f"(resume on {resid_rows} device(s), or restart the "
+                    f"run fresh and lose one step's quantization error)")
         state = TrainState(restored.params, jax.random.wrap_key_data(
-            jax.numpy.asarray(restored.key_data), impl=restored.impl))
+            jax.numpy.asarray(restored.key_data), impl=restored.impl),
+            resid=restored.resid if carries_resid else None)
         tcfg["start_epoch"] = restored.epoch
         start_offset = restored.offset
         start_step = restored.step
@@ -672,8 +754,12 @@ def main(argv=None) -> int:
             sidecar_box["sidecar"] = rng_sidecar
             sidecar_box["ckpt"] = tcfg["resume"]
     if mesh is not None:
+        # the error-feedback residual stays a HOST array here: it is
+        # device-VARYING state (sharded over 'dp', not replicated) and the
+        # trainers place it themselves via collectives.place_comm_state
         state = TrainState(replicate_state(mesh, state.params),
-                           replicate_state(mesh, state.key))
+                           replicate_state(mesh, state.key),
+                           resid=state.resid)
 
     # --health: the live training-health watchdog (telemetry/health.py).
     # Detectors run on every rank (each rank's health events land in ITS
@@ -699,7 +785,11 @@ def main(argv=None) -> int:
                     stash["params"], stash["key"], tcfg["impl"],
                     step=stash["step"], epoch=stash["epoch"],
                     offset=stash["offset"],
-                    meta=_run_geometry(tcfg, dcfg, global_batch), pin=True)
+                    meta=_run_geometry(tcfg, dcfg, global_batch), pin=True,
+                    # the int8 error-feedback residual the watchdog
+                    # stashed alongside params/key (None off-int8 or in
+                    # a multi-host world — see Watchdog._stash)
+                    resid=stash.get("resid"))
                 print(f"[health] rescue checkpoint committed: {path}",
                       file=sys.stderr, flush=True)
         watchdog = Watchdog(HealthConfig(policy=tcfg["health"]),
@@ -776,12 +866,36 @@ def main(argv=None) -> int:
         step_mgr = CheckpointManager(tcfg["checkpoint"] + ".steps",
                                      keep=tcfg["ckpt_keep"])
 
+        resid_warned = [False]
+
         def step_hook(ep, off, gs, st):
+            # the int8 strategy's error-feedback residual rides the
+            # checkpoint so a resumed run continues the unbroken
+            # quantization-error accounting — but it is dp-SHARDED
+            # device state, and in a multi-HOST world rank 0 cannot
+            # fetch the other hosts' shards without a collective (only
+            # rank 0 runs this hook, so a collective here would
+            # deadlock). Degrade loudly: the checkpoint commits without
+            # it and a resume reseeds a zero residual, losing at most
+            # one step's quantization error — never the run.
+            resid = None
+            if st.resid is not None:
+                if getattr(st.resid, "is_fully_addressable", True):
+                    resid = np.asarray(st.resid)
+                elif not resid_warned[0]:
+                    resid_warned[0] = True
+                    telemetry.flight.record("checkpoint_resid_skipped",
+                                            step=gs)
+                    print("[ckpt] int8 residual spans non-addressable "
+                          "devices (multi-host world); step checkpoints "
+                          "commit without it — a resume reseeds a zero "
+                          "residual", file=sys.stderr, flush=True)
             try:
                 step_mgr.save(st.params,
                               np.asarray(jax.random.key_data(st.key)),
                               tcfg["impl"], step=gs, epoch=ep, offset=off,
-                              meta=_run_geometry(tcfg, dcfg, global_batch))
+                              meta=_run_geometry(tcfg, dcfg, global_batch),
+                              resid=resid)
             except CheckpointError as e:
                 telemetry.flight.record("checkpoint_save_failed", step=gs,
                                         error=str(e)[:500])
@@ -842,6 +956,11 @@ def main(argv=None) -> int:
                               interpret=use_pallas and _pallas_interpret(),
                               fused=tcfg["fused"], comm=tcfg["ddp_comm"],
                               bf16_rounding=tcfg["bf16_rounding"],
+                              overlap=tcfg["overlap"],
+                              quant_block=tcfg["quant_block"],
+                              error_feedback=tcfg["error_feedback"],
+                              model=tcfg["model"],
+                              param_scale=tcfg["param_scale"],
                               log=log, epoch_hook=hook, start_epoch=start,
                               start_offset=(start_offset
                                             if start == tcfg["start_epoch"]
@@ -874,6 +993,7 @@ def main(argv=None) -> int:
                        batch_size=global_batch,
                        **({"lr": tcfg["lr"]} if train_step is None else {}),
                        log=log, train_step=train_step, put=put,
+                       model_apply=model_spec.apply,
                        epoch_hook=hook, start_epoch=start,
                        start_offset=(start_offset
                                      if start == tcfg["start_epoch"]

@@ -1,3 +1,7 @@
 from .mlp import MLP_DIMS, init_mlp, mlp_apply, param_count
+from .zoo import MODELS, ModelSpec, is_default_model, resolve_model, \
+    validate_model
 
-__all__ = ["MLP_DIMS", "init_mlp", "mlp_apply", "param_count"]
+__all__ = ["MLP_DIMS", "init_mlp", "mlp_apply", "param_count",
+           "MODELS", "ModelSpec", "is_default_model", "resolve_model",
+           "validate_model"]

@@ -55,9 +55,11 @@ def _torch_linear_init(key: jax.Array, fan_in: int, fan_out: int, *, bias: bool,
     return layer
 
 
-def init_mlp(key: jax.Array, dtype=jnp.float32) -> Params:
-    """Initialize the 784-128-128-10 MLP params pytree."""
-    d0, d1, d2, d3 = MLP_DIMS
+def init_mlp(key: jax.Array, dtype=jnp.float32, dims=MLP_DIMS) -> Params:
+    """Initialize the 784-128-128-10 MLP params pytree. `dims` widens the
+    two hidden layers for the scaled model family (models/zoo.py
+    `--param_scale`); the default is bit-for-bit the reference init."""
+    d0, d1, d2, d3 = dims
     k1, k2, k3 = jax.random.split(key, 3)
     return {
         "fc1": _torch_linear_init(k1, d0, d1, bias=True, dtype=dtype),

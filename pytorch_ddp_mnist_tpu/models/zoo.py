@@ -1,0 +1,141 @@
+"""Model zoo — the workload-scaling knob behind one interface.
+
+The 118k-param reference MLP is too small to be communication-bound in any
+interesting way (ROADMAP item 2: at that size every comm saving drowns in
+fixed costs, which is exactly how bf16 "compression" measured SLOWEST in
+MULTICHIP_r06). This module parameterizes the model family so the perf
+work has something to bite on, WITHOUT forking the training stack: every
+model is the same (init, apply) functional pair the trainers already
+consume, same 784-feature input, same 10-class head, dropout 0.2 after the
+first layer (the reference's one dropout site).
+
+    resolve_model("mlp", 1)        -> literally (init_mlp, mlp_apply): the
+                                      reference model, bit-for-bit — every
+                                      existing parity pin stays anchored
+    resolve_model("mlp", N)        -> hidden widths scaled N× (784-128N-
+                                      128N-10), same 3-layer topology
+    resolve_model("deep_mlp", N)   -> DEEP_MLP_LAYERS hidden layers of
+                                      width 128N (out layer bias-free like
+                                      the reference head)
+
+`param_scale` multiplies hidden WIDTH, so params grow ~quadratically: the
+knob reaches genuinely comm-bound sizes fast (mlp@8 ≈ 1.9M params ≈ 7.4 MB
+of f32 gradient on the wire per step under pmean; deep_mlp@8 ≈ 4.0M).
+`cli/train.py --model/--param_scale`, `bench.py --mode ddp`, and
+`scripts/bench_matrix.py`'s model-size axis all funnel through
+`resolve_model`; docs/PERF.md carries the measured strategy × model-size
+crossover table.
+
+The Pallas kernels hard-code the reference MLP's dims (VMEM block shapes
+are compile-time constants there), so non-default models run the XLA
+kernel — callers reject other kernels by name.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .mlp import (DROPOUT_RATE, MLP_DIMS, _torch_linear_init, init_mlp,
+                  mlp_apply)
+
+MODELS = ("mlp", "deep_mlp")
+DEEP_MLP_LAYERS = 4          # hidden layers of the deep_mlp family
+HIDDEN_BASE = MLP_DIMS[1]    # 128 — param_scale multiplies this
+
+
+class ModelSpec(NamedTuple):
+    """One resolved model: everything a trainer needs. `init(key)` builds
+    the params pytree; `apply(params, x, train=, dropout_key=,
+    dropout_mask=)` has exactly `mlp_apply`'s signature so the step
+    builders are model-agnostic."""
+    name: str
+    param_scale: int
+    init: Callable[..., Any]
+    apply: Callable[..., jax.Array]
+    dims: Tuple[int, ...]
+
+
+def validate_model(model: str, param_scale: int) -> None:
+    """Reject unknown families / non-positive scales by name — the single
+    source of truth the CLI, bench, and step builders funnel through."""
+    if model not in MODELS:
+        raise ValueError(f"unknown model {model!r}; choose one of {MODELS}")
+    if not isinstance(param_scale, int) or param_scale < 1:
+        raise ValueError(f"param_scale must be an int >= 1 (multiplies the "
+                         f"{HIDDEN_BASE}-unit hidden width); got "
+                         f"{param_scale!r}")
+
+
+def is_default_model(model: str, param_scale: int) -> bool:
+    return model == "mlp" and param_scale == 1
+
+
+def init_deep_mlp(key: jax.Array, width: int, depth: int,
+                  dtype=jnp.float32) -> dict:
+    """784 -> depth × width hidden (ReLU; dropout after the first, like
+    the reference) -> 10 bias-free head, torch Linear init bounds
+    throughout (the same `_torch_linear_init` as the reference MLP)."""
+    keys = jax.random.split(key, depth + 1)
+    params = {}
+    fan_in = MLP_DIMS[0]
+    for i in range(depth):
+        params[f"h{i}"] = _torch_linear_init(keys[i], fan_in, width,
+                                             bias=True, dtype=dtype)
+        fan_in = width
+    params["out"] = _torch_linear_init(keys[depth], fan_in, MLP_DIMS[3],
+                                       bias=False, dtype=dtype)
+    return params
+
+
+def deep_mlp_apply(params: dict, x: jax.Array, *, train: bool = False,
+                   dropout_key: jax.Array | None = None,
+                   dropout_mask: jax.Array | None = None) -> jax.Array:
+    """Forward pass of the deep family — mlp_apply's exact contract
+    (compute dtype follows x, dropout only after the first hidden layer,
+    exactly one of key/mask in train mode)."""
+    dt = x.dtype
+    depth = sum(1 for k in params if k.startswith("h"))
+    h = x
+    for i in range(depth):
+        layer = params[f"h{i}"]
+        h = h @ layer["w"].astype(dt) + layer["b"].astype(dt)
+        h = jax.nn.relu(h)
+        if i == 0 and train:
+            keep = 1.0 - DROPOUT_RATE
+            if (dropout_key is None) == (dropout_mask is None):
+                raise ValueError("train=True requires exactly one of "
+                                 "dropout_key / dropout_mask")
+            if dropout_mask is not None:
+                h = h * (dropout_mask.astype(dt)
+                         * jnp.asarray(1.0 / keep, dt))
+            else:
+                mask = jax.random.bernoulli(dropout_key, keep, h.shape)
+                h = jnp.where(mask, h / jnp.asarray(keep, dt),
+                              jnp.zeros((), dt))
+    return h @ params["out"]["w"].astype(dt)
+
+
+def resolve_model(model: str = "mlp", param_scale: int = 1) -> ModelSpec:
+    """(init, apply) for the named family at the given width scale.
+
+    The default resolves to the UNTOUCHED reference pair (same function
+    objects, not wrappers), so every bitwise pin built on init_mlp /
+    mlp_apply keeps holding by construction."""
+    validate_model(model, param_scale)
+    if model == "mlp":
+        if param_scale == 1:
+            return ModelSpec("mlp", 1, init_mlp, mlp_apply, MLP_DIMS)
+        dims = (MLP_DIMS[0], HIDDEN_BASE * param_scale,
+                HIDDEN_BASE * param_scale, MLP_DIMS[3])
+        return ModelSpec("mlp", param_scale,
+                         partial(init_mlp, dims=dims), mlp_apply, dims)
+    width = HIDDEN_BASE * param_scale
+    dims = (MLP_DIMS[0],) + (width,) * DEEP_MLP_LAYERS + (MLP_DIMS[3],)
+    return ModelSpec("deep_mlp", param_scale,
+                     partial(init_deep_mlp, width=width,
+                             depth=DEEP_MLP_LAYERS),
+                     deep_mlp_apply, dims)

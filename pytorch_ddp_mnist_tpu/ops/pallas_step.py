@@ -1273,6 +1273,14 @@ def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False,
 
     collectives.validate_comm(comm)
     collectives.validate_bf16_rounding(bf16_rounding, comm)
+    if comm == "int8":
+        # the int8 strategy threads error-feedback residual state through
+        # the step carry; this fused-kernel step has the plain
+        # (params, key, x, y) shape — keep the XLA step for int8
+        raise ValueError(
+            "comm='int8' carries error-feedback state the fused Pallas DP "
+            "step does not thread; use kernel='xla' "
+            "(parallel.ddp.make_dp_train_step) for the int8 strategy")
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     n_dev = int(mesh.devices.size)
 
@@ -1315,4 +1323,5 @@ def make_pallas_dp_train_step(mesh, lr: float, *, interpret: bool = False,
     step.ddp_comm = comm
     step.ddp_mesh = mesh
     step.ddp_devices = n_dev
+    step.comm_state = False
     return step

@@ -1,9 +1,9 @@
 """Gradient-communication strategies for the DDP step — one interface,
-three selectable programs.
+four selectable programs, optionally bucket-pipelined.
 
 The reference's DDP step (ddp_tutorial_multi_gpu.py:94) allreduce-means the
 full float32 gradient every step and then runs the SGD update REDUNDANTLY on
-every rank. That shape is the baseline here (`pmean`), and two measured
+every rank. That shape is the baseline here (`pmean`), and three measured
 alternatives sit behind the same switch:
 
   * `pmean`    — the naive baseline: one full-gradient f32
@@ -27,19 +27,47 @@ alternatives sit behind the same switch:
     quantization. Numeric drift vs `pmean` is bounded and pinned by test
     (note the bf16 REDUCTION error grows with device count — re-pin the
     bound before leaning on it past ~dozens of replicas).
+  * `int8`     — block-scaled int8 quantized allreduce with per-device
+    ERROR-FEEDBACK residuals (EQuARX proper, arXiv:2506.17615): each
+    device adds last step's quantization error back into its local
+    gradient, quantizes per `quant_block`-element block (int8 values + one
+    f32 scale per block, ~1/4 the f32 bytes), and the quantization rides
+    BOTH collective phases — an all_to_all reduce-scatter of the quantized
+    payload, a local f32 dequant-sum, then a re-quantized all_gather of
+    the mean shard — so the wire never carries f32. Every device applies
+    the same dequantized mean (params stay replicated); the local quant
+    error AND each device's own mean-shard quant error accumulate into
+    the residual, which the step carry threads to the next step
+    (`carries_state` / `int8_apply_gradients`). Drift vs `pmean` is
+    bounded and pinned by test; with error feedback the quantization bias
+    cancels across steps instead of compounding.
 
-All three run inside a `shard_map` body over the 'dp' axis; `parallel/ddp.py`
+All four run inside a `shard_map` body over the 'dp' axis; `parallel/ddp.py`
 and `train/scan.py` select them via `comm=` / the CLI's `--ddp_comm`, and
-`bench.py --mode ddp` measures all three on the same mesh.
+`bench.py --mode ddp` measures them on the same mesh.
+
+`overlap=True` additionally BUCKET-PIPELINES the pmean/bf16 strategies
+(arXiv:1711.00705's overlap design, the torch-DDP bucket idea): instead of
+one whole-tree collective that cannot start until every gradient leaf
+exists, the leaves are packed into `bucket_elems` buckets and each bucket
+gets its OWN collective whose only data dependency is that bucket's
+gradients — XLA's latency-hiding scheduler is then free to run bucket k's
+collective while bucket j's backward matmuls still execute, instead of
+serializing all comm behind all compute. `sharded` and `int8` are
+bucket-structured by construction, so `overlap=True` composes with them as
+the identity. `pmean` with `overlap=False` stays the UNTOUCHED exact-DDP
+baseline program (the bitwise anchor).
 
 Wire-byte accounting (`bytes_on_wire`) uses the ring-collective cost model:
 per device per step, a ring allreduce of M bytes moves 2*(N-1)/N*M, a
 reduce-scatter or all-gather moves (N-1)/N*M. Under that model `sharded`
 moves the same bytes as `pmean` (RS grads + AG params == allreduce) — its
 win is the 1/N update and HBM traffic, plus near-halved bytes wherever XLA
-lowers small allreduces as all-gather + local reduce — while `bf16` halves
-the wire outright. docs/PERF.md §DDP gradient communication carries the
-worked numbers for the 118,272-param MLP.
+lowers small allreduces as all-gather + local reduce — `bf16` halves the
+wire outright, and `int8` cuts it to (1 + 4/quant_block)/4 of f32 (~25% at
+the default 256 block: 1 byte/element + one f32 scale per block, both
+phases quantized). docs/PERF.md §DDP gradient communication carries the
+worked numbers per model size.
 """
 
 from __future__ import annotations
@@ -52,7 +80,7 @@ import jax.numpy as jnp
 
 from ..ops.sgd import sgd_step, sgd_step_flat
 
-STRATEGIES = ("pmean", "sharded", "bf16")
+STRATEGIES = ("pmean", "sharded", "bf16", "int8")
 
 # Bucket granularity for the sharded-update flatten: leaves are packed
 # greedily into buckets of at most this many elements (16 MiB of f32 —
@@ -60,6 +88,12 @@ STRATEGIES = ("pmean", "sharded", "bf16")
 # The 118k-param MLP packs into ONE bucket; the knob exists so the
 # machinery is general and the multi-bucket path stays testable.
 DEFAULT_BUCKET_ELEMS = 4 * 1024 * 1024
+
+# int8 scaling-block granularity: one f32 scale per this many elements
+# (EQuARX's block scaling — small enough that one outlier gradient can't
+# flatten a whole tensor's resolution, large enough that the scale
+# overhead stays 4/256 ≈ 1.6% of the wire).
+QUANT_BLOCK = 256
 
 
 def validate_comm(comm: str) -> None:
@@ -84,6 +118,41 @@ def validate_bf16_rounding(bf16_rounding: str, comm: str) -> None:
             f"cast; comm={comm!r} never casts — use comm='bf16'")
 
 
+def validate_int8_options(quant_block: "int | None", error_feedback: bool,
+                          comm: str) -> None:
+    """The int8 strategy's knobs, rejected BY NAME on any other strategy
+    rather than silently ignored (the unroll lesson, mirror of
+    `validate_bf16_rounding`): `quant_block` sizes the scaling blocks,
+    `error_feedback` carries the quantization residuals in the step
+    state. `quant_block=None` is the "unset" sentinel every caller
+    resolves to QUANT_BLOCK — valid on every strategy, so retuning
+    QUANT_BLOCK can never make default invocations start failing."""
+    if quant_block is not None and (
+            not isinstance(quant_block, (int, np.integer))
+            or quant_block < 8):
+        raise ValueError(
+            f"quant_block must be an int >= 8 (one f32 scale per block); "
+            f"got {quant_block!r}")
+    if comm != "int8":
+        if quant_block is not None and int(quant_block) != QUANT_BLOCK:
+            raise ValueError(
+                f"quant_block={quant_block} sizes the int8 strategy's "
+                f"scaling blocks; comm={comm!r} never quantizes to int8 — "
+                f"use comm='int8'")
+        if error_feedback is not True:
+            raise ValueError(
+                f"error_feedback={error_feedback!r} carries the int8 "
+                f"strategy's quantization residuals; comm={comm!r} has no "
+                f"quantization error to feed back — use comm='int8'")
+
+
+def carries_state(comm: str, error_feedback: bool = True) -> bool:
+    """Whether the strategy threads per-device error-feedback state through
+    the step carry — the one arity question every caller (step builders,
+    train loops, checkpointing, bench) funnels through."""
+    return comm == "int8" and bool(error_feedback)
+
+
 def _leaf_buckets(leaves, bucket_elems: int):
     """Greedy static partition of leaf INDICES into buckets of at most
     `bucket_elems` elements (a leaf larger than the budget gets its own
@@ -106,8 +175,100 @@ def padded_size(n: int, n_devices: int) -> int:
     return -(-n // n_devices) * n_devices
 
 
+def _leaf_size(leaf) -> int:
+    return int(np.prod(leaf.shape)) if leaf.shape else 1
+
+
+def _count_leaf(n: int) -> np.ndarray:
+    """Shape-only stand-in for a flat leaf of `n` elements — the bucket
+    layout math reads nothing but `.shape`, so a stride-0 broadcast view
+    serves without materializing n floats."""
+    return np.broadcast_to(np.float32(0), (int(n),))
+
+
+def _bucket_layout(leaves, bucket_elems: int, align: int):
+    """[(leaf_indices, n_real, padded)] per bucket: the greedy
+    `_leaf_buckets` partition with each bucket's element count rounded up
+    to a multiple of `align`. Pure host math over static shapes. `align`
+    encodes the strategy's constraint: 1 for the flat pmean/bf16 bucket
+    collectives (no alignment needed), n_devices for the reduce-scatter
+    shards, n_devices*quant_block for int8 (every device's shard must hold
+    whole scaling blocks)."""
+    out = []
+    for bucket in _leaf_buckets(leaves, bucket_elems):
+        n_real = sum(_leaf_size(leaves[i]) for i in bucket)
+        out.append((bucket, n_real, padded_size(n_real, align)))
+    return out
+
+
+def comm_state_elems(params_or_count, n_devices: int, *,
+                     bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                     quant_block: int = QUANT_BLOCK) -> int:
+    """Per-device length of the int8 error-feedback residual vector: the
+    sum of the strategy's padded bucket sizes (each a multiple of
+    n_devices*quant_block). The residual state is a (n_devices, this)
+    float32 array, device-sharded on dim 0."""
+    if isinstance(params_or_count, (int, np.integer)):
+        leaves = [_count_leaf(int(params_or_count))]
+    else:
+        leaves = jax.tree_util.tree_leaves(params_or_count)
+    return sum(padded for (_b, _n, padded) in
+               _bucket_layout(leaves, bucket_elems,
+                              int(n_devices) * int(quant_block)))
+
+
+def comm_state_zeros(params, n_devices: int, *,
+                     bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                     quant_block: int = QUANT_BLOCK) -> np.ndarray:
+    """Host-side zero-initialized error-feedback residual for a fresh run
+    (a resumed run restores the checkpointed one instead)."""
+    return np.zeros((int(n_devices),
+                     comm_state_elems(params, n_devices,
+                                      bucket_elems=bucket_elems,
+                                      quant_block=quant_block)), np.float32)
+
+
+def place_comm_state(mesh, params, host=None, *,
+                     bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                     quant_block: int = QUANT_BLOCK):
+    """Device placement of the residual state: a (n_devices, elems) f32
+    array sharded over the 'dp' axis (each device owns ITS residual — the
+    quantization error is per-device local state, unlike the replicated
+    params). `host=None` starts from zeros; a restored checkpoint passes
+    its saved array (shape-checked by name — a mesh of a different size
+    cannot silently reinterpret another world's residuals)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .mesh import DATA_AXIS
+    n = int(mesh.devices.size)
+    if host is None:
+        if params is None:
+            raise ValueError("place_comm_state needs either a params tree "
+                             "(to size a fresh zero state) or a restored "
+                             "host array")
+        host = comm_state_zeros(params, n, bucket_elems=bucket_elems,
+                                quant_block=quant_block)
+    else:
+        host = np.asarray(host, np.float32)
+        want_shape = (
+            comm_state_zeros(params, n, bucket_elems=bucket_elems,
+                             quant_block=quant_block).shape
+            if params is not None else None)
+        if ((want_shape is not None and host.shape != want_shape)
+                or host.ndim != 2 or host.shape[0] != n):
+            raise ValueError(
+                f"error-feedback state of shape {host.shape} does not fit "
+                f"this run (expected "
+                f"{want_shape or ('(' + str(n) + ', elems)')} for {n} "
+                f"device(s), quant_block={quant_block}) — it was saved "
+                f"under a different mesh size or quantization geometry")
+    s = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.make_array_from_callback(host.shape, s,
+                                        lambda idx, _h=host: _h[idx])
+
+
 def bytes_on_wire(params_or_count, n_devices: int, comm: str, *,
-                  bucket_elems: int = DEFAULT_BUCKET_ELEMS) -> int:
+                  bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                  quant_block: int = QUANT_BLOCK) -> int:
     """Analytic per-device per-step wire bytes under the ring-collective
     cost model (module docstring). `params_or_count` is the params pytree
     (bucket padding is then exact) or a plain element count.
@@ -119,21 +280,28 @@ def bytes_on_wire(params_or_count, n_devices: int, comm: str, *,
         return 0
     if isinstance(params_or_count, (int, np.integer)):
         n_params = int(params_or_count)
-        padded = padded_size(n_params, n)
+        leaves = [_count_leaf(n_params)]
     else:
         leaves = jax.tree_util.tree_leaves(params_or_count)
-        n_params = sum(int(np.prod(l.shape)) if l.shape else 1
-                       for l in leaves)
-        padded = sum(padded_size(
-            sum(int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
-                for i in bucket), n)
-            for bucket in _leaf_buckets(leaves, bucket_elems))
+        n_params = sum(_leaf_size(l) for l in leaves)
     ring = (n - 1) / n
     if comm == "pmean":
         return int(2 * ring * 4 * n_params)        # f32 allreduce
     if comm == "sharded":
         # RS of grads + AG of params, both over the padded buckets.
+        padded = sum(p for (_b, _n, p) in
+                     _bucket_layout(leaves, bucket_elems, n))
         return int(2 * ring * 4 * padded)
+    if comm == "int8":
+        # Both phases carry the quantized format — 1 int8 byte/element +
+        # one f32 scale per quant_block — over the int8-padded buckets:
+        # all_to_all RS moves (N-1)/N of the local payload, the AG of the
+        # re-quantized mean moves (N-1)/N of the same size again.
+        padded = sum(p for (_b, _n, p) in
+                     _bucket_layout(leaves, bucket_elems,
+                                    n * int(quant_block)))
+        payload = padded + 4 * (padded // int(quant_block))
+        return int(2 * ring * payload)
     return int(2 * ring * 2 * n_params)            # bf16 allreduce
 
 
@@ -166,6 +334,152 @@ def bf16_allreduce_mean(grads, axis_name: str, n_devices: int, *,
     reduced = [jax.lax.psum(g, axis_name).astype(jnp.float32) / n_devices
                for g in cast]
     return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def quantize_block_int8(flat: jax.Array, quant_block: int):
+    """Block-scaled int8 quantization of a flat f32 vector whose length is
+    a multiple of `quant_block`: per block, scale = max|x| / 127 (f32) and
+    q = round(x / scale) ∈ [-127, 127]. An all-zero block keeps scale 0
+    (dequantizes to exact zeros). Returns (q int8 (n,), scales f32
+    (n/quant_block,))."""
+    blocks = flat.reshape(-1, quant_block)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / jnp.float32(127.0)
+    safe = jnp.where(scale > 0, scale, jnp.float32(1.0))
+    q = jnp.round(blocks / safe[:, None]).astype(jnp.int8)
+    return q.reshape(-1), scale
+
+
+def dequantize_block_int8(q: jax.Array, scale: jax.Array,
+                          quant_block: int) -> jax.Array:
+    """Inverse of `quantize_block_int8`: q * its block's scale, f32."""
+    return (q.astype(jnp.float32).reshape(-1, quant_block)
+            * scale[:, None]).reshape(-1)
+
+
+def int8_allreduce_mean(flat_g: jax.Array, resid, axis_name: str,
+                        n_devices: int, quant_block: int):
+    """Block-scaled int8 quantized allreduce-mean of ONE padded flat
+    gradient bucket, with optional error feedback. Must run inside a
+    shard_map body over `axis_name`; `flat_g` is this device's local
+    gradient (length a multiple of n_devices*quant_block), `resid` its
+    carried residual slice of the same length (None = error feedback off).
+
+    The quantization rides BOTH phases (the wire never carries f32):
+      1. reduce-scatter via all_to_all of the int8 payload + block scales:
+         each device receives every peer's quantized chunk for ITS shard
+         and dequant-sums them in f32 — it now owns the exact-to-int8 mean
+         of 1/N of the vector;
+      2. the mean shard is RE-quantized (fresh scales) and all_gathered,
+         so every device applies the identical dequantized mean (params
+         stay replicated).
+
+    Error feedback: the local quantization error (g_eff - dequant(q))
+    lands in the residual everywhere, and each device additionally
+    reclaims the phase-2 error of its OWN mean shard, scaled by
+    n_devices — the residual re-enters next step's gradient MEAN, so an
+    owner-held correction is diluted 1/N on the way back and must be
+    pre-amplified for every element's mean-quantization error to be
+    corrected in full by exactly one device.
+    Returns (mean f32, new_resid | None)."""
+    g_eff = flat_g + resid if resid is not None else flat_g
+    q, s = quantize_block_int8(g_eff, quant_block)
+    new_resid = (g_eff - dequantize_block_int8(q, s, quant_block)
+                 if resid is not None else None)
+    if n_devices == 1:
+        # single device: the "mean" IS the dequantized local payload (both
+        # collective phases are the identity; no second quantization)
+        return dequantize_block_int8(q, s, quant_block), new_resid
+    shard = flat_g.size // n_devices
+    blocks_per_shard = shard // quant_block
+    # phase 1: all_to_all reduce-scatter of the quantized payload — row j
+    # of the result is device j's chunk for THIS device's shard
+    qr = jax.lax.all_to_all(q.reshape(n_devices, shard), axis_name,
+                            split_axis=0, concat_axis=0, tiled=True)
+    sr = jax.lax.all_to_all(s.reshape(n_devices, blocks_per_shard),
+                            axis_name, split_axis=0, concat_axis=0,
+                            tiled=True)
+    deq = (qr.astype(jnp.float32).reshape(n_devices, blocks_per_shard,
+                                          quant_block)
+           * sr[:, :, None])
+    mean_shard = deq.sum(axis=0).reshape(-1) / n_devices
+    # phase 2: re-quantize the mean shard and all_gather it
+    qm, sm = quantize_block_int8(mean_shard, quant_block)
+    if new_resid is not None:
+        me = jax.lax.axis_index(axis_name)
+        err = mean_shard - dequantize_block_int8(qm, sm, quant_block)
+        cur = jax.lax.dynamic_slice(new_resid, (me * shard,), (shard,))
+        new_resid = jax.lax.dynamic_update_slice(
+            new_resid, cur + err * n_devices, (me * shard,))
+    qg = jax.lax.all_gather(qm, axis_name, tiled=True)
+    sg = jax.lax.all_gather(sm, axis_name, tiled=True)
+    return dequantize_block_int8(qg, sg, quant_block), new_resid
+
+
+def _bucketized_apply(params, grads, lr: float, axis_name: str, comm: str,
+                      n_devices: int, *, bucket_elems: int,
+                      quant_block: int, resid, rounding_key):
+    """The bucket-pipelined apply shared by `overlap=True` (pmean/bf16)
+    and the always-bucketized int8 strategy: per bucket, one flat
+    collective whose only dependency is that bucket's gradient leaves,
+    then the bucket's SGD update — XLA overlaps bucket k's collective with
+    bucket j's backward (module docstring). Returns
+    (new_params, new_resid | None); `resid` is this device's flat residual
+    vector (int8 error feedback) or None."""
+    p_leaves, treedef = jax.tree_util.tree_flatten(params)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    assert len(p_leaves) == len(g_leaves), "params/grads tree mismatch"
+    align = n_devices * quant_block if comm == "int8" else 1
+    new_leaves: list = [None] * len(p_leaves)
+    resid_parts: list = []
+    off = 0
+    for b, (bucket, n_real, padded) in enumerate(
+            _bucket_layout(p_leaves, bucket_elems, align)):
+        flat_g = jnp.concatenate(
+            [g_leaves[i].reshape(-1).astype(jnp.float32) for i in bucket])
+        if padded > n_real:
+            flat_g = jnp.concatenate(
+                [flat_g, jnp.zeros(padded - n_real, flat_g.dtype)])
+        if comm == "int8":
+            r = resid[off:off + padded] if resid is not None else None
+            mean, new_r = int8_allreduce_mean(flat_g, r, axis_name,
+                                              n_devices, quant_block)
+            if new_r is not None:
+                resid_parts.append(new_r)
+        elif comm == "bf16":
+            if rounding_key is not None:
+                cast = stochastic_round_bf16(
+                    jax.random.fold_in(rounding_key, b), flat_g)
+            else:
+                cast = flat_g.astype(jnp.bfloat16)
+            mean = (jax.lax.psum(cast, axis_name).astype(jnp.float32)
+                    / n_devices)
+        else:  # pmean: the same f32 allreduce-mean, one bucket at a time
+            mean = jax.lax.psum(flat_g, axis_name) / n_devices
+        loff = 0
+        for i in bucket:
+            size = p_leaves[i].size
+            leaf = p_leaves[i].reshape(-1)
+            new_leaves[i] = sgd_step_flat(
+                leaf, mean[loff:loff + size], lr).reshape(p_leaves[i].shape)
+            loff += size
+        off += padded
+    new_resid = jnp.concatenate(resid_parts) if resid_parts else None
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), new_resid
+
+
+def int8_apply_gradients(params, grads, lr: float, axis_name: str,
+                         n_devices: int, *, resid=None,
+                         bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                         quant_block: int = QUANT_BLOCK):
+    """The int8 strategy's entry point — separate from `apply_gradients`
+    because it threads STATE: local per-device `grads` (and this device's
+    flat residual vector, or None with error feedback off) in,
+    (replicated fresh params, new residual | None) out. Runs inside a
+    shard_map body over `axis_name`."""
+    return _bucketized_apply(params, grads, lr, axis_name, "int8",
+                             n_devices, bucket_elems=bucket_elems,
+                             quant_block=quant_block, resid=resid,
+                             rounding_key=None)
 
 
 def sharded_update(params, grads, lr: float, axis_name: str,
@@ -213,14 +527,32 @@ def sharded_update(params, grads, lr: float, axis_name: str,
 def apply_gradients(params, grads, lr: float, axis_name: str, comm: str,
                     n_devices: int, *,
                     rounding_key: jax.Array | None = None,
-                    bucket_elems: int = DEFAULT_BUCKET_ELEMS):
-    """The one entry point: local per-device `grads` in, fresh replicated
-    params out, via the selected communication strategy. Runs inside a
-    shard_map body over `axis_name`."""
+                    bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                    overlap: bool = False):
+    """The stateless entry point: local per-device `grads` in, fresh
+    replicated params out, via the selected communication strategy. Runs
+    inside a shard_map body over `axis_name`. `overlap=True` selects the
+    bucket-pipelined program for pmean/bf16 (one collective per
+    `bucket_elems` bucket instead of a whole-tree barrier); `sharded` is
+    bucket-structured already, so overlap composes as the identity.
+
+    `comm='int8'` threads error-feedback state and therefore has its own
+    entry (`int8_apply_gradients`), rejected here by name."""
     validate_comm(comm)
+    if comm == "int8":
+        raise ValueError(
+            "comm='int8' carries error-feedback residual state through the "
+            "step — use int8_apply_gradients (params, resid in; params', "
+            "resid' out), not the stateless apply_gradients")
     if comm == "sharded":
         return sharded_update(params, grads, lr, axis_name, n_devices,
                               bucket_elems=bucket_elems)
+    if overlap:
+        new_params, _ = _bucketized_apply(
+            params, grads, lr, axis_name, comm, n_devices,
+            bucket_elems=bucket_elems, quant_block=QUANT_BLOCK,
+            resid=None, rounding_key=rounding_key)
+        return new_params
     if comm == "bf16":
         mean = bf16_allreduce_mean(grads, axis_name, n_devices,
                                    rounding_key=rounding_key)
@@ -238,7 +570,9 @@ def apply_gradients(params, grads, lr: float, axis_name: str, comm: str,
 # ---------------------------------------------------------------------------
 
 
-def make_comm_probe(mesh, comm: str):
+def make_comm_probe(mesh, comm: str, *,
+                    quant_block: int = QUANT_BLOCK,
+                    bucket_elems: int = DEFAULT_BUCKET_ELEMS):
     """Jitted (params-shaped tree) -> reduced tree program of the
     strategy's communication pattern over `mesh`'s 'dp' axis."""
     from jax.sharding import PartitionSpec as P
@@ -255,6 +589,15 @@ def make_comm_probe(mesh, comm: str):
             return sharded_update(tree, tree, 0.0, DATA_AXIS, n_dev)
         if comm == "bf16":
             return bf16_allreduce_mean(tree, DATA_AXIS, n_dev)
+        if comm == "int8":
+            # quantize + both quantized phases + dequant (error feedback
+            # off: the residual bookkeeping is elementwise VPU work the
+            # step pays, but the PROBE isolates the wire pattern)
+            new_tree, _ = _bucketized_apply(
+                tree, tree, 0.0, DATA_AXIS, "int8", n_dev,
+                bucket_elems=bucket_elems, quant_block=quant_block,
+                resid=None, rounding_key=None)
+            return new_tree
         return jax.lax.pmean(tree, DATA_AXIS)
 
     sharded_body = shard_map(body, mesh=mesh, in_specs=(P(),),

@@ -66,7 +66,11 @@ def _mesh_axis_size(mesh) -> int:
 
 def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
                     comm: str = "pmean", bf16_rounding: str = "nearest",
-                    health: bool = False):
+                    health: bool = False, overlap: bool = False,
+                    quant_block: int | None = None,
+                    error_feedback: bool = True,
+                    bucket_elems: int | None = None,
+                    model: str = "mlp", param_scale: int = 1):
     """The un-jitted SPMD step program: (params, key, x, y) ->
     (params', key', loss) over `mesh` (a Mesh, or an AbstractMesh for
     client-side export lowering — tests/test_export_lowering.py).
@@ -74,10 +78,26 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
     `comm` selects the gradient-communication strategy
     (parallel/collectives.py): 'pmean' (the reference-semantics baseline —
     full f32 allreduce-mean + replicated update), 'sharded' (bucketized
-    reduce-scatter → 1/N sharded SGD → params all-gather), or 'bf16'
-    (compressed allreduce: bf16 wire + reduction, f32 mean/update).
+    reduce-scatter → 1/N sharded SGD → params all-gather), 'bf16'
+    (compressed allreduce: bf16 wire + reduction, f32 mean/update), or
+    'int8' (block-scaled quantized allreduce with error feedback).
     `bf16_rounding='stochastic'` opts the bf16 cast into unbiased
     stochastic rounding (per-step per-replica keys off the dropout chain).
+
+    `overlap=True` bucket-pipelines the pmean/bf16 collectives (one
+    collective per bucket instead of a whole-tree barrier; sharded/int8
+    are bucketized by construction). pmean with overlap=False stays the
+    UNTOUCHED baseline program — the bitwise anchor.
+
+    `comm='int8'` with `error_feedback=True` (the default) threads the
+    residual state: the program becomes (params, key, resid, x, y) ->
+    (params', key', loss[, aux], resid') with `resid` a
+    (n_devices, comm_state_elems) f32 array sharded over 'dp' (see
+    `collectives.place_comm_state`). `quant_block` sizes the scaling
+    blocks; both knobs are rejected by name off the int8 strategy.
+
+    `model`/`param_scale` select the workload from models/zoo.py
+    (the default is the untouched reference MLP).
 
     `health=True` folds the training-health auxiliary vector
     (`telemetry.health.device_health_aux`: global grad norm, finite flag,
@@ -86,23 +106,31 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
     watchdog's per-step signals ride the existing dispatch and the
     existing once-per-epoch fetch: zero extra host syncs (the invariant
     tests/test_health.py pins). The pmean strategy reports the exact norm
-    of the averaged grads; the sharded/bf16 strategies (which never
-    materialize them) pmean the local sum-of-squares instead — a
+    of the averaged grads; the other strategies (which never materialize
+    the averaged grads) pmean the local sum-of-squares instead — a
     scale-faithful proxy.
     """
     from . import collectives
+    from ..models.zoo import resolve_model
     from ..telemetry.health import device_health_aux
+    quant_block = (collectives.QUANT_BLOCK if quant_block is None
+                   else quant_block)
+    bucket_elems = (collectives.DEFAULT_BUCKET_ELEMS if bucket_elems is None
+                    else bucket_elems)
     collectives.validate_comm(comm)
     collectives.validate_bf16_rounding(bf16_rounding, comm)
+    collectives.validate_int8_options(quant_block, error_feedback, comm)
+    apply_fn = resolve_model(model, param_scale).apply
+    stateful = collectives.carries_state(comm, error_feedback)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     n_dev = _mesh_axis_size(mesh)
 
     def _local(params, x, y, rkey):
-        logits = mlp_apply(params, x.astype(compute_dt), train=True,
-                           dropout_key=rkey)
+        logits = apply_fn(params, x.astype(compute_dt), train=True,
+                          dropout_key=rkey)
         return cross_entropy(logits, y)
 
-    if comm == "pmean":
+    if comm == "pmean" and not overlap:
         def _shard_fn(params, sub, x, y):
             # Mark params device-varying: each replica differentiates its
             # OWN copy, so the cotangent stays local and the allreduce
@@ -117,40 +145,66 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
             loss = jax.lax.pmean(loss, DATA_AXIS)
             return grads, loss
     else:
-        def _shard_fn(params, sub, x, y):
-            # Same local fwd/bwd as the pmean path (pvary note above);
-            # only the grads' trip across the wire — and where the SGD
-            # update runs — changes with the strategy.
-            params = _pvary(params, DATA_AXIS)
-            rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
-            loss, grads = jax.value_and_grad(_local)(params, x, y, rkey)
-            loss = jax.lax.pmean(loss, DATA_AXIS)
+        def _comm_apply(params, grads, rkey, resid_vec):
+            """The selected strategy's (new_params, new_resid|None)."""
+            if comm == "int8":
+                return collectives.int8_apply_gradients(
+                    params, grads, lr, DATA_AXIS, n_dev, resid=resid_vec,
+                    bucket_elems=bucket_elems, quant_block=quant_block)
             # per-step per-replica rounding noise off the dropout chain
             # (distinct per replica so cast errors decorrelate in the sum)
             rnd = (jax.random.fold_in(rkey, 7)
                    if bf16_rounding == "stochastic" else None)
-            new_params = collectives.apply_gradients(
+            return collectives.apply_gradients(
                 params, grads, lr, DATA_AXIS, comm, n_dev,
-                rounding_key=rnd)
+                rounding_key=rnd, bucket_elems=bucket_elems,
+                overlap=overlap), None
+
+        def _shard_fn(params, sub, *rest):
+            # Same local fwd/bwd as the pmean path (pvary note above);
+            # only the grads' trip across the wire — and where the SGD
+            # update runs — changes with the strategy.
+            resid, (x, y) = ((rest[0], rest[1:]) if stateful
+                             else (None, rest))
+            params = _pvary(params, DATA_AXIS)
+            rkey = jax.random.fold_in(sub, jax.lax.axis_index(DATA_AXIS))
+            loss, grads = jax.value_and_grad(_local)(params, x, y, rkey)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            new_params, new_resid = _comm_apply(
+                params, grads, rkey,
+                resid.reshape(-1) if resid is not None else None)
+            out = (new_params, loss)
             if health:
                 # the averaged grads never exist under these strategies;
                 # pmean the local sum-of-squares inside the shard instead
-                aux = device_health_aux(loss, grads, new_params,
-                                        axis_name=DATA_AXIS)
-                return new_params, loss, aux
-            return new_params, loss
+                out += (device_health_aux(loss, grads, new_params,
+                                          axis_name=DATA_AXIS),)
+            if stateful:
+                out += (new_resid.reshape(1, -1),)
+            return out
 
-    # check_vma only on the pmean path: the sharded/bf16 bodies end in
+    # check_vma only on the pmean path: the other bodies end in
     # all_gather/psum programs whose outputs are value-replicated but not
     # provably so to the static replication checker; their cross-strategy
     # parity (and therefore replication) is pinned by test instead.
-    n_out = 3 if (health and comm != "pmean") else 2
+    legacy_pmean = comm == "pmean" and not overlap
+    n_out = 2 + (1 if (health and not legacy_pmean) else 0) \
+        + (1 if stateful else 0)
+    in_specs = [P(), P()]
+    out_specs = [P()] * (n_out - (1 if stateful else 0))
+    if stateful:
+        # the residual is per-DEVICE local state (quantization error of
+        # this device's own gradients), sharded over 'dp' — unlike the
+        # replicated params
+        in_specs.append(P(DATA_AXIS))
+        out_specs.append(P(DATA_AXIS))
+    in_specs += [P(DATA_AXIS), P(DATA_AXIS)]
     sharded = shard_map(
         _shard_fn, mesh=mesh,
-        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=(P(),) * n_out, check_vma=comm == "pmean")
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs), check_vma=legacy_pmean)
 
-    if comm == "pmean":
+    if legacy_pmean:
         def program(params, key, x, y):
             key, sub = jax.random.split(key)
             grads, loss = sharded(params, sub, x, y)
@@ -164,14 +218,18 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
                 return (new_params, key, loss,
                         device_health_aux(loss, grads, new_params))
             return new_params, key, loss
+    elif stateful:
+        def program(params, key, resid, x, y):
+            key, sub = jax.random.split(key)
+            out = sharded(params, sub, resid, x, y)
+            # out = (params', loss[, aux], resid') -> the program's
+            # public ordering keeps loss at index 2 and resid LAST
+            return (out[0], key) + out[1:]
     else:
         def program(params, key, x, y):
             key, sub = jax.random.split(key)
-            if health:
-                new_params, loss, aux = sharded(params, sub, x, y)
-                return new_params, key, loss, aux
-            new_params, loss = sharded(params, sub, x, y)
-            return new_params, key, loss
+            out = sharded(params, sub, x, y)
+            return (out[0], key) + out[1:]
 
     return program
 
@@ -179,33 +237,72 @@ def dp_step_program(mesh, lr: float, *, dtype: str = "float32",
 def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
                        comm: str = "pmean",
                        bf16_rounding: str = "nearest",
-                       health: bool = False):
+                       health: bool = False, overlap: bool = False,
+                       quant_block: int | None = None,
+                       error_feedback: bool = True,
+                       bucket_elems: int | None = None,
+                       model: str = "mlp", param_scale: int = 1):
     """Build the jitted SPMD step: (params, key, x, y) -> (params', key', loss).
 
     x: (global_batch, 784) sharded over 'dp'; params replicated; returned loss
     is the global batch mean (= mean of per-replica means at equal local batch,
     exactly DDP's effective loss). `comm` selects the gradient-communication
-    strategy (see dp_step_program / parallel/collectives.py). `health=True`
-    appends the watchdog's in-program auxiliary vector to the outputs
-    (see dp_step_program).
+    strategy and `overlap` the bucket-pipelined scheduling (see
+    dp_step_program / parallel/collectives.py); `model`/`param_scale` the
+    workload (models/zoo.py). `health=True` appends the watchdog's
+    in-program auxiliary vector to the outputs (see dp_step_program).
+
+    `comm='int8'` with error feedback threads the residual: the step is
+    then (params, key, x, y, resid) -> (params', key', loss[, aux],
+    resid'); `.comm_state` is True and `.place_comm_state(host=None)`
+    builds the device-sharded residual (zeros, or a restored checkpoint's
+    array) — train/loop.py keys off these.
 
     The returned step carries metadata the train loop's telemetry reads:
-    `.ddp_comm` (strategy), `.ddp_mesh`, `.ddp_devices` — the
+    `.ddp_comm` (strategy), `.ddp_mesh`, `.ddp_devices`,
+    `.ddp_quant_block`, `.ddp_bucket_elems`, `.ddp_overlap` — the
     `ddp.bytes_on_wire` / `ddp.collective_s` wiring in train/loop.py keys
     off these without the loop having to know about meshes — and
-    `.health_aux` (whether the step returns the 4th aux output).
+    `.health_aux` (whether the step returns the aux output).
     """
+    from . import collectives
     program = dp_step_program(mesh, lr, dtype=dtype, comm=comm,
-                              bf16_rounding=bf16_rounding, health=health)
-    jitted = jax.jit(program, donate_argnums=(0, 1))
+                              bf16_rounding=bf16_rounding, health=health,
+                              overlap=overlap, quant_block=quant_block,
+                              error_feedback=error_feedback,
+                              bucket_elems=bucket_elems,
+                              model=model, param_scale=param_scale)
+    stateful = collectives.carries_state(comm, error_feedback)
+    qb = collectives.QUANT_BLOCK if quant_block is None else quant_block
+    be = (collectives.DEFAULT_BUCKET_ELEMS if bucket_elems is None
+          else bucket_elems)
+    if stateful:
+        jitted = jax.jit(program, donate_argnums=(0, 1, 2))
 
-    def step(params, key, x, y):
-        return jitted(params, key, x, y)
+        def step(params, key, x, y, resid):
+            return jitted(params, key, resid, x, y)
+
+        def place_comm_state(host=None, params=None):
+            # params only needed for sizing a fresh zero state; restored
+            # states carry their own shape (validated by name)
+            return collectives.place_comm_state(
+                mesh, params, host=host, bucket_elems=be, quant_block=qb)
+
+        step.place_comm_state = place_comm_state
+    else:
+        jitted = jax.jit(program, donate_argnums=(0, 1))
+
+        def step(params, key, x, y):
+            return jitted(params, key, x, y)
 
     step.ddp_comm = comm
     step.ddp_mesh = mesh
     step.ddp_devices = _mesh_axis_size(mesh)
     step.health_aux = health
+    step.comm_state = stateful
+    step.ddp_quant_block = qb
+    step.ddp_bucket_elems = be
+    step.ddp_overlap = overlap
     return step
 
 

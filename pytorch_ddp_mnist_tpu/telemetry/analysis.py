@@ -382,13 +382,71 @@ def analyze(paths: List[str]) -> dict:
 # the regression gate
 # ---------------------------------------------------------------------------
 
+EFFICIENCY_STAT = "scaling_efficiency_vs_1dev"
+
+
+def efficiency_report(artifact: dict, path: str = "<artifact>") -> dict:
+    """A report-shaped dict from a DDP bench artifact (the
+    `MULTICHIP_r0X.json` shape: a `strategies` list of
+    `bench.ddp_strategy_rows` rows). The phases section stays empty —
+    what the artifact carries is per-strategy `scaling_efficiency_vs_1dev`
+    under an `efficiency` key, which `compare` gates exactly like the
+    step-time stats (ROADMAP item 2: efficiency regressions must exit 3
+    like step-time regressions already do). Row labels are
+    `strategy` plus `+overlap` for bucket-pipelined rows, plus
+    `@model xN` for rows measured on a non-default workload and
+    `@Ndev` for the device count (row-level, falling back to the
+    artifact's) — efficiency is only comparable at MATCHED model size
+    AND device count (per-chip efficiency always falls as devices grow),
+    so rows from different `--model`/`--param_scale`/pool-size runs
+    must never gate against each other (legacy artifacts without the
+    workload fields are the default 118k mlp at scale 1)."""
+    eff = {}
+    for row in artifact.get("strategies") or []:
+        if not isinstance(row, dict):
+            continue
+        v = row.get(EFFICIENCY_STAT)
+        if not isinstance(v, (int, float)):
+            continue
+        label = str(row.get("strategy", "?"))
+        if row.get("overlap"):
+            label += "+overlap"
+        model = row.get("model", "mlp")
+        scale = row.get("param_scale", 1)
+        if (model, scale) != ("mlp", 1):
+            label += f"@{model} x{scale}"
+        ndev = row.get("n_devices", artifact.get("n_devices"))
+        if ndev is not None:
+            label += f"@{int(ndev)}dev"
+        eff[label] = float(v)
+    return {
+        "report": "trace_phase_stats", "v": 1,
+        "files": [path], "processes": [], "n_processes": 0,
+        "records": len(eff), "snapshots": 0, "span_errors": [],
+        "phases": {},
+        "epochs": {"count": 0, "mean_s": 0.0, "durations_s": [],
+                   "trend_pct_per_epoch": None},
+        "straggler": {"processes": 0, "epochs_compared": 0,
+                      "max_skew_s": 0.0, "max_skew_pct": 0.0,
+                      "mean_skew_pct": 0.0, "max_start_spread_s": 0.0,
+                      "worst_epoch": None},
+        "efficiency": eff,
+    }
+
+
 def compare(new: dict, baseline: dict, threshold: float = 1.5,
             stats: Tuple[str, ...] = ("p50_s", "p95_s")) -> dict:
     """Diff two reports' phase statistics -> {"rows": [...], "regressions":
     [...]}. A row per (phase, stat) present in both reports; a regression is
     a ratio past `threshold` (new/old > threshold means SLOWER). Tiny
     absolute values are not gated (< 1 ms both sides): at that scale the
-    ratio measures scheduler noise, not the workload."""
+    ratio measures scheduler noise, not the workload.
+
+    Reports carrying an `efficiency` section (DDP bench artifacts via
+    `efficiency_report`) gate scaling efficiency the same way, one row per
+    strategy present in both. Efficiency is better-is-BIGGER, so its ratio
+    is old/new — the same "ratio > threshold means regressed" convention
+    as the time rows (a drop from 0.3 to 0.15 reads as 2.0x)."""
     rows, regressions = [], []
     for phase in sorted(set(new.get("phases", {}))
                         & set(baseline.get("phases", {}))):
@@ -406,6 +464,23 @@ def compare(new: dict, baseline: dict, threshold: float = 1.5,
             rows.append(row)
             if row["regressed"]:
                 regressions.append(row)
+    eff_new = new.get("efficiency") or {}
+    eff_old = baseline.get("efficiency") or {}
+    for label in sorted(set(eff_new) & set(eff_old)):
+        old_v, new_v = eff_old[label], eff_new[label]
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            continue
+        # efficiency DROP reads as >1 (slower); a collapse to <= 0 (the
+        # artifact rounds to 4 decimals, so a dead strategy lands as
+        # exactly 0.0) is the WORST regression, not a skippable row
+        ratio = (old_v / new_v) if new_v > 0 else float("inf")
+        row = {"phase": label, "stat": EFFICIENCY_STAT,
+               "baseline_s": old_v, "new_s": new_v, "ratio": ratio,
+               "regressed": ratio > threshold}
+        rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
     return {"threshold": threshold, "rows": rows, "regressions": regressions}
 
 
@@ -457,8 +532,9 @@ def format_compare(diff: dict) -> str:
              f"on p50/p95):"]
     for row in diff["rows"]:
         verdict = "REGRESSION" if row["regressed"] else "ok"
+        u = "" if row["stat"] == EFFICIENCY_STAT else "s"
         lines.append(f"  {row['phase']:<14} {row['stat']:<6} "
-                     f"{row['baseline_s']:.4f}s -> {row['new_s']:.4f}s  "
+                     f"{row['baseline_s']:.4f}{u} -> {row['new_s']:.4f}{u}  "
                      f"({row['ratio']:.2f}x)  {verdict}")
     if not diff["rows"]:
         lines.append("  (no phase overlaps baseline — nothing gated)")

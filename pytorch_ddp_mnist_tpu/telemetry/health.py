@@ -200,9 +200,24 @@ class Watchdog:
 
     def _stash(self, state, *, epoch: int, offset: int, step: int) -> None:
         import jax
+        # the int8 strategy's error-feedback residual is part of the
+        # resume state (PARITY.md: crash->resume continues the exact
+        # quantization-error accounting), so the rescue carries it too.
+        # It is dp-SHARDED device state: in a multi-host world rank 0
+        # (the only rank that stashes) cannot fetch the other hosts'
+        # shards without a collective, so the stash degrades to
+        # params+key there — a rescue resume reseeds a zero residual,
+        # losing at most one step's quantization error.
+        resid = getattr(state, "resid", None)
+        if resid is not None and getattr(resid, "is_fully_addressable",
+                                         True):
+            resid = np.asarray(resid)
+        else:
+            resid = None
         self._last_good = {
             "params": jax.tree_util.tree_map(np.asarray, state.params),
             "key": np.asarray(jax.random.key_data(state.key)),
+            "resid": resid,
             "epoch": int(epoch), "offset": int(offset), "step": int(step),
         }
 

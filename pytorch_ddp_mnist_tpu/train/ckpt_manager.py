@@ -68,6 +68,11 @@ from .checkpoint import CheckpointError
 _SCHEMA = 1
 _NAME_RE = re.compile(r"^step_(\d{8})\.json$")
 _PAYLOAD_RE = re.compile(r"^step_(\d{8})\.msgpack$")
+_RESID_RE = re.compile(r"^step_(\d{8})\.resid\.msgpack$")
+
+
+def _resid_name(step: int) -> str:
+    return f"step_{step:08d}.resid.msgpack"
 
 
 def _nonfinite_leaves(tree, prefix: str = "") -> List[str]:
@@ -105,7 +110,11 @@ class StepCheckpoint:
     epoch: int               # epoch in progress at save time
     offset: int              # batches already consumed in that epoch
     path: str                # manifest path it came from
-    meta: dict               # caller-stamped run geometry (may be empty):
+    resid: Any = None        # the int8 comm strategy's error-feedback
+                             # residual ((n_devices, elems) f32), when the
+                             # save carried one — None otherwise (every
+                             # pre-int8 manifest restores as None)
+    meta: dict = None        # caller-stamped run geometry (may be empty):
                              # the fields whose change would silently
                              # re-interpret (epoch, offset) — the CLI
                              # stamps global_batch/limit/sampler_rng and
@@ -130,13 +139,20 @@ class CheckpointManager:
 
     def save(self, params, key_data, impl: str, *, step: int, epoch: int,
              offset: int, meta: dict | None = None,
-             pin: bool = False) -> str:
+             pin: bool = False, resid=None) -> str:
         """Commit one step checkpoint; returns the manifest path.
 
         Fetches params to host (this is the one deliberate device sync of a
         checkpoint save). Raises CheckpointError on any I/O failure, with
         the temp file cleaned up and prior checkpoints untouched — a failed
         save never costs existing durability.
+
+        `resid` (the int8 comm strategy's error-feedback residual — a
+        (n_devices, elems) f32 array) rides as a SECOND payload file
+        (`step_N.resid.msgpack`) with its own size/CRC stamp in the
+        manifest, written BEFORE the manifest rename so the commit point
+        covers both payloads: a resumed int8 run continues the unbroken
+        quantization-error accounting instead of reseeding zeros.
 
         `pin=True` marks the checkpoint exempt from keep-last-N rotation
         (the health watchdog's rescue save uses it: a last-known-good
@@ -153,9 +169,13 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         host = jax.tree_util.tree_map(np.asarray, params)
         blob = serialization.to_bytes(host)
+        rblob = (serialization.to_bytes(np.asarray(resid, np.float32))
+                 if resid is not None else None)
         payload = os.path.join(self.directory, _payload_name(step))
+        rpayload = os.path.join(self.directory, _resid_name(step))
         manifest = os.path.join(self.directory, _manifest_name(step))
         tmp = f"{payload}.tmp.{os.getpid()}"
+        rtmp = f"{rpayload}.tmp.{os.getpid()}"
         try:
             faultpoints.fire("ckpt_save", step=step, epoch=epoch)
             with open(tmp, "wb") as f:
@@ -163,6 +183,12 @@ class CheckpointManager:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, payload)
+            if rblob is not None:
+                with open(rtmp, "wb") as f:
+                    f.write(rblob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(rtmp, rpayload)
             record = {
                 "v": _SCHEMA, "step": int(step), "epoch": int(epoch),
                 "offset": int(offset),
@@ -173,6 +199,10 @@ class CheckpointManager:
                 "meta": dict(meta or {}),
                 "t_wall": time.time(),
             }
+            if rblob is not None:
+                record.update(resid_payload=os.path.basename(rpayload),
+                              resid_bytes=len(rblob),
+                              resid_crc32=zlib.crc32(rblob))
             if pin:
                 record["pinned"] = True
             mtmp = f"{manifest}.tmp.{os.getpid()}"
@@ -196,7 +226,7 @@ class CheckpointManager:
             except OSError:
                 pass  # best effort (non-POSIX dir fsync)
         except OSError as e:
-            for stray in (tmp, f"{manifest}.tmp.{os.getpid()}"):
+            for stray in (tmp, rtmp, f"{manifest}.tmp.{os.getpid()}"):
                 try:
                     os.unlink(stray)
                 except OSError:
@@ -207,7 +237,8 @@ class CheckpointManager:
         self._rotate()
         reg = get_registry()
         reg.histogram("checkpoint.save_s").record(time.perf_counter() - t0)
-        reg.counter("checkpoint.bytes").inc(len(blob))
+        reg.counter("checkpoint.bytes").inc(len(blob)
+                                            + (len(rblob) if rblob else 0))
         return manifest
 
     def _pinned(self, steps: List[int]) -> set:
@@ -243,7 +274,8 @@ class CheckpointManager:
         for step in doomed:
             if step in pinned:
                 continue
-            for name in (_manifest_name(step), _payload_name(step)):
+            for name in (_manifest_name(step), _payload_name(step),
+                         _resid_name(step)):
                 try:
                     os.unlink(os.path.join(self.directory, name))
                 except OSError:
@@ -258,7 +290,7 @@ class CheckpointManager:
             if ".tmp." in name:
                 stray = not name.endswith(my_suffix)  # ours may be in flight
             else:
-                m = _PAYLOAD_RE.match(name)
+                m = _PAYLOAD_RE.match(name) or _RESID_RE.match(name)
                 stray = bool(m) and int(m.group(1)) not in live
             if stray:
                 try:
@@ -321,12 +353,42 @@ class CheckpointManager:
             raise CheckpointError(
                 f"{payload}: cannot decode checkpoint ({len(blob)} bytes): "
                 f"{type(e).__name__}: {e}") from e
+        resid = None
+        if rec.get("resid_payload"):
+            # the int8 error-feedback residual: a second payload under the
+            # same intactness contract (size + CRC + decode) — a torn
+            # residual makes the whole checkpoint torn (resuming the
+            # quantization-error accounting from garbage would silently
+            # corrupt gradients, worse than falling back one checkpoint)
+            rpath = os.path.join(self.directory, rec["resid_payload"])
+            try:
+                with open(rpath, "rb") as f:
+                    rblob = f.read()
+            except OSError as e:
+                raise CheckpointError(
+                    f"{rpath}: unreadable residual payload: {e}") from e
+            if len(rblob) != rec.get("resid_bytes"):
+                raise CheckpointError(
+                    f"{rpath}: truncated residual payload ({len(rblob)} "
+                    f"bytes, manifest says {rec.get('resid_bytes')})")
+            if zlib.crc32(rblob) != rec.get("resid_crc32"):
+                raise CheckpointError(
+                    f"{rpath}: residual CRC32 mismatch "
+                    f"({zlib.crc32(rblob):#010x}, manifest says "
+                    f"{rec.get('resid_crc32'):#010x})")
+            try:
+                resid = np.asarray(serialization.msgpack_restore(rblob),
+                                   np.float32)
+            except Exception as e:
+                raise CheckpointError(
+                    f"{rpath}: cannot decode residual payload: "
+                    f"{type(e).__name__}: {e}") from e
         return StepCheckpoint(
             params=params,
             key_data=np.asarray(rec["key"], np.uint32),
             impl=str(rec["impl"]), step=int(rec["step"]),
             epoch=int(rec["epoch"]), offset=int(rec["offset"]),
-            path=manifest, meta=dict(rec.get("meta") or {}))
+            path=manifest, resid=resid, meta=dict(rec.get("meta") or {}))
 
     def restore_latest(self, template) -> StepCheckpoint:
         """Newest INTACT + FINITE checkpoint, falling back past torn,

@@ -54,19 +54,64 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
     t.add_argument("--parallel", action="store_true",
                    help="data-parallel over the device mesh (DDP analog)")
     t.add_argument("--ddp-comm", "--ddp_comm", dest="ddp_comm",
-                   choices=("pmean", "sharded", "bf16"), default="pmean",
+                   choices=("pmean", "sharded", "bf16", "int8"),
+                   default="pmean",
                    help="gradient-communication strategy for --parallel "
                         "(parallel/collectives.py): pmean (default — the "
                         "reference DDP shape: full f32 allreduce-mean + "
                         "replicated SGD update), sharded (bucketized "
                         "reduce-scatter, SGD on each device's 1/N shard, "
                         "params all-gather — 1/N update FLOPs/HBM; parity "
-                        "with pmean to f32 reduction-order tolerance), or "
+                        "with pmean to f32 reduction-order tolerance), "
                         "bf16 (compressed allreduce: bf16 wire bytes AND "
                         "bf16 reduction, f32 mean/update against f32 "
-                        "master params — bounded drift, pinned by test). "
-                        "Telemetry reports "
+                        "master params — bounded drift, pinned by test), "
+                        "or int8 (block-scaled quantized allreduce with "
+                        "error-feedback residuals riding the step state "
+                        "and step checkpoints — ~1/4 the wire bytes, "
+                        "bounded drift, pinned by test; --quant_block / "
+                        "--error_feedback tune it). Telemetry reports "
                         "ddp.bytes_on_wire / ddp.collective_s per strategy")
+    t.add_argument("--overlap", action="store_true",
+                   help="bucket-pipeline the DDP gradient collectives "
+                        "(--parallel): one collective per gradient bucket, "
+                        "launched as soon as that bucket's backward slice "
+                        "exists, instead of one whole-tree barrier at step "
+                        "end — XLA overlaps comm with the remaining "
+                        "backward compute (arXiv:1711.00705). Composes "
+                        "with every --ddp_comm strategy (sharded/int8 are "
+                        "bucketized by construction); plain pmean without "
+                        "it stays the bitwise reference baseline. Needs "
+                        "the XLA kernels (the whole-epoch kernel owns its "
+                        "comms in-kernel)")
+    t.add_argument("--quant_block", type=int, default=None,
+                   help="--ddp_comm int8 only: elements per int8 scaling "
+                        "block (one f32 scale each; default "
+                        "collectives.QUANT_BLOCK = 256 — ~1.6%% scale "
+                        "overhead on the wire). Rejected by name on other "
+                        "strategies")
+    t.add_argument("--error_feedback", choices=("on", "off"), default="on",
+                   help="--ddp_comm int8 only: carry each device's "
+                        "quantization error into the next step's gradients "
+                        "(on, default — the EQuARX residual; rides the "
+                        "step state and step checkpoints) or drop it (off "
+                        "— measures the residual's contribution; drift "
+                        "then compounds). Rejected by name on other "
+                        "strategies")
+    # choices mirror models.zoo.MODELS (kept literal: this layer stays
+    # jax-import-free); zoo.validate_model re-checks at train time
+    t.add_argument("--model", choices=("mlp", "deep_mlp"), default="mlp",
+                   help="model family (models/zoo.py): mlp (default — the "
+                        "reference 784-128-128-10 MLP, bit-for-bit at "
+                        "--param_scale 1) or deep_mlp (4 hidden layers). "
+                        "Non-default models run the XLA kernels (the "
+                        "Pallas kernels hard-code the reference MLP)")
+    t.add_argument("--param_scale", type=int, default=1,
+                   help="hidden-width multiplier for --model (128*N units; "
+                        "params grow ~quadratically — the workload knob "
+                        "that makes gradient-communication costs visible; "
+                        "docs/PERF.md carries the strategy x scale "
+                        "crossover table)")
     t.add_argument("--bf16_rounding", choices=("nearest", "stochastic"),
                    default="nearest",
                    help="--ddp_comm bf16 only: how gradients round into "
@@ -272,7 +317,10 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
         "trainer": {
             "batch_size": a.batch_size, "n_epochs": a.n_epochs, "lr": a.lr,
             "seed": a.seed, "parallel": a.parallel, "ddp_comm": a.ddp_comm,
-            "bf16_rounding": a.bf16_rounding,
+            "bf16_rounding": a.bf16_rounding, "overlap": a.overlap,
+            "quant_block": a.quant_block,
+            "error_feedback": a.error_feedback == "on",
+            "model": a.model, "param_scale": a.param_scale,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
             "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,

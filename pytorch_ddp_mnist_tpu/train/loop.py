@@ -43,17 +43,22 @@ from ..telemetry.events import get_tracer
 
 @dataclass
 class TrainState:
-    """Params + RNG key. SGD is stateless so this is the whole state."""
+    """Params + RNG key (+ the gradient-communication strategy's optional
+    error-feedback residual — `comm='int8'` carries its quantization error
+    here so checkpoints can round-trip it; None everywhere else: SGD
+    itself is stateless)."""
     params: dict
     key: jax.Array
+    resid: object = None
 
 
-def _loss_fn(params, x, y, dropout_key):
-    logits = mlp_apply(params, x, train=True, dropout_key=dropout_key)
+def _loss_fn(params, x, y, dropout_key, apply_fn=mlp_apply):
+    logits = apply_fn(params, x, train=True, dropout_key=dropout_key)
     return cross_entropy(logits, y)
 
 
-def make_train_step(lr: float, *, health: bool = False) -> Callable:
+def make_train_step(lr: float, *, health: bool = False,
+                    apply_fn=mlp_apply) -> Callable:
     """One jitted SGD step: (params, key, x, y) -> (params', key', mean_loss).
 
     The RNG key is split inside the step (traced, so it stays on device); the
@@ -72,7 +77,8 @@ def make_train_step(lr: float, *, health: bool = False) -> Callable:
     @partial(jax.jit, donate_argnums=(0, 1))
     def _step(params, key, x, y):
         key, sub = jax.random.split(key)
-        loss, grads = jax.value_and_grad(_loss_fn)(params, x, y, sub)
+        loss, grads = jax.value_and_grad(_loss_fn)(params, x, y, sub,
+                                                   apply_fn)
         new_params = sgd_step(params, grads, lr)
         if health:
             return (new_params, key, loss,
@@ -139,11 +145,13 @@ def make_torch_dropout_train_step(lr: float, seed: int, *,
     return step
 
 
-def _eval_math(params, x, y):
+def _eval_math(params, x, y, apply_fn=mlp_apply):
     """Per-sample test-set forward: (params, x (n,784), y (n,)) ->
     (per_sample_loss, correct), both (n,) float32. Dropout off, exactly the
-    reference eval pass (ddp_tutorial_multi_gpu.py:101-114)."""
-    logits = mlp_apply(params, x, train=False)
+    reference eval pass (ddp_tutorial_multi_gpu.py:101-114). `apply_fn`
+    follows the selected model (models/zoo.py); default = the reference
+    MLP."""
+    logits = apply_fn(params, x, train=False)
     logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     per_sample = -jnp.take_along_axis(
         logz, y[:, None].astype(jnp.int32), axis=-1)[:, 0]
@@ -151,7 +159,7 @@ def _eval_math(params, x, y):
     return per_sample, correct
 
 
-def make_eval_step() -> Callable:
+def make_eval_step(apply_fn=mlp_apply) -> Callable:
     """Jitted whole-test-set eval: (params, x, y) -> (per_sample_loss,
     correct), both (n,) float32.
 
@@ -163,10 +171,10 @@ def make_eval_step() -> Callable:
     Per-sample values come back so the caller can aggregate in any batch
     segmentation it wants.
     """
-    return jax.jit(_eval_math)
+    return jax.jit(partial(_eval_math, apply_fn=apply_fn))
 
 
-def make_snapshot_eval_step() -> Callable:
+def make_snapshot_eval_step(apply_fn=mlp_apply) -> Callable:
     """Jitted eval over STACKED per-epoch params snapshots: (p_snaps with an
     (E, ...) leading axis on every leaf, x, y) -> (per_sample (E, n),
     correct (E, n)).
@@ -180,7 +188,7 @@ def make_snapshot_eval_step() -> Callable:
     """
     @jax.jit
     def step(p_snaps, x, y):
-        return jax.vmap(lambda p: _eval_math(p, x, y))(p_snaps)
+        return jax.vmap(lambda p: _eval_math(p, x, y, apply_fn))(p_snaps)
 
     return step
 
@@ -255,7 +263,9 @@ def epoch_summary(epoch: int, losses: np.ndarray, batch_size: int,
             f"acc={val_acc:.4f} {imgs / dt:.0f} img/s{io}]")
 
 
-def make_ddp_comm_recorder(mesh, comm: str, n_devices: int, params):
+def make_ddp_comm_recorder(mesh, comm: str, n_devices: int, params,
+                           quant_block: int | None = None,
+                           bucket_elems: int | None = None):
     """Per-epoch recorder for the DDP gradient-communication metrics —
     shared by the streaming `fit` and the epoch-scanned `fit_cached` so the
     two paths can never report different units.
@@ -274,7 +284,13 @@ def make_ddp_comm_recorder(mesh, comm: str, n_devices: int, params):
     from ..telemetry import get_registry
     from ..telemetry.events import NullTracer
 
-    bytes_step = collectives.bytes_on_wire(params, n_devices, comm)
+    quant_block = (collectives.QUANT_BLOCK if quant_block is None
+                   else quant_block)
+    bucket_elems = (collectives.DEFAULT_BUCKET_ELEMS if bucket_elems is None
+                    else bucket_elems)
+    bytes_step = collectives.bytes_on_wire(params, n_devices, comm,
+                                           bucket_elems=bucket_elems,
+                                           quant_block=quant_block)
     reg = get_registry()
     wire = reg.counter("ddp.bytes_on_wire")
     hist = reg.histogram("ddp.collective_s")
@@ -286,7 +302,9 @@ def make_ddp_comm_recorder(mesh, comm: str, n_devices: int, params):
         if isinstance(tracer, NullTracer):
             return
         if "probe" not in box:
-            box["probe"] = collectives.make_comm_probe(mesh, comm)
+            box["probe"] = collectives.make_comm_probe(
+                mesh, comm, quant_block=quant_block,
+                bucket_elems=bucket_elems)
         secs = collectives.measure_collective_seconds(box["probe"], params)
         for s in secs:
             hist.record(s)
@@ -350,12 +368,14 @@ def step_ckpt_positions(nsteps: int, epoch: int, i: int):
 
 
 def _fire_step_hook(step_hook, every: int, nsteps: int, epoch: int, i: int,
-                    params, key) -> None:
+                    params, key, resid=None) -> None:
     """Invoke the step-checkpoint hook when in-epoch step `i` (0-based)
     lands on the cadence (`every` global steps) or closes the epoch.
     `step_hook(epoch', offset', global_step, state)` — positions from
     step_ckpt_positions. One helper for both trainers (cadence drift
-    between them would silently break resume parity expectations)."""
+    between them would silently break resume parity expectations).
+    `resid` (the int8 strategy's error-feedback state) rides the
+    TrainState so step checkpoints can round-trip it."""
     if step_hook is None or not every:
         return
     # cadence is EPOCH-LOCAL (step i+1 a multiple of `every`, plus the
@@ -364,7 +384,8 @@ def _fire_step_hook(step_hook, every: int, nsteps: int, epoch: int, i: int,
     # global steps for any nsteps/every combination
     if (i + 1) % every == 0 or i + 1 >= nsteps:
         ep, off = step_ckpt_positions(nsteps, epoch, i)
-        step_hook(ep, off, epoch * nsteps + i + 1, TrainState(params, key))
+        step_hook(ep, off, epoch * nsteps + i + 1,
+                  TrainState(params, key, resid))
 
 
 def _skip_batches(loader, n: int):
@@ -395,7 +416,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         start_offset: int = 0, ckpt_every_steps: int = 0,
         step_hook: Callable | None = None,
         eval_perm: Callable | None = None,
-        watchdog=None) -> TrainState:
+        watchdog=None, model_apply: Callable | None = None) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -441,12 +462,21 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     if start_offset < 0:
         raise ValueError(f"start_offset={start_offset} must be >= 0")
     step = (train_step if train_step is not None
-            else make_train_step(lr, health=watchdog is not None))
+            else make_train_step(lr, health=watchdog is not None,
+                                 apply_fn=model_apply or mlp_apply))
     # health-enabled steps return a 4th per-step aux output (grad norm /
     # finite flag / param norm) that rides the loss fetch — see
     # telemetry/health.py
     step_health = bool(getattr(step, "health_aux", False))
-    eval_step = make_eval_step()
+    # comm-state steps (the int8 strategy's error feedback) additionally
+    # thread the residual: (params, key, x, y, resid) in, resid' LAST out
+    # — seeded from the TrainState (a resumed checkpoint) or zeros
+    step_comm = bool(getattr(step, "comm_state", False))
+    resid = (step.place_comm_state(
+                 np.asarray(state.resid) if state.resid is not None
+                 else None, state.params)
+             if step_comm else None)
+    eval_step = make_eval_step(model_apply or mlp_apply)
     # Hoist the test set to device ONCE — the reference re-materializes its
     # test tensors per batch per epoch (ddp_tutorial_multi_gpu.py:105-106);
     # repeating jnp.asarray inside the epoch loop would re-upload ~31 MB of
@@ -459,7 +489,9 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     ddp_record = None
     if getattr(step, "ddp_comm", None) is not None:
         ddp_record = make_ddp_comm_recorder(
-            step.ddp_mesh, step.ddp_comm, step.ddp_devices, params)
+            step.ddp_mesh, step.ddp_comm, step.ddp_devices, params,
+            quant_block=getattr(step, "ddp_quant_block", None),
+            bucket_elems=getattr(step, "ddp_bucket_elems", None))
     nsteps = len(train_loader)
     if start_epoch < epochs and start_offset >= nsteps:
         raise ValueError(f"start_offset={start_offset} >= the epoch's "
@@ -497,7 +529,13 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                     break
                 x, y = batch
                 with step_timer:
-                    if step_health:
+                    if step_comm:
+                        out = step(params, key, x, y, resid)
+                        params, key, loss, resid = (out[0], out[1], out[2],
+                                                    out[-1])
+                        if step_health:
+                            aux_list.append(out[3])
+                    elif step_health:
                         params, key, loss, aux = step(params, key, x, y)
                         aux_list.append(aux)
                     else:
@@ -510,7 +548,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                                           epoch=epoch)
                 losses.append(loss)
                 _fire_step_hook(step_hook, ckpt_every_steps, nsteps,
-                                epoch, i, params, key)
+                                epoch, i, params, key, resid=resid)
                 # hook BEFORE the kill fault point: an injected kill at
                 # step K must never race the step-K checkpoint it tests
                 faultpoints.fire("step", step=epoch * nsteps + i + 1,
@@ -534,7 +572,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             dt = time.perf_counter() - t0
             log(epoch_summary(epoch, losses, batch_size, val,
                               dt, io_seconds=io_timer.total))
-            state = TrainState(params, key)
+            state = TrainState(params, key, resid)
             if watchdog is not None:
                 # one observation per epoch, over the already-fetched loss
                 # curve (+ the aux vectors, stacked in the same style — a

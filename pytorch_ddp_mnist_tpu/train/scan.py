@@ -151,12 +151,16 @@ def _check_ring(ring: str, kernel: str, n_dev: int) -> None:
             f"multi-device mesh (got kernel={kernel!r}, {n_dev} device(s))")
 
 
-def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):
+def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool,
+                    apply_fn=None):
     """Per-step fwd+bwd: XLA autodiff or the fused Pallas kernel. 'pallas'
     draws the dropout mask from the same bernoulli stream as 'xla' for the
     same key (bitwise-matched schedule change); 'pallas_rng' draws it inside
     the kernel from the TPU core PRNG, seeded per step from the key — same
-    keep distribution, its own stream (like threefry vs rbg)."""
+    keep distribution, its own stream (like threefry vs rbg). `apply_fn`
+    (models/zoo.py) selects the model on the XLA path; the Pallas kernels
+    hard-code the reference MLP and their callers reject other models by
+    name."""
     if kernel == "pallas_rng":
         if interpret:
             raise ValueError("kernel 'pallas_rng' draws dropout bits with "
@@ -171,21 +175,25 @@ def _loss_and_grads(params, x, y, dropout_key, kernel: str, interpret: bool):
         mask = dropout_mask(dropout_key, x.shape[0])
         return fused_loss_and_grads(params, x, y, mask, interpret=interpret)
 
+    fwd = apply_fn or mlp_apply
+
     def loss_fn(p):
         return cross_entropy(
-            mlp_apply(p, x, train=True, dropout_key=dropout_key), y)
+            fwd(p, x, train=True, dropout_key=dropout_key), y)
 
     return jax.value_and_grad(loss_fn)(params)
 
 
 def make_epoch_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
-                  interpret: bool = False) -> Callable:
+                  interpret: bool = False, model: str = "mlp",
+                  param_scale: int = 1) -> Callable:
     """Serial epoch program: (params, key, x_all, y_all, idx) ->
     (params', key', losses) with idx (nbatches, B).
 
     One epoch is the one-element case of the fused multi-epoch program
     (mirrors make_dp_epoch_fn / make_dp_run_fn)."""
-    run = make_run_fn(lr, dtype=dtype, kernel=kernel, interpret=interpret)
+    run = make_run_fn(lr, dtype=dtype, kernel=kernel, interpret=interpret,
+                      model=model, param_scale=param_scale)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def epoch(params, key, x_all, y_all, idx):
@@ -314,7 +322,8 @@ def _make_epochal_body(x_all, y_all, lr, *, interpret: bool, snapshots: bool,
 
 def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
                 interpret: bool = False, snapshots: bool = False,
-                unroll: int = 1, superstep: int = 1) -> Callable:
+                unroll: int = 1, superstep: int = 1, model: str = "mlp",
+                param_scale: int = 1) -> Callable:
     """Serial analog of make_dp_run_fn: the whole E-epoch run as ONE jitted
     nested-scan program, optionally with per-epoch params snapshots.
 
@@ -327,9 +336,20 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
 
     `superstep` (kernel='pallas_epoch' only; K in {1,2,4,8}): K SGD steps
     per epoch-kernel grid iteration — identical math, amortized
-    per-iteration cost (ops.pallas_step.epoch_fused_sgd)."""
+    per-iteration cost (ops.pallas_step.epoch_fused_sgd).
+
+    `model`/`param_scale` (models/zoo.py) select the workload; non-default
+    models need kernel='xla' (the Pallas kernels hard-code the reference
+    MLP) and are rejected by name."""
+    from ..models.zoo import is_default_model, resolve_model
     _check_kernel(kernel, dtype)
     _check_superstep(superstep, kernel)
+    apply_fn = resolve_model(model, param_scale).apply
+    if not is_default_model(model, param_scale) and kernel != "xla":
+        raise ValueError(
+            f"model={model!r} param_scale={param_scale} needs the XLA scan "
+            f"body; kernel={kernel!r} hard-codes the reference MLP's VMEM "
+            f"block shapes — use kernel='xla'")
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
     def body(carry, batch_idx, x_all, y_all):
@@ -337,7 +357,8 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
         key, sub = jax.random.split(key)
         x = _gathered_x(x_all, batch_idx, compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
-        loss, grads = _loss_and_grads(params, x, y, sub, kernel, interpret)
+        loss, grads = _loss_and_grads(params, x, y, sub, kernel, interpret,
+                                      apply_fn=apply_fn)
         return (sgd_step(params, grads, lr), key), loss
 
     if kernel == "pallas_epoch":
@@ -379,33 +400,52 @@ def make_run_fn(lr: float, *, dtype: str = "float32", kernel: str = "xla",
 
 def _dp_step_body(x_all, y_all, me, lr, compute_dt, kernel="xla",
                   interpret=False, comm="pmean", n_dev=1,
-                  bf16_rounding="nearest"):
+                  bf16_rounding="nearest", overlap=False,
+                  quant_block=None, error_feedback=True,
+                  bucket_elems=None, apply_fn=None):
     """The shared per-step scan body of the DP programs: gather this
     replica's rows, fwd/bwd with a replica-distinct dropout key, then the
     selected gradient-communication strategy (`comm`,
     parallel/collectives.py) — pmean + replicated SGD (the DDP baseline),
-    reduce-scatter + sharded update + all-gather, or bf16-compressed
-    allreduce."""
+    reduce-scatter + sharded update + all-gather, bf16-compressed
+    allreduce, or the int8 error-feedback quantized allreduce (whose
+    residual rides the scan carry as a third element, device-varying).
+    `overlap=True` bucket-pipelines the pmean/bf16 collectives."""
+    from ..parallel import collectives
+    qb = collectives.QUANT_BLOCK if quant_block is None else quant_block
+    be = (collectives.DEFAULT_BUCKET_ELEMS if bucket_elems is None
+          else bucket_elems)
+    stateful = collectives.carries_state(comm, error_feedback)
 
     def body(carry, batch_idx):
-        params, key = carry
+        if stateful:
+            params, key, resid = carry
+        else:
+            params, key = carry
         key, sub = jax.random.split(key)
         rkey = jax.random.fold_in(sub, me)
         x = _gathered_x(x_all, batch_idx, compute_dt)
         y = jnp.take(y_all, batch_idx, axis=0)
-        loss, grads = _loss_and_grads(params, x, y, rkey, kernel, interpret)
+        loss, grads = _loss_and_grads(params, x, y, rkey, kernel, interpret,
+                                      apply_fn=apply_fn)
         loss = jax.lax.pmean(loss, DATA_AXIS)
-        if comm == "pmean":
+        if comm == "pmean" and not overlap:
             grads = jax.lax.pmean(grads, DATA_AXIS)  # the DDP allreduce-mean
             params = sgd_step(params, grads, lr)
+        elif comm == "int8":
+            params, new_r = collectives.int8_apply_gradients(
+                params, grads, lr, DATA_AXIS, n_dev,
+                resid=resid.reshape(-1) if stateful else None,
+                bucket_elems=be, quant_block=qb)
+            if stateful:
+                resid = new_r.reshape(resid.shape)
         else:
-            from ..parallel import collectives
             rnd = (jax.random.fold_in(rkey, 7)
                    if bf16_rounding == "stochastic" else None)
             params = collectives.apply_gradients(
                 params, grads, lr, DATA_AXIS, comm, n_dev,
-                rounding_key=rnd)
-        return (params, key), loss
+                rounding_key=rnd, bucket_elems=be, overlap=overlap)
+        return ((params, key, resid) if stateful else (params, key)), loss
 
     return body
 
@@ -413,7 +453,11 @@ def _dp_step_body(x_all, y_all, me, lr, compute_dt, kernel="xla",
 def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                      kernel: str = "xla", interpret: bool = False,
                      comm: str = "pmean",
-                     bf16_rounding: str = "nearest") -> Callable:
+                     bf16_rounding: str = "nearest",
+                     overlap: bool = False, quant_block: int | None = None,
+                     error_feedback: bool = True,
+                     bucket_elems: int | None = None,
+                     model: str = "mlp", param_scale: int = 1) -> Callable:
     """SPMD epoch program over the 'dp' mesh.
 
     x_all/y_all replicated (each device holds the dataset and gathers its own
@@ -422,18 +466,45 @@ def make_dp_epoch_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     like parallel.ddp.make_dp_train_step. Dropout keys fold in the replica
     index (independent masks per replica, SURVEY.md §7 item 4).
 
+    Comm-state strategies (int8 with error feedback) make the epoch
+    (params, key, x_all, y_all, idx, resid) -> (params', key', losses,
+    resid'); `.comm_state` on the returned fn says which arity applies.
+
     One epoch is the one-element case of the fused multi-epoch program
     (tests prove the equivalence), so this just wraps make_dp_run_fn.
     """
+    from ..parallel import collectives
     run = make_dp_run_fn(mesh, lr, dtype=dtype, kernel=kernel,
                          interpret=interpret, comm=comm,
-                         bf16_rounding=bf16_rounding)
+                         bf16_rounding=bf16_rounding, overlap=overlap,
+                         quant_block=quant_block,
+                         error_feedback=error_feedback,
+                         bucket_elems=bucket_elems,
+                         model=model, param_scale=param_scale)
+    if collectives.carries_state(comm, error_feedback):
+        jitted_ef = jax.jit(
+            lambda params, key, x_all, y_all, idx, resid:
+                run(params, key, x_all, y_all, idx[None], resid),
+            donate_argnums=(0, 1, 5))
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+        def epoch_ef(params, key, x_all, y_all, idx, resid):
+            params, key, losses, resid = jitted_ef(params, key, x_all,
+                                                   y_all, idx, resid)
+            return params, key, losses[0], resid
+
+        epoch_ef.comm_state = True
+        return epoch_ef
+
+    jitted = jax.jit(
+        lambda params, key, x_all, y_all, idx:
+            run(params, key, x_all, y_all, idx[None]),
+        donate_argnums=(0, 1))
+
     def epoch(params, key, x_all, y_all, idx):
-        params, key, losses = run(params, key, x_all, y_all, idx[None])
+        params, key, losses = jitted(params, key, x_all, y_all, idx)
         return params, key, losses[0]
 
+    epoch.comm_state = False
     return epoch
 
 
@@ -442,7 +513,11 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
                    snapshots: bool = False, unroll: int = 1,
                    superstep: int = 1, ring: str = "auto",
                    comm: str = "pmean",
-                   bf16_rounding: str = "nearest") -> Callable:
+                   bf16_rounding: str = "nearest",
+                   overlap: bool = False, quant_block: int | None = None,
+                   error_feedback: bool = True,
+                   bucket_elems: int | None = None,
+                   model: str = "mlp", param_scale: int = 1) -> Callable:
     """Multi-epoch fused DP program: (params, key, x_all, y_all, idxs) ->
     (params', key', losses (E, nbatches)) with idxs (E, nbatches, global_B)
     sharded on the batch dim.
@@ -465,10 +540,23 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     budget switch); see ops.pallas_step.epoch_fused_sgd.
 
     `comm` selects the per-step gradient communication
-    (parallel/collectives.py: 'pmean' / 'sharded' / 'bf16') for the
-    scan-body kernels; kernel='pallas_epoch' owns its comms in-kernel (the
-    ICI ring) and rejects a non-default comm by name.
+    (parallel/collectives.py: 'pmean' / 'sharded' / 'bf16' / 'int8') for
+    the scan-body kernels, `overlap` the bucket-pipelined scheduling;
+    kernel='pallas_epoch' owns its comms in-kernel (the ICI ring) and
+    rejects a non-default comm (and overlap) by name.
+
+    Comm-state strategies (int8 with error feedback) change the
+    signature: (params, key, x_all, y_all, idxs, resid) -> (params', key',
+    losses, resid'[, snaps]) — losses stay at index 2, the residual rides
+    right behind them, snapshots (which do NOT include per-epoch residual
+    copies — a fused-run epoch checkpoint resumes with a zero residual,
+    bounded drift) stay last. `.comm_state` on the returned fn says which
+    arity applies. `model`/`param_scale` select the workload
+    (models/zoo.py); non-default models need the XLA scan body (the
+    Pallas kernels hard-code the reference MLP) and are rejected by name
+    elsewhere.
     """
+    from ..models.zoo import is_default_model, resolve_model
     from ..parallel import collectives
     from ..parallel.ddp import _mesh_axis_size
     _check_kernel(kernel, dtype)
@@ -477,11 +565,26 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
     _check_ring(ring, kernel, n_dev)
     collectives.validate_comm(comm)
     collectives.validate_bf16_rounding(bf16_rounding, comm)
+    collectives.validate_int8_options(
+        collectives.QUANT_BLOCK if quant_block is None else quant_block,
+        error_feedback, comm)
+    apply_fn = resolve_model(model, param_scale).apply
+    if not is_default_model(model, param_scale) and kernel != "xla":
+        raise ValueError(
+            f"model={model!r} param_scale={param_scale} needs the XLA scan "
+            f"body; kernel={kernel!r} hard-codes the reference MLP's VMEM "
+            f"block shapes — use kernel='xla'")
     if comm != "pmean" and kernel == "pallas_epoch":
         raise ValueError(
             f"comm={comm!r} selects the per-step XLA gradient collective; "
             f"kernel 'pallas_epoch' performs its allreduce IN-kernel (the "
             f"ICI ring — pick it with ring=) and never reads comm")
+    if overlap and kernel == "pallas_epoch":
+        raise ValueError(
+            "overlap=True bucket-pipelines the per-step XLA gradient "
+            "collectives; kernel 'pallas_epoch' owns its comms IN-kernel "
+            "and never reads it")
+    stateful = collectives.carries_state(comm, error_feedback)
     compute_dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     use_pallas = kernel.startswith("pallas")
 
@@ -540,7 +643,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
 
         return run_ep
 
-    def shard_fn(params, key, x_all, y_all, idxs):
+    def shard_fn(params, key, x_all, y_all, idxs, resid=None):
         if not use_pallas:
             # Differentiate per-replica copies so the allreduce in the body
             # is the only grad reduction (see parallel/ddp.py). The pallas
@@ -551,45 +654,72 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
         body = _dp_step_body(x_all, y_all, me, lr, compute_dt,
                              kernel=kernel, interpret=interpret,
                              comm=comm, n_dev=n_dev,
-                             bf16_rounding=bf16_rounding)
+                             bf16_rounding=bf16_rounding, overlap=overlap,
+                             quant_block=quant_block,
+                             error_feedback=error_feedback,
+                             bucket_elems=bucket_elems, apply_fn=apply_fn)
 
         def epoch(carry, idx_e):
             carry, losses = jax.lax.scan(body, carry, idx_e, unroll=unroll)
-            out = (losses, carry) if snapshots else losses
+            if snapshots:
+                # snapshots stay (params, key) pairs in BOTH arities: the
+                # residual is comm state, not trajectory state (docstring)
+                out = (losses, carry[:2])
+            else:
+                out = losses
             return carry, out
 
-        (params, key), out = jax.lax.scan(epoch, (params, key), idxs)
-        if comm == "pmean":
+        carry0 = (params, key, resid) if stateful else (params, key)
+        carry, out = jax.lax.scan(epoch, carry0, idxs)
+        params, key = carry[:2]
+        if comm == "pmean" and not overlap:
             # per-replica lockstep copies: pmean re-replicates for output.
-            # The sharded/bf16 strategies end each step in an
-            # all-gather/psum whose outputs are already value-identical on
-            # every device — a further pmean would only add a run-final
-            # collective for nothing.
+            # The other strategies end each step in an all-gather/psum
+            # whose outputs are already value-identical on every device —
+            # a further pmean would only add a run-final collective for
+            # nothing.
             params = jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, DATA_AXIS), params)
+        tail = (carry[2],) if stateful else ()
         if snapshots:
             losses, (p_snaps, k_snaps) = out
             # params snapshots are per-replica copies kept in lockstep by the
             # in-body allreduce: pmean re-replicates them for output. The key
             # evolves identically on every replica (pure split chain) and is
             # not a float — no reduction, it is already replicated.
-            if comm == "pmean":
+            if comm == "pmean" and not overlap:
                 p_snaps = jax.tree_util.tree_map(
                     lambda a: jax.lax.pmean(a, DATA_AXIS), p_snaps)
-            return params, key, losses, (p_snaps, k_snaps)
-        return params, key, out
+            return (params, key, losses) + tail + ((p_snaps, k_snaps),)
+        return (params, key, out) + tail
 
-    nout = 4 if snapshots else 3
+    nout = 3 + (1 if snapshots else 0) + (1 if stateful else 0)
+    in_specs = [P(), P(), P(), P(), P(None, None, DATA_AXIS)]
+    out_specs = [P()] * nout
+    if stateful:
+        in_specs.append(P(DATA_AXIS))       # resid: per-device local state
+        out_specs[3] = P(DATA_AXIS)         # (params, key, losses, resid..)
     sharded = shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(None, None, DATA_AXIS)),
-        out_specs=(P(),) * nout,
-        check_vma=not use_pallas and comm == "pmean")
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
+        check_vma=not use_pallas and comm == "pmean" and not overlap)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    if stateful:
+        jitted_ef = jax.jit(sharded, donate_argnums=(0, 1, 5))
+
+        def run_ef(params, key, x_all, y_all, idxs, resid):
+            return jitted_ef(params, key, x_all, y_all, idxs, resid)
+
+        run_ef.comm_state = True
+        return run_ef
+
+    jitted = jax.jit(sharded, donate_argnums=(0, 1))
+
     def run(params, key, x_all, y_all, idxs):
-        return sharded(params, key, x_all, y_all, idxs)
+        return jitted(params, key, x_all, y_all, idxs)
 
+    run.comm_state = False
     return run
 
 
@@ -599,6 +729,9 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                kernel: str = "xla", interpret: bool = False,
                fused: bool = False, comm: str = "pmean",
                bf16_rounding: str = "nearest",
+               overlap: bool = False, quant_block: int | None = None,
+               error_feedback: bool = True,
+               model: str = "mlp", param_scale: int = 1,
                log: Callable[[str], None] = print,
                epoch_hook: Callable | None = None,
                start_epoch: int = 0, start_offset: int = 0,
@@ -649,8 +782,16 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     """
     import time
 
+    from ..models.zoo import resolve_model
+    from ..parallel import collectives
     from ..utils import faultpoints
 
+    model_apply = resolve_model(model, param_scale).apply
+    # int8-with-error-feedback threads the residual state through every
+    # program call (and into the TrainState the hooks/watchdog see, so
+    # step checkpoints round-trip it)
+    stateful = (mesh is not None
+                and collectives.carries_state(comm, error_feedback))
     if not 0 <= start_epoch <= epochs:
         raise ValueError(f"start_epoch={start_epoch} outside [0, {epochs}]")
     if start_offset < 0:
@@ -682,30 +823,41 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         y_all = replicate_state(mesh, np.asarray(y_train, np.int32))
         epoch_fn = None if fused else make_dp_epoch_fn(
             mesh, lr, dtype=dtype, kernel=kernel, interpret=interpret,
-            comm=comm, bf16_rounding=bf16_rounding)
+            comm=comm, bf16_rounding=bf16_rounding, overlap=overlap,
+            quant_block=quant_block, error_feedback=error_feedback,
+            model=model, param_scale=param_scale)
         idx_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     else:
         x_all = jax.device_put(resident_images(x_train))
         y_all = jax.device_put(np.asarray(y_train, np.int32))
         epoch_fn = None if fused else make_epoch_fn(
-            lr, dtype=dtype, kernel=kernel, interpret=interpret)
+            lr, dtype=dtype, kernel=kernel, interpret=interpret,
+            model=model, param_scale=param_scale)
         idx_sharding = None
 
     # Test set to device once, not per epoch (mirrors loop.fit's hoist).
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
+    resid = (collectives.place_comm_state(
+                 mesh, params,
+                 host=(np.asarray(state.resid)
+                       if state.resid is not None else None),
+                 quant_block=(collectives.QUANT_BLOCK if quant_block is None
+                              else quant_block))
+             if stateful else None)
     # DP runs publish the ddp.* comm metrics (same recorder as loop.fit) —
     # except kernel='pallas_epoch', whose allreduce happens IN-kernel via
     # its own ring strategy: the recorder's ring-model bytes and XLA-pmean
     # probe would attribute a collective that program never runs.
     ddp_record = (make_ddp_comm_recorder(mesh, comm,
-                                         int(mesh.devices.size), params)
+                                         int(mesh.devices.size), params,
+                                         quant_block=quant_block)
                   if mesh is not None and kernel != "pallas_epoch"
                   else None)
 
     if fused:
         if epochs <= start_epoch:  # match the per-epoch loop's no-op
-            return TrainState(params, key)
+            return TrainState(params, key, resid)
         # ONE program for the whole run (zero host round-trips inside),
         # then replay the per-epoch reporting from the snapshots.
         run_epochs = list(range(start_epoch, epochs))
@@ -717,16 +869,24 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         if mesh is not None:
             run = make_dp_run_fn(mesh, lr, dtype=dtype, kernel=kernel,
                                  interpret=interpret, snapshots=True,
-                                 comm=comm, bf16_rounding=bf16_rounding)
+                                 comm=comm, bf16_rounding=bf16_rounding,
+                                 overlap=overlap, quant_block=quant_block,
+                                 error_feedback=error_feedback,
+                                 model=model, param_scale=param_scale)
             sh3 = NamedSharding(mesh, P(None, None, DATA_AXIS))
             idxs = jax.make_array_from_callback(
                 idxs.shape, sh3, lambda s, _i=idxs: _i[s])
         else:
             run = make_run_fn(lr, dtype=dtype, kernel=kernel,
-                              interpret=interpret, snapshots=True)
+                              interpret=interpret, snapshots=True,
+                              model=model, param_scale=param_scale)
         t0 = time.perf_counter()
-        params, key, losses, (p_snaps, k_snaps) = run(
-            params, key, x_all, y_all, idxs)
+        if stateful:
+            params, key, losses, resid, (p_snaps, k_snaps) = run(
+                params, key, x_all, y_all, idxs, resid)
+        else:
+            params, key, losses, (p_snaps, k_snaps) = run(
+                params, key, x_all, y_all, idxs)
         losses = np.asarray(losses)                      # sync: run finished
         per_epoch_dt = (time.perf_counter() - t0) / len(run_epochs)
         # one span for the whole fused program — there is no per-epoch
@@ -739,7 +899,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         # Replay ALL epochs' val lines from one vmapped eval program + one
         # fetch — per-epoch evaluate() calls here would cost E dispatch
         # round-trips (a full tunnel RTT each on a remote TPU).
-        ps_all, corr_all = make_snapshot_eval_step()(
+        ps_all, corr_all = make_snapshot_eval_step(model_apply)(
             p_snaps, x_test_dev, y_test_dev)
         ps_all, corr_all = np.asarray(ps_all), np.asarray(corr_all)
         for i, epoch in enumerate(run_epochs):
@@ -751,12 +911,14 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             if epoch_hook is not None:
                 # faithful TrainState: this epoch's params AND RNG key, so a
                 # hook that checkpoints state resumes the same trajectory as
-                # a non-fused run would.
+                # a non-fused run would. (No per-epoch residual snapshots:
+                # an int8 run resumed from such a checkpoint reseeds a zero
+                # residual — bounded drift, documented on make_dp_run_fn.)
                 epoch_hook(epoch, TrainState(p_e, k_snaps[i]))
-        return TrainState(params, key)
+        return TrainState(params, key, resid)
 
     tracer = get_tracer()
-    eval_step = make_eval_step()
+    eval_step = make_eval_step(model_apply)
     for epoch in range(start_epoch, epochs):
         with tracer.span("epoch", epoch=epoch):
             t0 = time.perf_counter()
@@ -785,8 +947,12 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 if idx_sharding is not None:
                     part = jax.make_array_from_callback(
                         part.shape, idx_sharding, lambda s, _i=part: _i[s])
-                params, key, part_losses = epoch_fn(params, key,
-                                                    x_all, y_all, part)
+                if stateful:
+                    params, key, part_losses, resid = epoch_fn(
+                        params, key, x_all, y_all, part, resid)
+                else:
+                    params, key, part_losses = epoch_fn(params, key,
+                                                        x_all, y_all, part)
                 part_np = np.asarray(part_losses)           # chunk sync
                 # the nan value-fault point, chunk form: poisons only the
                 # fetched loss curve (params untouched) — the watchdog's
@@ -796,7 +962,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                     epoch=epoch)
                 loss_parts.append(part_np)
                 _fire_step_hook(step_hook, ckpt_every_steps, nb, epoch,
-                                c1 - 1, params, key)
+                                c1 - 1, params, key, resid=resid)
                 # hook BEFORE the kill point: an injected kill at step K
                 # must never race the step-K checkpoint it tests
                 faultpoints.fire("step", step=epoch * nb + c1, epoch=epoch)
@@ -808,7 +974,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                     # raise TrainingHealthError under the abort policy.
                     ck_ep, ck_off = step_ckpt_positions(nb, epoch, c1 - 1)
                     watchdog.observe(
-                        part_np, state=TrainState(params, key), epoch=epoch,
+                        part_np, state=TrainState(params, key, resid),
+                        epoch=epoch,
                         step=epoch * nb + c1,
                         ckpt_epoch=ck_ep, ckpt_offset=ck_off,
                         dt_s=time.perf_counter() - t_chunk,
@@ -830,7 +997,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 ddp_record(int(losses.size), params)
             log(epoch_summary(epoch, losses, batch_size, val,
                               time.perf_counter() - t0))
-            state = TrainState(params, key)
+            state = TrainState(params, key, resid)
             if epoch_hook is not None:
                 epoch_hook(epoch, state)
     return state

@@ -94,6 +94,27 @@ VARIANTS = [
      ["--mode", "ddp", "--ddp_comm", "sharded"]),
     ("DDP comms / bf16 compressed allreduce",
      ["--mode", "ddp", "--ddp_comm", "bf16"]),
+    # Round 12: the int8 error-feedback quantized allreduce and the
+    # bucket-pipelined overlap variant, plus the MODEL-SIZE axis (ROADMAP
+    # item 2): at param_scale 1 the 118k-param MLP is dispatch-bound and
+    # every comm saving is noise — the scale-8 rows (1.9M params, ~7.4 MB
+    # f32 gradient) are where the strategies separate and the crossover
+    # lives (docs/PERF.md §strategy × model-size crossover).
+    ("DDP comms / int8 error-feedback quantized allreduce",
+     ["--mode", "ddp", "--ddp_comm", "int8"]),
+    ("DDP comms / pmean + bucket-pipelined overlap",
+     ["--mode", "ddp", "--ddp_comm", "pmean", "--overlap"]),
+    ("DDP comms @ mlp x8 / pmean baseline",
+     ["--mode", "ddp", "--ddp_comm", "pmean", "--param_scale", "8"]),
+    ("DDP comms @ mlp x8 / sharded update",
+     ["--mode", "ddp", "--ddp_comm", "sharded", "--param_scale", "8"]),
+    ("DDP comms @ mlp x8 / bf16 compressed",
+     ["--mode", "ddp", "--ddp_comm", "bf16", "--param_scale", "8"]),
+    ("DDP comms @ mlp x8 / int8 error-feedback quantized",
+     ["--mode", "ddp", "--ddp_comm", "int8", "--param_scale", "8"]),
+    ("DDP comms @ mlp x8 / int8 + overlap",
+     ["--mode", "ddp", "--ddp_comm", "int8", "--overlap",
+      "--param_scale", "8"]),
 ]
 
 # Single source of truth for the roofline math: bench.perf_fields — the

@@ -49,6 +49,21 @@ def main(argv=None) -> int:
                    help="fused epochs per strategy timing window")
     p.add_argument("--batch_size", type=int, default=16,
                    help="per-chip batch for the strategy rows")
+    p.add_argument("--model", choices=("mlp", "deep_mlp"), default="mlp",
+                   help="model family for the strategy rows "
+                        "(models/zoo.py)")
+    p.add_argument("--param_scale", type=int, default=1,
+                   help="hidden-width multiplier for the strategy rows — "
+                        "the model-size axis (at 1 the 118k-param MLP is "
+                        "dispatch-bound and comm strategies are noise; "
+                        "ISSUE 7's acceptance measures >= 8)")
+    p.add_argument("--overlap_rows", action="store_true",
+                   help="additionally measure every strategy's "
+                        "bucket-pipelined (overlap=True) variant — doubles "
+                        "the row count")
+    p.add_argument("--n_rows", type=int, default=2048,
+                   help="synthetic training rows per epoch window (large "
+                        "models amortize comm over this many images)")
     p.add_argument("--skip_rows", action="store_true",
                    help="dry run only — record the old smoke-bit keys with "
                         "an empty strategies list (a backendless window)")
@@ -118,7 +133,15 @@ def main(argv=None) -> int:
             from bench import ddp_strategy_rows
             rows = ddp_strategy_rows(per_chip_batch=a.batch_size,
                                      epochs=a.epochs,
-                                     n_devices=a.n_devices)
+                                     n_devices=a.n_devices,
+                                     n_rows=a.n_rows,
+                                     model=a.model,
+                                     param_scale=a.param_scale,
+                                     overlap_variants=(
+                                         (False, True) if a.overlap_rows
+                                         else (False,)))
+            artifact["model"] = a.model
+            artifact["param_scale"] = a.param_scale
         except Exception as e:  # noqa: BLE001 — recorded, not raised
             print(f"multichip_smoke: strategy rows failed: {e}",
                   file=sys.stderr)

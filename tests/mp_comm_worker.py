@@ -3,8 +3,10 @@
 
 The mp_worker.py shape (one jax.distributed process per rank, env wireup,
 SPMD DP training over the cross-process mesh), parameterized by the
-gradient-communication strategy: `--comm pmean|sharded|bf16` selects the
-parallel/collectives.py program inside make_dp_train_step. After
+gradient-communication strategy: `--comm pmean|sharded|bf16|int8` selects
+the parallel/collectives.py program inside make_dp_train_step (`--overlap`
+adds the bucket-pipelined form; int8 threads its error-feedback residual
+through the step, zero-seeded here). After
 HPARAMS["steps"] steps every rank prints one JSON line (losses + checksum)
 and, when `--save PATH` is given, rank 0 writes the final params to
 PATH (.npz, one array per leaf in tree order) so the parent can compare
@@ -24,8 +26,10 @@ HPARAMS = dict(n=1024, local_batch=32, steps=3, lr=0.05,
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--comm", choices=("pmean", "sharded", "bf16"),
+    p.add_argument("--comm", choices=("pmean", "sharded", "bf16", "int8"),
                    required=True)
+    p.add_argument("--overlap", action="store_true",
+                   help="bucket-pipelined collectives (overlap=True)")
     p.add_argument("--save", default=None,
                    help="rank 0: write final params here (.npz)")
     a = p.parse_args()
@@ -57,10 +61,12 @@ def main() -> int:
     sampler.set_epoch(0)
     shard = sampler.indices()
 
-    step = make_dp_train_step(mesh, lr=lr, comm=a.comm)
+    step = make_dp_train_step(mesh, lr=lr, comm=a.comm, overlap=a.overlap)
     params = replicate_state(mesh,
                              init_mlp(jax.random.key(HPARAMS["param_seed"])))
     key = replicate_state(mesh, jax.random.key(HPARAMS["key_seed"]))
+    resid = (step.place_comm_state(None, params) if step.comm_state
+             else None)
 
     losses = []
     for s in range(steps):
@@ -68,7 +74,10 @@ def main() -> int:
         assert len(rows) == local_batch, \
             f"shard exhausted at step {s}: raise HPARAMS['n']"
         gx, gy = global_batch_from_local(mesh, (x_all[rows], y_all[rows]))
-        params, key, loss = step(params, key, gx, gy)
+        if step.comm_state:
+            params, key, loss, resid = step(params, key, gx, gy, resid)
+        else:
+            params, key, loss = step(params, key, gx, gy)
         losses.append(float(loss))
 
     # Params are replicated on every strategy's output (pmean by out_specs,

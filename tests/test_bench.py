@@ -84,10 +84,11 @@ def test_serve_mode_contract():
 
 def test_ddp_mode_contract_8_fake_devices():
     """The PR acceptance as a test: `--mode ddp` on 8 fake CPU devices
-    emits ONE artifact line per strategy (pmean, sharded, bf16), each with
-    non-null images_per_sec and scaling_efficiency_vs_1dev; the pmean row
-    pins zero parity drift against itself, the sharded row stays within
-    rtol 1e-6 of pmean."""
+    emits ONE artifact line per strategy (pmean, sharded, bf16, int8),
+    each with non-null images_per_sec and scaling_efficiency_vs_1dev; the
+    pmean row pins zero parity drift against itself, the sharded row stays
+    within rtol 1e-6 of pmean, bf16/int8 within their bounded-drift
+    envelopes."""
     env = dict(ENV, XLA_FLAGS="--xla_force_host_platform_device_count=8")
     out = subprocess.run(
         [sys.executable, "bench.py", "--mode", "ddp", "--epochs", "2",
@@ -96,7 +97,8 @@ def test_ddp_mode_contract_8_fake_devices():
     assert out.returncode == 0, out.stderr[-2000:]
     recs = [json.loads(ln) for ln in out.stdout.splitlines()
             if ln.startswith("{")]
-    assert [r["strategy"] for r in recs] == ["pmean", "sharded", "bf16"]
+    assert [r["strategy"] for r in recs] == ["pmean", "sharded", "bf16",
+                                            "int8"]
     by = {r["strategy"]: r for r in recs}
     for r in recs:
         assert r["metric"] == "mnist_ddp_train_images_per_sec_per_chip"
@@ -111,6 +113,11 @@ def test_ddp_mode_contract_8_fake_devices():
     # the compressed wire is half the f32 wire, exactly
     assert (by["bf16"]["bytes_on_wire_per_step_per_device"] * 2
             == by["pmean"]["bytes_on_wire_per_step_per_device"])
+    # int8: ~quarter of f32 (1 byte/elem + block scales + device*block pad)
+    assert (by["int8"]["bytes_on_wire_per_step_per_device"]
+            < 0.27 * by["pmean"]["bytes_on_wire_per_step_per_device"])
+    assert 0 < by["int8"]["parity_max_abs_diff_vs_pmean"] < 1e-3
+    assert not any(r["overlap"] for r in recs)
 
 
 def test_ddp_comm_knob_rejected_outside_ddp_mode():

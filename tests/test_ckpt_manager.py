@@ -220,9 +220,17 @@ def test_geometry_meta_roundtrips_and_cli_refuses_mismatch(tmp_path):
                         "--ckpt_every_steps", "3"]) == 0
     mgr = CheckpointManager(str(tmp_path / "m.msgpack.steps"))
     assert mgr.restore_latest(_params(0)).meta == {
-        "global_batch": 64, "limit": 512, "sampler_rng": "pcg64"}
+        "global_batch": 64, "limit": 512, "sampler_rng": "pcg64",
+        "model": "mlp", "param_scale": 1}
     with pytest.raises(SystemExit, match="global_batch"):
         main(base + ["--batch_size", "32", "--checkpoint", str(ckpt),
+                     "--resume", str(tmp_path / "m.msgpack.steps")])
+    # model size is geometry too: flax from_bytes restores by dict KEYS
+    # (no shape check), so a mismatched --param_scale template would
+    # silently accept the blob and train the wrong model
+    with pytest.raises(SystemExit, match="param_scale"):
+        main(base + ["--batch_size", "64", "--param_scale", "2",
+                     "--checkpoint", str(ckpt),
                      "--resume", str(tmp_path / "m.msgpack.steps")])
 
 
@@ -288,6 +296,82 @@ def test_injected_save_io_fault_fails_cleanly(tmp_path, monkeypatch):
     finally:
         monkeypatch.delenv("PDMT_FAULT")
         faultpoints.install()
+
+
+def _resid(seed=2, n_dev=8, elems=2048):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n_dev, elems)).astype(np.float32)
+
+
+def test_resid_payload_roundtrips(tmp_path):
+    """The int8 error-feedback residual rides as a second payload with its
+    own size/CRC stamp and restores exactly; saves without one restore
+    resid=None (every pre-int8 manifest keeps working)."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    r = _resid()
+    mgr.save(_params(5), _key_data(), "threefry2x32",
+             step=4, epoch=0, offset=4, resid=r)
+    got = mgr.restore_latest(_params(0))
+    assert got.resid is not None and got.resid.dtype == np.float32
+    np.testing.assert_array_equal(got.resid, r)
+    rec = json.loads((tmp_path / "s" / "step_00000004.json").read_text())
+    assert rec["resid_payload"] == "step_00000004.resid.msgpack"
+    rblob = (tmp_path / "s" / "step_00000004.resid.msgpack").read_bytes()
+    assert rec["resid_bytes"] == len(rblob)
+    assert rec["resid_crc32"] == zlib.crc32(rblob)
+    # a plain save in the same directory restores with resid=None
+    _save(mgr, step=6, seed=1)
+    assert mgr.restore_latest(_params(0)).resid is None
+
+
+def test_torn_resid_makes_checkpoint_torn(tmp_path):
+    """A truncated or bit-rotted residual payload fails the WHOLE
+    checkpoint (resuming quantization-error accounting from garbage would
+    silently corrupt gradients) — restore falls back to the previous
+    intact one."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    mgr.save(_params(1), _key_data(), "threefry2x32",
+             step=2, epoch=0, offset=2, resid=_resid(1))
+    mgr.save(_params(2), _key_data(), "threefry2x32",
+             step=4, epoch=0, offset=4, resid=_resid(2))
+    rp = tmp_path / "s" / "step_00000004.resid.msgpack"
+    rp.write_bytes(rp.read_bytes()[: rp.stat().st_size // 2])
+    got = mgr.restore_latest(_params(0))
+    assert got.step == 2
+    np.testing.assert_array_equal(got.resid, _resid(1))
+    with pytest.raises(CheckpointError, match="truncated residual"):
+        mgr._load_intact(4, _params(0))
+    # same-length corruption: the CRC stamp catches it
+    mgr.save(_params(3), _key_data(), "threefry2x32",
+             step=6, epoch=0, offset=6, resid=_resid(3))
+    rp6 = tmp_path / "s" / "step_00000006.resid.msgpack"
+    b6 = bytearray(rp6.read_bytes())
+    b6[len(b6) // 2] ^= 0xFF
+    rp6.write_bytes(bytes(b6))
+    with pytest.raises(CheckpointError, match="residual CRC32"):
+        mgr._load_intact(6, _params(0))
+
+
+def test_rotation_and_sweep_cover_resid_payloads(tmp_path):
+    """keep-last-N rotation deletes the residual payload with its
+    checkpoint, and the crash-debris sweep collects manifest-less resid
+    strays."""
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=2)
+    for s in (2, 4, 6):
+        mgr.save(_params(s), _key_data(), "threefry2x32",
+                 step=s, epoch=0, offset=s, resid=_resid(s))
+    assert mgr.steps() == [4, 6]
+    names = sorted(os.listdir(tmp_path / "s"))
+    assert names == [
+        "step_00000004.json", "step_00000004.msgpack",
+        "step_00000004.resid.msgpack",
+        "step_00000006.json", "step_00000006.msgpack",
+        "step_00000006.resid.msgpack"]
+    # a dead writer's orphan resid payload is swept by the next save
+    (tmp_path / "s" / "step_00000009.resid.msgpack").write_bytes(b"orphan")
+    mgr.save(_params(8), _key_data(), "threefry2x32",
+             step=8, epoch=0, offset=8, resid=_resid(8))
+    assert "step_00000009.resid.msgpack" not in os.listdir(tmp_path / "s")
 
 
 def test_save_publishes_registry_metrics(tmp_path):

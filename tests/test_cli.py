@@ -241,6 +241,81 @@ def test_ddp_comm_cli_guards_and_training(tmp_path, capsys):
     assert len(lines) == 1 and _mean_train(lines[0]) > 0
 
 
+def test_int8_overlap_model_cli_guards_and_training(tmp_path, capsys):
+    """ISSUE 7 knob hygiene at the CLI boundary: every int8/overlap/model
+    knob a configuration would silently ignore is rejected by name, and
+    the new strategies train end-to-end on the virtual 8-device mesh."""
+    with pytest.raises(SystemExit, match="never quantizes"):
+        main(["--parallel", "--ddp_comm", "pmean", "--quant_block", "128",
+              "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="no quantization error"):
+        main(["--parallel", "--ddp_comm", "bf16", "--error_feedback",
+              "off", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="needs --parallel"):
+        main(["--overlap", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="IN-kernel"):
+        main(["--parallel", "--cached", "--ddp_comm", "int8",
+              "--kernel", "pallas_epoch", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="need\\(s\\) --kernel xla"):
+        main(["--parallel", "--cached", "--overlap",
+              "--kernel", "pallas", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="quant_block must be"):
+        main(["--parallel", "--ddp_comm", "int8", "--quant_block", "4",
+              "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="param_scale"):
+        main(["--param_scale", "0", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="mask stream|geometry"):
+        main(["--model", "deep_mlp", "--dropout_rng", "torch",
+              "--n_epochs", "1"])
+    # int8 + overlap trains (streaming), int8 on the cached scan trains
+    main(["--parallel", "--ddp_comm", "int8", "--overlap", "--n_epochs",
+          "1", "--limit", "512", "--batch_size", "16", "--checkpoint", ""])
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and _mean_train(lines[0]) > 0
+    main(["--parallel", "--cached", "--ddp_comm", "int8", "--n_epochs",
+          "1", "--limit", "512", "--batch_size", "16", "--checkpoint", ""])
+    _, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and _mean_train(lines[0]) > 0
+
+
+def test_int8_resume_refuses_mismatched_resid_device_count(tmp_path):
+    """The int8 error-feedback residual is per-DEVICE state, so a
+    checkpoint saved on a different mesh size cannot resume — refused by
+    name at the CLI boundary (like every geometry mismatch) instead of
+    surfacing place_comm_state's ValueError from inside fit."""
+    import numpy as np
+    import jax
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.train.ckpt_manager import CheckpointManager
+
+    steps = tmp_path / "m.steps"
+    CheckpointManager(str(steps)).save(
+        init_mlp(jax.random.key(0)),
+        np.asarray(jax.random.key_data(jax.random.key(0))),
+        "threefry2x32", step=1, epoch=1, offset=0,
+        # geometry stamp matching the resume run below (8-device mesh,
+        # --batch_size 16 -> global batch 128) — only the residual's
+        # device-row count disagrees
+        meta={"global_batch": 128, "limit": 512, "sampler_rng": "pcg64",
+              "model": "mlp", "param_scale": 1},
+        resid=np.zeros((4, 2048), np.float32))
+    with pytest.raises(SystemExit, match="residual.*4 device"):
+        main(["--parallel", "--cached", "--ddp_comm", "int8",
+              "--n_epochs", "2", "--limit", "512", "--batch_size", "16",
+              "--path", str(tmp_path), "--checkpoint", "",
+              "--resume", str(steps)])
+
+
+def test_model_zoo_cli_trains_scaled_model(tmp_path, capsys):
+    """--model deep_mlp --param_scale 2 trains end-to-end (serial cached
+    path; the params line reflects the scaled count)."""
+    assert main(["--model", "deep_mlp", "--param_scale", "2", "--cached",
+                 "--n_epochs", "1", "--limit", "256", "--batch_size", "64",
+                 "--path", str(tmp_path), "--checkpoint", ""]) == 0
+    out, lines = _epoch_lines(capsys)
+    assert len(lines) == 1 and _mean_train(lines[0]) > 0
+
+
 def test_eval_shuffle_changes_only_ref_unit(tmp_path, capsys):
     """--eval_shuffle reproduces the reference's shuffled test loader
     (ddp_tutorial_multi_gpu.py:43-47): the Σ(mean/B) ref-unit val_loss gets

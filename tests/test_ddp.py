@@ -181,12 +181,16 @@ def test_comm_strategies_run_and_losses_close(mesh):
         params = jax.device_put(init_mlp(jax.random.key(0)),
                                 replicated(mesh))
         key = jax.device_put(jax.random.key(1), replicated(mesh))
-        _, _, loss = step(params, key,
-                          jax.device_put(x, batch_sharding(mesh)),
-                          jax.device_put(y, batch_sharding(mesh)))
+        args = [params, key,
+                jax.device_put(x, batch_sharding(mesh)),
+                jax.device_put(y, batch_sharding(mesh))]
+        if step.comm_state:      # int8 threads its error-feedback state
+            args.append(step.place_comm_state(None, params))
+        loss = step(*args)[2]
         losses[comm] = float(loss)
     assert np.allclose(losses["sharded"], losses["pmean"], rtol=1e-6)
     assert np.allclose(losses["bf16"], losses["pmean"], rtol=1e-3)
+    assert np.allclose(losses["int8"], losses["pmean"], rtol=1e-3)
 
 
 def test_replicate_state_preserves_rbg_key_impl(mesh):

@@ -205,13 +205,15 @@ def test_dp_ring_threefry_lowers():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("comm", ["pmean", "sharded", "bf16"])
-def test_dp_comm_strategy_step_lowers(comm):
+@pytest.mark.parametrize("comm,overlap", [
+    ("pmean", False), ("sharded", False), ("bf16", False),
+    ("pmean", True), ("bf16", True)])
+def test_dp_comm_strategy_step_lowers(comm, overlap):
     from pytorch_ddp_mnist_tpu.parallel.ddp import dp_step_program
 
     n = 8
     mesh = abstract_mesh((n,), ("dp",))
-    prog = dp_step_program(mesh, 0.01, comm=comm)
+    prog = dp_step_program(mesh, 0.01, comm=comm, overlap=overlap)
     params = init_mlp(jax.random.PRNGKey(0))
     key = jax.random.PRNGKey(1)
     x = jnp.zeros((n * B, 784), jnp.float32)
@@ -219,18 +221,54 @@ def test_dp_comm_strategy_step_lowers(comm):
     _export_tpu(prog, params, key, x, y)
 
 
-@pytest.mark.parametrize("comm", ["sharded", "bf16"])
-def test_dp_comm_strategy_scan_program_lowers(comm):
+def test_dp_comm_int8_step_lowers():
+    # int8's all_to_all reduce-scatter / re-quantized all_gather phases +
+    # the error-feedback state threading (dp-sharded resid in AND out)
+    from pytorch_ddp_mnist_tpu.parallel import collectives
+    from pytorch_ddp_mnist_tpu.parallel.ddp import dp_step_program
+
+    n = 8
+    mesh = abstract_mesh((n,), ("dp",))
+    prog = dp_step_program(mesh, 0.01, comm="int8")
+    params = init_mlp(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    resid = jnp.zeros((n, collectives.comm_state_elems(params, n)),
+                      jnp.float32)
+    x = jnp.zeros((n * B, 784), jnp.float32)
+    y = jnp.zeros((n * B,), jnp.int32)
+    _export_tpu(prog, params, key, resid, x, y)
+
+
+@pytest.mark.parametrize("comm,overlap", [
+    ("sharded", False), ("bf16", False), ("pmean", True)])
+def test_dp_comm_strategy_scan_program_lowers(comm, overlap):
     # the epoch-scanned form (make_dp_run_fn threads comm through
     # _dp_step_body) over the same 8-device abstract mesh
     from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
 
     n = 8
     mesh = abstract_mesh((n,), ("dp",))
-    run = make_dp_run_fn(mesh, lr=0.01, comm=comm)
+    run = make_dp_run_fn(mesh, lr=0.01, comm=comm, overlap=overlap)
     params = init_mlp(jax.random.PRNGKey(0))
     key = jax.random.PRNGKey(1)
     x_all = jnp.zeros((n * 2 * B, 784), jnp.uint8)
     y_all = jnp.zeros((n * 2 * B,), jnp.int32)
     idxs = jnp.zeros((1, 2, n * B), jnp.int32)
     _export_tpu(run, params, key, x_all, y_all, idxs)
+
+
+def test_dp_comm_int8_scan_program_lowers():
+    from pytorch_ddp_mnist_tpu.parallel import collectives
+    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
+
+    n = 8
+    mesh = abstract_mesh((n,), ("dp",))
+    run = make_dp_run_fn(mesh, lr=0.01, comm="int8")
+    params = init_mlp(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    resid = jnp.zeros((n, collectives.comm_state_elems(params, n)),
+                      jnp.float32)
+    x_all = jnp.zeros((n * 2 * B, 784), jnp.uint8)
+    y_all = jnp.zeros((n * 2 * B,), jnp.int32)
+    idxs = jnp.zeros((1, 2, n * B), jnp.int32)
+    _export_tpu(run, params, key, x_all, y_all, idxs, resid)

@@ -179,9 +179,10 @@ def test_training_health_error_is_not_a_runtime_error():
 
 
 class _FakeState:
-    def __init__(self, params):
+    def __init__(self, params, resid=None):
         self.params = params
         self.key = jax.random.key(0)
+        self.resid = resid
 
 
 def test_checkpoint_and_warn_rescues_pre_nan_state():
@@ -189,7 +190,8 @@ def test_checkpoint_and_warn_rescues_pre_nan_state():
     reg = MetricsRegistry()
     wd = Watchdog(HealthConfig(policy="checkpoint-and-warn"), registry=reg,
                   on_fatal=saved.append, log=lambda _m: None)
-    good = _FakeState({"w": np.full(3, 7.0)})
+    good = _FakeState({"w": np.full(3, 7.0)},
+                      resid=np.full((2, 4), 0.5, np.float32))
     wd.seed_good(_FakeState({"w": np.zeros(3)}), epoch=0, offset=0, step=0)
     wd.observe(np.full(4, 1.0), state=good, epoch=0, step=4,
                ckpt_epoch=0, ckpt_offset=4)               # healthy: stashed
@@ -200,6 +202,11 @@ def test_checkpoint_and_warn_rescues_pre_nan_state():
     # poisoned one observed at detection time
     assert stash["step"] == 4 and (stash["epoch"], stash["offset"]) == (0, 4)
     np.testing.assert_array_equal(stash["params"]["w"], np.full(3, 7.0))
+    # the int8 error-feedback residual is resume state: it rides the
+    # rescue stash alongside params/key (None when the strategy carries
+    # none — the seed state above — or when it is not host-addressable)
+    np.testing.assert_array_equal(stash["resid"],
+                                  np.full((2, 4), 0.5, np.float32))
 
 
 def test_checkpoint_and_warn_first_window_rescues_the_seed():

@@ -170,7 +170,7 @@ def test_bench_matrix_skip_defers_rows_without_running_them(tmp_path):
         cwd=REPO, env=ENV, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     rows = json.loads(out_json.read_text())["variants"]
-    assert len(rows) == 17    # 14 kernel variants + the 3 DDP comms rows
+    assert len(rows) == 24    # 14 kernel variants + 10 DDP comms/scale rows
     assert all(row["value"] is None and
                "skipped by --skip" in row["error"][0] for row in rows)
     assert "retry pass" not in r.stderr       # skips are not failures

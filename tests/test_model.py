@@ -63,3 +63,74 @@ def test_train_eval_expectation_consistent(params):
             for i in range(200)]
     mean_out = np.mean(outs, axis=0)
     np.testing.assert_allclose(mean_out, eval_out, atol=0.25)
+
+
+# ---------------------------------------------------------------------------
+# models/zoo.py — the workload-scaling knob (ISSUE 7): every family is the
+# same (init, apply) functional pair, and the default IS the reference.
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_model_default_is_reference_identity():
+    """resolve_model('mlp', 1) returns the UNTOUCHED reference functions —
+    same objects, not wrappers — so every bitwise pin built on
+    init_mlp/mlp_apply keeps holding by construction."""
+    from pytorch_ddp_mnist_tpu.models import resolve_model
+
+    spec = resolve_model("mlp", 1)
+    assert spec.init is init_mlp
+    assert spec.apply is mlp_apply
+    assert spec.dims == (784, 128, 128, 10)
+
+
+def test_resolve_model_scales_quadratically():
+    from pytorch_ddp_mnist_tpu.models import resolve_model
+
+    p1 = param_count(resolve_model("mlp", 1).init(jax.random.key(0)))
+    p8 = param_count(resolve_model("mlp", 8).init(jax.random.key(0)))
+    assert p1 == 118_272
+    # 784*1024 + 1024 + 1024*1024 + 1024 + 1024*10 = 1,863,680
+    assert p8 == 1_863_680
+    d4 = resolve_model("deep_mlp", 4).init(jax.random.key(0))
+    # 4 hidden layers of width 512, bias-free 10-unit head
+    assert set(d4) == {"h0", "h1", "h2", "h3", "out"}
+    assert "b" not in d4["out"]
+    assert d4["out"]["w"].shape == (512, 10)
+
+
+@pytest.mark.parametrize("model,scale", [("mlp", 4), ("deep_mlp", 2)])
+def test_zoo_apply_contract_matches_mlp_apply(model, scale):
+    """Every family honors mlp_apply's exact contract: (n, 784) -> (n, 10),
+    deterministic in eval, dropout-key-varying in train, exactly one of
+    key/mask required in train mode."""
+    from pytorch_ddp_mnist_tpu.models import resolve_model
+
+    spec = resolve_model(model, scale)
+    p = spec.init(jax.random.key(0))
+    x = jnp.ones((4, 784))
+    out = spec.apply(p, x, train=False)
+    assert out.shape == (4, 10)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(spec.apply(p, x, train=False)))
+    t1 = spec.apply(p, x, train=True, dropout_key=jax.random.key(1))
+    t2 = spec.apply(p, x, train=True, dropout_key=jax.random.key(2))
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+    with pytest.raises(ValueError, match="exactly one"):
+        spec.apply(p, x, train=True)
+
+
+def test_validate_model_rejects_by_name():
+    from pytorch_ddp_mnist_tpu.models import validate_model
+
+    with pytest.raises(ValueError, match="convnet"):
+        validate_model("convnet", 1)
+    for bad in (0, -1, "2", 1.5):
+        with pytest.raises(ValueError, match="param_scale"):
+            validate_model("mlp", bad)
+
+
+def test_nondefault_model_rejected_on_pallas_kernels():
+    from pytorch_ddp_mnist_tpu.train.scan import make_run_fn
+
+    with pytest.raises(ValueError, match="kernel='xla'"):
+        make_run_fn(0.01, kernel="pallas", param_scale=2)

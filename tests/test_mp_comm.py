@@ -13,7 +13,11 @@ the final params. The ladder:
     a reduce-scatter tree instead of an allreduce; f32 reduction-order
     tolerance) — the acceptance criterion;
   * bf16 vs pmean      — drift bounded by the cast-error envelope
-    (lr * 2^-8-relative per step — pinned well below any wrong-mean bug).
+    (lr * 2^-8-relative per step — pinned well below any wrong-mean bug);
+  * int8 vs pmean      — drift bounded by the block-quantization envelope
+    (error feedback keeps it from compounding);
+  * pmean+overlap      — allclose at rtol 1e-6 (bucket-pipelining is pure
+    scheduling; the per-element math is unchanged).
 
 Every rank must also agree with every other rank within one run (replica
 lockstep — the strategies' all-gather/psum outputs are truly replicated).
@@ -39,11 +43,12 @@ pytestmark = pytest.mark.skipif(
 from test_multiprocess import WORLD, _run_world  # noqa: E402
 
 
-def _run_comm(comm: str, save_path) -> tuple:
+def _run_comm(comm: str, save_path, overlap: bool = False) -> tuple:
     """One 4-process world through `comm`; returns (records, leaves)."""
     outs = _run_world(
         [sys.executable, os.path.join("tests", "mp_comm_worker.py"),
-         "--comm", comm, "--save", str(save_path)])
+         "--comm", comm, "--save", str(save_path)]
+        + (["--overlap"] if overlap else []))
     recs = []
     for rank, (_, out, err) in enumerate(outs):
         line = [ln for ln in out.splitlines() if ln.startswith("{")]
@@ -73,6 +78,8 @@ def comm_runs(tmp_path_factory):
     runs["pmean2"] = _run_comm("pmean", d / "pmean2.npz")
     runs["sharded"] = _run_comm("sharded", d / "sharded.npz")
     runs["bf16"] = _run_comm("bf16", d / "bf16.npz")
+    runs["int8"] = _run_comm("int8", d / "int8.npz")
+    runs["pmean_ov"] = _run_comm("pmean", d / "pmean_ov.npz", overlap=True)
     return runs
 
 
@@ -97,3 +104,22 @@ def test_bf16_drift_bounded(comm_runs):
     _, bf = comm_runs["bf16"]
     worst = max(float(np.max(np.abs(u - v))) for u, v in zip(bf, ref))
     assert worst < 1e-4, worst
+
+
+def test_int8_drift_bounded(comm_runs):
+    """int8 error-feedback quantized allreduce across REAL process
+    boundaries (the all_to_all/all_gather phases cross the wire): bounded
+    drift vs the pmean world — same envelope as the in-process pin."""
+    _, ref = comm_runs["pmean"]
+    _, q = comm_runs["int8"]
+    worst = max(float(np.max(np.abs(u - v))) for u, v in zip(q, ref))
+    assert 0 < worst < 1e-3, worst
+
+
+def test_pmean_overlap_matches_pmean(comm_runs):
+    """Bucket-pipelining is pure scheduling: the overlapped pmean world
+    stays within f32 reassociation tolerance of the whole-tree one."""
+    _, ref = comm_runs["pmean"]
+    _, ov = comm_runs["pmean_ov"]
+    for u, v in zip(ov, ref):
+        np.testing.assert_allclose(u, v, rtol=1e-6, atol=1e-7)

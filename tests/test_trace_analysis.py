@@ -258,6 +258,128 @@ def test_trace_cli_report_baseline_gate_exit_codes(tmp_path, capsys):
     assert "REGRESSION" in out and "step_compute" in out and "FAIL" in out
 
 
+def _ddp_artifact(effs: dict) -> dict:
+    """A MULTICHIP_r0X.json-shaped artifact from {label: efficiency}
+    (label 'strategy' or 'strategy+overlap')."""
+    return {"n_devices": 8, "ok": True, "strategies": [
+        {"strategy": lbl.split("+")[0], "overlap": lbl.endswith("+overlap"),
+         "scaling_efficiency_vs_1dev": eff,
+         "images_per_sec": 1000.0} for lbl, eff in effs.items()]}
+
+
+def test_efficiency_report_from_ddp_artifact():
+    """The MULTICHIP artifact adapter: one efficiency entry per strategy
+    row, overlap rows labeled apart, device count in every label (from
+    the artifact when rows don't carry it), malformed rows skipped."""
+    art = _ddp_artifact({"pmean": 0.30, "sharded": 0.25,
+                         "sharded+overlap": 0.33})
+    art["strategies"].append({"strategy": "bf16"})       # no efficiency
+    art["strategies"].append("not-a-dict")
+    rep = analysis.efficiency_report(art, path="r07.json")
+    assert rep["report"] == "trace_phase_stats"
+    assert rep["records"] == 3
+    assert rep["efficiency"] == {"pmean@8dev": 0.30, "sharded@8dev": 0.25,
+                                 "sharded+overlap@8dev": 0.33}
+    assert rep["phases"] == {}
+
+
+def test_efficiency_labels_carry_workload():
+    """Efficiency rows measured on different --model/--param_scale
+    workloads or device counts must NEVER gate against each other: a
+    scale-16 pmean row is not a regression of a scale-1 pmean row, and a
+    per-chip efficiency measured on 8 devices is not a regression of one
+    measured on 4 (it always falls with device count). Non-default
+    workloads get `@model xN` labels, device counts `@Ndev`; legacy rows
+    without the workload fields are the default mlp x1."""
+    r06 = analysis.efficiency_report(_ddp_artifact({"pmean": 0.1991}))
+    art16 = _ddp_artifact({"pmean": 0.1094})
+    for row in art16["strategies"]:
+        row["model"] = "mlp"
+        row["param_scale"] = 16
+    r07 = analysis.efficiency_report(art16)
+    assert r07["efficiency"] == {"pmean@mlp x16@8dev": 0.1094}
+    # zero shared labels -> zero rows -> no false exit-3 regression
+    assert analysis.compare(r07, r06, threshold=1.5)["rows"] == []
+    # different pool size: same strategy, same workload, no pairing
+    art4 = _ddp_artifact({"pmean": 0.30})
+    art4["n_devices"] = 4
+    r4 = analysis.efficiency_report(art4)
+    assert r4["efficiency"] == {"pmean@4dev": 0.30}
+    assert analysis.compare(r06, r4, threshold=1.5)["rows"] == []
+    # explicit default workload stamps collapse to the bare legacy label
+    art1 = _ddp_artifact({"pmean": 0.2})
+    for row in art1["strategies"]:
+        row["model"] = "mlp"
+        row["param_scale"] = 1
+    assert analysis.efficiency_report(art1)["efficiency"] == {
+        "pmean@8dev": 0.2}
+
+
+def test_compare_gates_efficiency_drop():
+    """ROADMAP item 2's tail: a scaling-efficiency drop past the threshold
+    regresses (exit-3 material) exactly like a step-time blowup; an
+    efficiency IMPROVEMENT gates nothing; strategies missing from either
+    side are not compared."""
+    old = analysis.efficiency_report(_ddp_artifact(
+        {"pmean": 0.30, "sharded": 0.20, "int8": 0.10}))
+    new = analysis.efficiency_report(_ddp_artifact(
+        {"pmean": 0.13, "sharded": 0.30, "bf16": 0.05}))
+    diff = analysis.compare(new, old, threshold=1.5)
+    labels = {r["phase"]: r for r in diff["rows"]}
+    assert set(labels) == {"pmean@8dev", "sharded@8dev"}  # int8/bf16 unpaired
+    assert labels["pmean@8dev"]["regressed"]          # 0.30 -> 0.13 = 2.3x
+    assert labels["pmean@8dev"]["stat"] == analysis.EFFICIENCY_STAT
+    assert not labels["sharded@8dev"]["regressed"]    # it IMPROVED
+    assert [r["phase"] for r in diff["regressions"]] == ["pmean@8dev"]
+    # the ratio convention matches the time rows: bigger = worse
+    assert labels["pmean@8dev"]["ratio"] == pytest.approx(0.30 / 0.13)
+
+
+def test_compare_gates_efficiency_collapse_to_zero():
+    """A total efficiency collapse (the artifact rounds to 4 decimals, so
+    a dead strategy lands as exactly 0.0) is the WORST regression — it
+    must gate with an infinite ratio, never be filtered as an unpaired
+    row."""
+    old = analysis.efficiency_report(_ddp_artifact(
+        {"pmean": 0.30, "sharded": 0.20}))
+    new = analysis.efficiency_report(_ddp_artifact(
+        {"pmean": 0.0, "sharded": 0.21}))
+    diff = analysis.compare(new, old, threshold=1.5)
+    labels = {r["phase"]: r for r in diff["rows"]}
+    assert labels["pmean@8dev"]["regressed"]
+    assert labels["pmean@8dev"]["ratio"] == float("inf")
+    assert [r["phase"] for r in diff["regressions"]] == ["pmean@8dev"]
+    # baseline-side zero stays uncomparable (no signal to regress FROM)
+    old0 = analysis.efficiency_report(_ddp_artifact({"pmean": 0.0}))
+    new0 = analysis.efficiency_report(_ddp_artifact({"pmean": 0.1}))
+    assert analysis.compare(new0, old0, threshold=1.5)["rows"] == []
+
+
+def test_trace_cli_gates_multichip_artifact(tmp_path, capsys):
+    """The front door: `trace report NEW.json --baseline OLD.json` over
+    DDP bench artifacts exits 3 on an efficiency regression, 0 when
+    efficiency held, 1 when an artifact carries no gateable rows."""
+    old = tmp_path / "MULTICHIP_old.json"
+    good = tmp_path / "MULTICHIP_good.json"
+    bad = tmp_path / "MULTICHIP_bad.json"
+    old.write_text(json.dumps(_ddp_artifact({"pmean": 0.30, "int8": 0.20})))
+    good.write_text(json.dumps(_ddp_artifact({"pmean": 0.31, "int8": 0.22})))
+    bad.write_text(json.dumps(_ddp_artifact({"pmean": 0.30, "int8": 0.08})))
+    assert trace_cli.main(["report", str(good),
+                           "--baseline", str(old)]) == 0
+    capsys.readouterr()
+    rc = trace_cli.main(["report", str(bad), "--baseline", str(old)])
+    assert rc == 3
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "int8" in out
+    # row-less artifact: a named failure, not a silent pass
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"strategies": [], "ok": False}))
+    assert trace_cli.main(["report", str(empty),
+                           "--baseline", str(old)]) == 1
+    assert "no strategy rows" in capsys.readouterr().err
+
+
 def test_trace_cli_report_accepts_saved_json_baseline(tmp_path, capsys):
     run_dir = tmp_path / "run"
     run_dir.mkdir()
