@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -91,6 +91,24 @@ chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py || \
 		{ rc=$$?; [ $$rc -eq 75 ] && \
 		JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py --world 1; }
+
+# Static-analysis smoke (docs/STATIC_ANALYSIS.md): the source lint over
+# the whole package (zero unbaselined findings or exit 1) plus the
+# program auditor over the full comm x overlap x {step, run} matrix
+# (exit 3 names the broken contract). Both are CPU-cheap: the lint is
+# pure stdlib ast, the audit traces jaxprs over a deviceless AbstractMesh
+# (no compile, no devices).
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu lint
+
+audit-program:
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu audit-program
+
+static-smoke: lint audit-program
+
+# The committed pre-merge gate: static contracts first (seconds), then the
+# fast test tier.
+check: static-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -24,6 +24,7 @@ sync), best of 5 windows.
 """
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -95,6 +96,51 @@ CALIBRATION_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "bench_calibration.json")
 
 
+@functools.lru_cache(maxsize=1)
+def statics_stamp() -> dict:
+    """{lint_findings, audit_ok[, error]} — computed once per process
+    (lru_cache) and stamped on every artifact line, so a MULTICHIP/BENCH
+    JSON records whether the measured build also honored the static
+    contracts (docs/STATIC_ANALYSIS.md). The audit covers the 8
+    comm x overlap step programs (the form every measured strategy runs).
+    The stamp NEVER kills a finished measurement: a named contract
+    violation reads as audit_ok=false, and an unexpected stamp failure
+    (a scratch file under scripts/ that doesn't parse, a malformed
+    baseline, a backendless process) degrades to null fields plus an
+    `error` string instead of an exception."""
+    from pytorch_ddp_mnist_tpu.statics import jaxpr_audit, lint
+    out = {"lint_findings": None, "audit_ok": None}
+    try:
+        findings, _ = lint.lint_paths(lint.default_targets())
+        new, _, _ = lint.apply_baseline(
+            findings, lint.load_baseline(lint.default_baseline_path()))
+        out["lint_findings"] = len(new)
+    except (OSError, SyntaxError, UnicodeDecodeError, ValueError) as e:
+        out["error"] = f"lint: {e}"[:300]
+    try:
+        jaxpr_audit.audit_matrix(forms=("step",))
+        out["audit_ok"] = True
+    except jaxpr_audit.AuditViolation:
+        out["audit_ok"] = False
+    except (RuntimeError, ValueError, OSError) as e:
+        # tracing needs a live backend for the example arrays; a dead one
+        # must not cost the artifact (the _backend_info degradation rule)
+        out["error"] = (out.get("error", "") + f" audit: {e}"[:300]).strip()
+    return out
+
+
+def statics_stamp_fields() -> "dict | None":
+    """The env-gated form every stamper shares: the statics_stamp() dict,
+    or None when PDMT_STATICS_STAMP=0 disabled it (the test harness's
+    fast path — the stamp costs a few seconds of lint+audit per process;
+    matrix drivers disable it per cell and stamp once at the artifact
+    level instead)."""
+    if os.environ.get("PDMT_STATICS_STAMP", "1").strip().lower() \
+            in ("0", "false", "no", "off"):
+        return None
+    return dict(statics_stamp())
+
+
 def registry_stamp(registry=None) -> dict:
     """Compile-count and memory fields for a bench JSON line, read from the
     telemetry registry (main() arms the jax.monitoring compile listener
@@ -116,6 +162,9 @@ def registry_stamp(registry=None) -> dict:
     # device-mode bench runs one over its measured loss curves). A round
     # that died mid-measure still stamps the signals seen up to the death.
     out["health_summary"] = telemetry.health_summary(reg)
+    statics = statics_stamp_fields()
+    if statics is not None:
+        out["statics"] = statics
     return out
 
 

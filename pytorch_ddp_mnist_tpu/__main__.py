@@ -10,6 +10,13 @@ framework's executables (each also runs standalone as its own module):
     convert    IDX -> NetCDF converter (data/convert.py; the
                mnist_to_netcdf.ipynb workflow)
     download   mirrored, checksum-verified MNIST IDX fetch (data/download.py)
+    lint       JAX-aware source lint — host syncs in traced code, wire
+               dtypes, overbroad excepts, unlocked globals... with a
+               committed baseline (statics/lint.py; docs/STATIC_ANALYSIS.md)
+    audit-program
+               lower the comm x overlap step-program matrix and assert the
+               collective/dtype/wire-byte contracts per strategy
+               (statics/jaxpr_audit.py; exit 3 names the broken contract)
 """
 
 from __future__ import annotations
@@ -25,6 +32,10 @@ _COMMANDS = {
     "convert": ("pytorch_ddp_mnist_tpu.data.convert",
                 "IDX -> NetCDF converter"),
     "download": ("pytorch_ddp_mnist_tpu.data.download", "MNIST IDX fetch"),
+    "lint": ("pytorch_ddp_mnist_tpu.statics.lint",
+             "JAX-aware source lint (baseline-gated)"),
+    "audit-program": ("pytorch_ddp_mnist_tpu.statics.jaxpr_audit",
+                      "step-program collective/dtype/wire contract audit"),
 }
 
 

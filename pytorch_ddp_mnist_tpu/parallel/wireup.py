@@ -203,8 +203,8 @@ def _honor_platform_env() -> None:
         try:
             from jax.extend.backend import clear_backends
             clear_backends()
-        except Exception:
-            pass
+        except (ImportError, AttributeError, RuntimeError):
+            pass  # older jax spelling / already-clear client: re-probe anyway
 
 
 class BackendUnavailableError(RuntimeError):
@@ -317,8 +317,8 @@ def _subprocess_backend_healthy(timeout_s: float) -> bool:
         return subprocess.run(
             [sys.executable, "-c", code],
             timeout=timeout_s, capture_output=True).returncode == 0
-    except Exception:  # TimeoutExpired, spawn failure: not healthy
-        return False
+    except (subprocess.SubprocessError, OSError):
+        return False  # TimeoutExpired, spawn failure: not healthy
 
 
 # Substrings (lowercased match) of RuntimeErrors that a lost/dropping
@@ -422,7 +422,7 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
             try:
                 from jax._src import xla_bridge
                 xla_bridge._clear_backends()
-            except Exception:
+            except (ImportError, AttributeError, RuntimeError):
                 pass  # older/newer jax: fall through and re-probe anyway
             continue
 

@@ -1,0 +1,39 @@
+"""statics/ — JAX-aware static analysis: the repo's contracts, machine-checked.
+
+Two passes behind one CLI (`python -m pytorch_ddp_mnist_tpu lint` /
+`... audit-program`):
+
+  * **Source lint** (`rules.py` + `lint.py`, stdlib `ast` only — the
+    check_telemetry.py discipline: loadable by file path on hosts without
+    jax): JAX/TPU-specific rules with stable IDs — host syncs and wall
+    clocks inside traced code, Python `if` on tracer values, f64 dtypes,
+    collectives without an explicit axis name, overbroad `except` that
+    would swallow `TrainingHealthError`/`CheckpointError`, mutable default
+    args, and module-global reassignment without a lock (the PR 6 tracer
+    race, as a rule). A committed `baseline.json` suppresses accepted
+    findings with a reason string, so CI fails only on NEW ones.
+
+  * **Program auditor** (`jaxpr_audit.py`): lower the full step-program
+    matrix (comm x overlap x {streaming step, fit_cached scan body}) over
+    a deviceless 8-way AbstractMesh and walk the jaxpr asserting the
+    structural contracts the hand-written pins guard one test at a time —
+    collective kinds/counts per strategy and per bucket, wire dtypes (the
+    wire never carries f32 for bf16/int8), no f64, no host callbacks, and
+    bytes-on-wire recomputed from the audited program matching the
+    `ddp.bytes_on_wire` cost model.
+
+`lint` imports nothing outside the stdlib; `jaxpr_audit` imports jax (and
+the step builders) lazily inside its functions, so importing this package
+stays cheap.
+
+docs/STATIC_ANALYSIS.md carries the rule catalog, the per-strategy audit
+contract table, and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+from .rules import RULES, Finding, Rule  # noqa: F401
+from .lint import lint_paths, lint_source, load_baseline  # noqa: F401
+
+__all__ = ["RULES", "Rule", "Finding", "lint_source", "lint_paths",
+           "load_baseline"]

@@ -1,0 +1,613 @@
+"""JAX-aware source lint — stdlib `ast` only, no framework import.
+
+Engine for the rule catalog in `rules.py`. Two passes per file:
+
+  1. **Traced-function marking** (purely syntactic): a function is traced
+     when it is decorated with a jit-family decorator, or its NAME is
+     passed to a trace sink (`jax.jit`, `jax.vmap`, `jax.grad`,
+     `jax.value_and_grad`, `jax.lax.scan/cond/while_loop`, `shard_map`,
+     `pallas_call`, ...) anywhere in the module — including one
+     `functools.partial` hop (``step = partial(body, ...)`` then
+     ``lax.scan(step, ...)`` marks ``body``). Matching is by name within
+     the module: a deliberate over-approximation that needs no dataflow.
+
+  2. **Rule checks**: traced-scope rules (SYNC001/002/003, the traced part
+     of DT001) walk only marked functions' subtrees; module-scope rules
+     (COLL001, EXC001, MUT001, MUT002, the jnp-rooted part of DT001) walk
+     the whole file.
+
+Baseline: `baseline.json` entries are `(rule, file, stripped source line)`
+triples with a human reason. A finding matching an entry is suppressed; an
+entry matching nothing is STALE (warned, and `--prune-baseline` rewrites
+the file without it); anything else fails the run. Keying on line CONTENT
+instead of line number keeps entries stable as unrelated code moves, and
+re-surfaces the finding the moment the flagged line itself is edited.
+
+CLI (also reachable as `python -m pytorch_ddp_mnist_tpu lint`):
+
+    python -m pytorch_ddp_mnist_tpu.statics.lint [paths...]
+        [--json] [--baseline FILE] [--no-baseline] [--prune-baseline]
+
+Exit codes: 0 clean (stale-only is clean), 1 new findings, 2 usage.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+try:
+    from .rules import RULES, Finding
+except ImportError:
+    # Loaded BY FILE PATH with no package context (the check_telemetry.py
+    # copied-alone pattern — a CI host without the framework installed):
+    # pull the sibling rules.py the same way.
+    import importlib.util as _ilu
+    _spec = _ilu.spec_from_file_location(
+        "_pdmt_statics_rules",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "rules.py"))
+    _rules = _ilu.module_from_spec(_spec)
+    sys.modules["_pdmt_statics_rules"] = _rules   # dataclasses needs it
+    _spec.loader.exec_module(_rules)
+    RULES, Finding = _rules.RULES, _rules.Finding
+
+# Call sites whose function-valued arguments become traced code. Last
+# dotted segment is matched, so `jax.jit`, `jax.lax.scan` and a bare
+# `shard_map` all count.
+TRACE_SINKS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "scan", "cond",
+    "switch", "while_loop", "fori_loop", "shard_map", "pallas_call",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "make_jaxpr",
+    "eval_shape",
+}
+# Decorator heads that make the decorated def traced.
+TRACE_DECORATORS = {"jit", "vmap", "pmap", "pallas_call", "custom_vjp",
+                    "custom_jvp", "checkpoint", "remat"}
+
+# jax.lax collectives and the positional-argument count that includes the
+# axis name (COLL001).
+COLLECTIVE_MIN_ARGS = {
+    "psum": 2, "pmean": 2, "pmax": 2, "pmin": 2, "psum_scatter": 2,
+    "all_gather": 2, "all_to_all": 2, "ppermute": 2, "pshuffle": 2,
+    "pswapaxes": 2, "pvary": 2, "pcast": 2, "axis_index": 1,
+}
+_COLLECTIVE_ROOTS = {"jax", "lax", "jnp"}
+
+# Static array metadata: branching on these is how builders specialize
+# programs, so SYNC003 never descends past them.
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "config"}
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NP_SYNC_CALLS = {"asarray", "array", "copyto", "save", "savez"}
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last(node) -> Optional[str]:
+    d = _dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _root(node) -> Optional[str]:
+    d = _dotted(node)
+    return d.split(".", 1)[0] if d else None
+
+
+def _scoped_body(func) -> Iterable[ast.AST]:
+    """Walk `func`'s own body, not descending into nested function/class
+    definitions (their scopes own their own `global`/lock semantics)."""
+    stack = list(func.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Linter:
+    def __init__(self, tree: ast.Module, path: str, lines: Sequence[str]):
+        self.tree = tree
+        self.path = path
+        self.lines = lines
+        self.findings: List[Finding] = []
+
+    # -- plumbing ----------------------------------------------------------
+
+    def flag(self, rule_id: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        content = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        self.findings.append(Finding(
+            rule=rule_id, path=self.path, line=line, col=col,
+            message=message, content=content, hint=RULES[rule_id].hint))
+
+    # -- pass 1: traced-function marking -----------------------------------
+
+    def traced_functions(self) -> List[ast.AST]:
+        defs: dict = {}
+        decorated: List[ast.AST] = []
+        marked: set = set()
+        aliases: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    heads = {_last(n) for n in ast.walk(dec)
+                             if isinstance(n, (ast.Name, ast.Attribute))}
+                    if heads & TRACE_DECORATORS:
+                        decorated.append(node)
+                        break
+            elif isinstance(node, ast.Call):
+                if _last(node.func) in TRACE_SINKS:
+                    for arg in node.args:
+                        for n in ast.walk(arg):
+                            if isinstance(n, ast.Name):
+                                marked.add(n.id)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt, val = node.targets[0].id, node.value
+                if isinstance(val, ast.Call) and _last(val.func) == "partial":
+                    refs = {n.id for a in val.args
+                            for n in ast.walk(a) if isinstance(n, ast.Name)}
+                    aliases.setdefault(tgt, set()).update(refs - {"partial"})
+                elif isinstance(val, ast.Name):
+                    aliases.setdefault(tgt, set()).add(val.id)
+        # one-hop-at-a-time fixpoint: a marked alias marks what it wraps
+        changed = True
+        while changed:
+            changed = False
+            for tgt, refs in aliases.items():
+                if tgt in marked and not refs <= marked:
+                    marked |= refs
+                    changed = True
+        out = list(decorated)
+        seen = {id(n) for n in decorated}
+        for name in marked & set(defs):
+            for node in defs[name]:
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    out.append(node)
+        # drop defs nested inside another traced def (parent walk covers
+        # them; avoids double reports)
+        inner: set = set()
+        for node in out:
+            for sub in ast.walk(node):
+                if sub is not node and id(sub) in seen:
+                    inner.add(id(sub))
+        return [n for n in out if id(n) not in inner]
+
+    # -- traced-scope rules -------------------------------------------------
+
+    def check_traced(self, func) -> None:
+        fname = getattr(func, "name", "<lambda>")
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                self._sync001(node, fname)
+                self._sync002(node, fname)
+            if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                self._sync003(node, fname)
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in ("float64", "complex128"):
+                self.flag("DT001", node,
+                          f"{node.attr} inside traced function "
+                          f"'{fname}' (TPUs have no f64; the wire "
+                          f"contract is f32 or narrower)")
+            if isinstance(node, ast.keyword) and node.arg == "dtype" \
+                    and isinstance(node.value, ast.Constant) \
+                    and node.value.value in ("float64", "f8", "double"):
+                self.flag("DT001", node.value,
+                          f"dtype={node.value.value!r} inside traced "
+                          f"function '{fname}'")
+
+    def _sync001(self, node: ast.Call, fname: str) -> None:
+        callee = node.func
+        if isinstance(callee, ast.Name) and callee.id == "float":
+            if node.args and isinstance(node.args[0], ast.Constant):
+                return  # float("inf") etc: a literal, not a tracer
+            self.flag("SYNC001", node,
+                      f"builtin float() inside traced function '{fname}' "
+                      f"coerces a tracer to a host scalar")
+            return
+        if isinstance(callee, ast.Attribute):
+            if callee.attr in _HOST_SYNC_METHODS:
+                self.flag("SYNC001", node,
+                          f".{callee.attr}() inside traced function "
+                          f"'{fname}' forces a device->host sync")
+                return
+            d = _dotted(callee)
+            if d in ("jax.device_get",):
+                self.flag("SYNC001", node,
+                          f"jax.device_get inside traced function "
+                          f"'{fname}' forces a device->host sync")
+                return
+            if _root(callee) in ("np", "numpy") \
+                    and callee.attr in _NP_SYNC_CALLS:
+                self.flag("SYNC001", node,
+                          f"np.{callee.attr} inside traced function "
+                          f"'{fname}' materializes a tracer on host")
+
+    def _sync002(self, node: ast.Call, fname: str) -> None:
+        callee = node.func
+        if not isinstance(callee, ast.Attribute):
+            return
+        d = _dotted(callee) or ""
+        root = _root(callee)
+        if root == "time":
+            self.flag("SYNC002", node,
+                      f"{d}() inside traced function '{fname}' freezes "
+                      f"one trace-time timestamp into the program")
+        elif root == "random" or d.startswith(("np.random.",
+                                               "numpy.random.")):
+            self.flag("SYNC002", node,
+                      f"{d}() inside traced function '{fname}' draws host "
+                      f"randomness once at trace time")
+        elif callee.attr in ("now", "today", "utcnow") \
+                and _last(callee.value) == "datetime":
+            self.flag("SYNC002", node,
+                      f"{d}() inside traced function '{fname}' freezes "
+                      f"one trace-time wall clock into the program")
+
+    def _sync003(self, node, fname: str) -> None:
+        offender = self._tracer_call_in(node.test)
+        if offender is not None:
+            kind = type(node).__name__.lower()
+            self.flag("SYNC003", node,
+                      f"Python {kind} on the result of "
+                      f"{_dotted(offender.func) or 'a jax call'} inside "
+                      f"traced function '{fname}' coerces a tracer to "
+                      f"bool")
+
+    def _tracer_call_in(self, expr) -> Optional[ast.Call]:
+        """First jnp/jax/lax-rooted Call in `expr`, pruning static-metadata
+        attribute accesses (.shape/.dtype/... and jax.config)."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _STATIC_ATTRS:
+                continue  # don't descend: static metadata is host-legal
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                if d.split(".", 1)[0] in ("jnp", "jax", "lax") \
+                        and not d.startswith("jax.config"):
+                    return node
+            stack.extend(ast.iter_child_nodes(node))
+        return None
+
+    # -- module-scope rules --------------------------------------------------
+
+    def check_module(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "float64" \
+                    and _root(node) == "jnp":
+                self.flag("DT001", node,
+                          "jnp.float64 (device f64) — TPUs have no f64 "
+                          "ALU and x64 is off framework-wide")
+            if isinstance(node, ast.Call):
+                self._x64_flip(node)
+                self._coll001(node)
+            if isinstance(node, ast.ExceptHandler):
+                self._exc001(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                self._mut001(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._mut002(node)
+
+    def _x64_flip(self, node: ast.Call) -> None:
+        callee = node.func
+        if isinstance(callee, ast.Attribute) and callee.attr == "update" \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value == "jax_enable_x64" \
+                and isinstance(node.args[1], ast.Constant) \
+                and node.args[1].value:
+            self.flag("DT001", node,
+                      "jax_enable_x64 flipped on — every op doubles and "
+                      "the wire-dtype contract breaks")
+
+    def _coll001(self, node: ast.Call) -> None:
+        callee = node.func
+        if not isinstance(callee, ast.Attribute):
+            return
+        need = COLLECTIVE_MIN_ARGS.get(callee.attr)
+        if need is None or _root(callee) not in _COLLECTIVE_ROOTS:
+            return
+        kwargs = {k.arg for k in node.keywords}
+        if len(node.args) < need and "axis_name" not in kwargs:
+            self.flag("COLL001", node,
+                      f"jax.lax.{callee.attr} without an explicit axis "
+                      f"name")
+
+    def _exc001(self, node: ast.ExceptHandler) -> None:
+        def broad(t) -> bool:
+            return t is None or _last(t) in ("Exception", "BaseException")
+
+        t = node.type
+        is_broad = broad(t) or (isinstance(t, ast.Tuple)
+                                and any(broad(e) for e in t.elts))
+        if not is_broad:
+            return
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            return  # re-raising handlers don't swallow the signal
+        what = "bare except" if t is None else f"except {_last(t) or '...'}"
+        self.flag("EXC001", node,
+                  f"{what} without re-raise swallows TrainingHealthError/"
+                  f"CheckpointError too")
+
+    def _mut001(self, node) -> None:
+        defaults = list(getattr(node.args, "defaults", []))
+        defaults += [d for d in getattr(node.args, "kw_defaults", []) if d]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set")
+                    and not d.args and not d.keywords):
+                name = getattr(node, "name", "<lambda>")
+                self.flag("MUT001", d,
+                          f"mutable default argument in '{name}' is "
+                          f"shared across every call")
+
+    def _mut002(self, node) -> None:
+        globals_: List[ast.Global] = []
+        assigned: set = set()
+        locked = False
+        for n in _scoped_body(node):
+            if isinstance(n, ast.Global):
+                globals_.append(n)
+            elif isinstance(n, ast.Assign):
+                assigned |= {t.id for t in n.targets
+                             if isinstance(t, ast.Name)}
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) \
+                    and isinstance(n.target, ast.Name):
+                assigned.add(n.target.id)
+            elif isinstance(n, ast.With):
+                for item in n.items:
+                    d = _dotted(item.context_expr) or ""
+                    if isinstance(item.context_expr, ast.Call):
+                        d = _dotted(item.context_expr.func) or ""
+                    if "lock" in d.lower():
+                        locked = True
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "acquire":
+                locked = True
+        if locked:
+            return
+        for g in globals_:
+            hot = sorted(set(g.names) & assigned)
+            if hot:
+                self.flag("MUT002", g,
+                          f"'{node.name}' reassigns module global(s) "
+                          f"{', '.join(hot)} without holding a lock")
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for func in self.traced_functions():
+            self.check_traced(func)
+        self.check_module()
+        # stable order + dedup (a def marked through two routes walks once,
+        # but belt and braces)
+        uniq = {}
+        for f in self.findings:
+            uniq[(f.rule, f.path, f.line, f.col, f.message)] = f
+        return sorted(uniq.values(), key=lambda f: (f.path, f.line, f.col,
+                                                    f.rule))
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    """Lint one source string; `path` is stamped into findings verbatim."""
+    tree = ast.parse(src, filename=path)
+    return _Linter(tree, path, src.splitlines()).run()
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in filenames if f.endswith(".py"))
+        else:
+            out.append(p)
+    return sorted(set(out))
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None
+               ) -> Tuple[List[Finding], int]:
+    """Lint files/directories; returns (findings, files checked). Finding
+    paths are repo-root-relative ('/'-separated) so baseline entries are
+    machine-independent."""
+    root = root or repo_root()
+    findings: List[Finding] = []
+    files = _iter_py_files(paths)
+    for path in files:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(os.path.abspath(path), root)
+        if rel.startswith(".."):
+            rel = os.path.abspath(path)
+        findings.extend(lint_source(src, rel.replace(os.sep, "/")))
+    return findings, len(files)
+
+
+# -- baseline ----------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> dict:
+    """{"version": 1, "entries": [...]} — a missing file is an empty
+    baseline; a malformed one is an error (a silently ignored baseline
+    would un-suppress everything and fail CI confusingly)."""
+    if not os.path.exists(path):
+        return {"version": 1, "entries": []}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or not isinstance(
+            data.get("entries"), list):
+        raise ValueError(f"baseline {path}: expected an object with an "
+                         f"'entries' list")
+    for e in data["entries"]:
+        missing = [k for k in ("rule", "file", "content", "reason")
+                   if k not in e]
+        if missing:
+            raise ValueError(f"baseline {path}: entry {e!r} missing "
+                             f"{missing} (every suppression carries a "
+                             f"reason)")
+    return data
+
+
+def apply_baseline(findings: List[Finding], baseline: dict
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(new, suppressed, stale_entries). An entry suppresses every finding
+    with its (rule, file, content) key; an entry matching nothing is
+    stale."""
+    by_key = {}
+    for e in baseline.get("entries", []):
+        by_key[(e["rule"], e["file"], e["content"])] = e
+    matched: set = set()
+    new, suppressed = [], []
+    for f in findings:
+        if f.key() in by_key:
+            matched.add(f.key())
+            suppressed.append(f)
+        else:
+            new.append(f)
+    stale = [e for k, e in by_key.items() if k not in matched]
+    return new, suppressed, stale
+
+
+def prune_baseline(path: str, baseline: dict, stale: List[dict]) -> int:
+    """Rewrite `path` without the stale entries; returns how many were
+    dropped. Order and reasons of surviving entries are preserved."""
+    stale_keys = {(e["rule"], e["file"], e["content"]) for e in stale}
+    kept = [e for e in baseline.get("entries", [])
+            if (e["rule"], e["file"], e["content"]) not in stale_keys]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": baseline.get("version", 1), "entries": kept},
+                  f, indent=2)
+        f.write("\n")
+    return len(baseline.get("entries", [])) - len(kept)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def default_targets() -> List[str]:
+    """The whole-package lint surface: the framework package, bench.py and
+    scripts/ (tests are excluded — fixtures there violate rules on
+    purpose)."""
+    root = repo_root()
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = [pkg]
+    for extra in ("bench.py", "scripts"):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog=os.path.basename(sys.argv[0]),
+        description="JAX-aware source lint (stdlib ast; rule catalog in "
+                    "docs/STATIC_ANALYSIS.md). Exit 0 clean, 1 new "
+                    "findings, 2 usage.")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the package, "
+                        "bench.py and scripts/)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--baseline", default=default_baseline_path(),
+                   help="baseline file of accepted findings "
+                        "(default: statics/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline without stale entries")
+    a = p.parse_args(argv)
+
+    try:
+        findings, n_files = lint_paths(a.paths or default_targets())
+    except (OSError, SyntaxError, UnicodeDecodeError, ValueError) as e:
+        # a missing/unreadable/unparsable target is a USAGE problem (fix
+        # the path or the file), not a rule finding — the documented exit
+        # 2, with the offending file named, instead of a raw traceback
+        print(f"lint: cannot lint target: {e}", file=sys.stderr)
+        return 2
+    if a.no_baseline:
+        baseline = {"version": 1, "entries": []}
+    else:
+        try:
+            baseline = load_baseline(a.baseline)
+        except (ValueError, OSError) as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 2
+    new, suppressed, stale = apply_baseline(findings, baseline)
+
+    pruned = 0
+    if a.prune_baseline and stale and not a.no_baseline:
+        pruned = prune_baseline(a.baseline, baseline, stale)
+        stale = []
+
+    if a.json:
+        print(json.dumps({
+            "files": n_files,
+            "findings": [f.to_json() for f in new],
+            "suppressed": len(suppressed),
+            "stale_baseline_entries": stale,
+            "pruned": pruned,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+    for e in stale:
+        print(f"lint: warning: stale baseline entry (finding gone): "
+              f"{e['rule']} {e['file']}: {e['content']!r} — re-run with "
+              f"--prune-baseline to drop it", file=sys.stderr)
+    if pruned:
+        print(f"lint: pruned {pruned} stale baseline entr"
+              f"{'y' if pruned == 1 else 'ies'} from {a.baseline}",
+              file=sys.stderr)
+    if new:
+        print(f"lint: FAIL — {len(new)} new finding(s) across {n_files} "
+              f"file(s) ({len(suppressed)} baselined)", file=sys.stderr)
+        return 1
+    if not a.json:
+        print(f"lint: OK — 0 new findings across {n_files} file(s) "
+              f"({len(suppressed)} baselined"
+              + (f", {len(stale)} stale" if stale else "") + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Rule catalog for the JAX-aware source lint — stable IDs, one-line fixes.
+
+Pure stdlib (no jax import): like scripts/check_telemetry.py, this module
+must load by file path on any host the source lands on. The engine lives in
+`lint.py`; this module is the contract — rule IDs are STABLE (tests,
+baseline entries and docs key on them; retire a rule by deleting it, never
+by renaming).
+
+Scope vocabulary used below:
+
+  * "traced code" — the body of a function the engine marks as traced: it
+    is decorated with (or passed to) `jax.jit` / `jax.vmap` / `jax.grad` /
+    `jax.value_and_grad` / `jax.lax.scan` / `jax.lax.cond` /
+    `jax.lax.while_loop` / `shard_map` / `pallas_call` (directly, or one
+    `functools.partial` hop away), anywhere in the module. Matching is by
+    function NAME within the module — a deliberate over-approximation
+    (two defs sharing a name are both marked) that keeps the pass purely
+    syntactic.
+  * "anywhere" — the whole file.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    rationale: str
+    hint: str          # the one-line fix a finding prints
+    scope: str         # "traced" | "anywhere" (documentation; the engine
+    #                    hard-codes where each check runs)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit. `content` is the stripped source line — together with
+    (rule, file) it is the baseline suppression key, robust to the line
+    NUMBER drifting as unrelated code moves."""
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    content: str
+    hint: str = field(default="")
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message} [fix: {self.hint}]"
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.content)
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "content": self.content, "hint": self.hint}
+
+
+RULES = {r.id: r for r in [
+    Rule(
+        id="SYNC001",
+        title="host sync inside traced code",
+        rationale=(
+            "float()/.item()/.tolist()/np.asarray()/jax.device_get()/"
+            ".block_until_ready() on a tracer either fails at trace time or "
+            "forces a device->host round trip per call — the zero-per-step-"
+            "host-sync invariant (docs/OBSERVABILITY.md) dies one innocent "
+            "cast at a time. Host numpy math belongs in the builder, not "
+            "the traced body."),
+        hint="compute on-device with jnp, or hoist the host math into the "
+             "(untraced) builder",
+        scope="traced"),
+    Rule(
+        id="SYNC002",
+        title="wall clock / host RNG inside traced code",
+        rationale=(
+            "time.*, random.*, np.random.* and argless datetime calls "
+            "evaluate ONCE at trace time and freeze into the jaxpr as "
+            "constants — the step then replays a stale timestamp/draw "
+            "forever (and recompilation changes results). Timing belongs "
+            "on the host around the dispatch; randomness belongs to "
+            "jax.random keys threaded through the step."),
+        hint="move timing to the host caller; draw randomness from a "
+             "threaded jax.random key",
+        scope="traced"),
+    Rule(
+        id="SYNC003",
+        title="Python control flow on a traced value",
+        rationale=(
+            "`if`/`while` on a jnp/jax call result coerces a tracer to a "
+            "Python bool: TracerBoolConversionError at best, a silent "
+            "trace-time specialization at worst. Static metadata "
+            "(.shape/.dtype/.ndim) is exempt — branching on it is how the "
+            "builders specialize programs."),
+        hint="use jax.lax.cond / jnp.where, or branch on static "
+             ".shape/.dtype metadata",
+        scope="traced"),
+    Rule(
+        id="DT001",
+        title="float64 dtype in device code",
+        rationale=(
+            "TPUs have no f64 ALU; with jax_enable_x64 off the dtype "
+            "silently truncates, with it on every op doubles its HBM "
+            "footprint and the wire contract ('bf16/int8 strategies never "
+            "carry f32' — let alone f64) breaks. Host-side np.float64 "
+            "statistics are fine and out of scope; jnp.float64 anywhere, "
+            "f64 dtypes inside traced code, and jax_enable_x64 flips are "
+            "not."),
+        hint="use jnp.float32 (or bf16) on device; keep f64 to host numpy "
+             "post-processing",
+        scope="traced (plus jnp.float64 / jax_enable_x64 anywhere)"),
+    Rule(
+        id="COLL001",
+        title="collective without an explicit axis name",
+        rationale=(
+            "jax.lax.psum/pmean/all_gather/... with the axis argument "
+            "missing raises deep inside tracing with no source context — "
+            "or, under nested meshes, silently reduces over the wrong "
+            "axes. Every collective in this codebase names its axis "
+            "('dp'); the auditor then verifies the LOWERED program agrees."),
+        hint="pass the axis name explicitly (DATA_AXIS / axis_name=...)",
+        scope="anywhere"),
+    Rule(
+        id="EXC001",
+        title="bare/overbroad except that swallows framework signals",
+        rationale=(
+            "`except:` / `except Exception:` without a re-raise also "
+            "catches TrainingHealthError (deliberately NOT a RuntimeError "
+            "so health aborts pass through generic runtime handling — "
+            "telemetry/health.py) and CheckpointError — one careless "
+            "handler and a fatal-NaN abort reads as a handled hiccup. "
+            "Deliberate catch-alls (fault barriers around arbitrary user "
+            "callables) go in the baseline with a reason."),
+        hint="catch the specific exceptions, re-raise, or baseline with a "
+             "reason",
+        scope="anywhere"),
+    Rule(
+        id="MUT001",
+        title="mutable default argument",
+        rationale=(
+            "def f(xs=[]) evaluates the default ONCE at def time; every "
+            "call then shares (and mutates) the same object — state leaks "
+            "across calls and across tests. In a codebase built on pure "
+            "functions and explicit carries this is always a bug."),
+        hint="default to None and create the container inside the function",
+        scope="anywhere"),
+    Rule(
+        id="MUT002",
+        title="module global reassigned without a lock",
+        rationale=(
+            "`global NAME` + assignment in a function that takes no lock "
+            "is a check-then-act race the moment a second thread appears — "
+            "exactly the PR 6 tracer-registry race: serve's asyncio "
+            "threads and the Prometheus scrape thread share these "
+            "modules' process-wide singletons with the train loop."),
+        hint="guard the read-modify-write with a module-level "
+             "threading.Lock",
+        scope="anywhere"),
+]}

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Optional
 
@@ -217,6 +218,14 @@ class NullTracer:
 
 _NULL = NullTracer()
 _tracer = _NULL
+# enable()/disable() are close-then-swap sequences on the process-wide
+# tracer; the serve event loop's worker threads and the Prometheus scrape
+# thread call get_tracer() concurrently with a CLI toggling telemetry, so
+# the swap must be atomic (statics rule MUT002 — the PR 6 registry race,
+# closed rather than baselined). get_tracer() itself stays lock-free: it
+# reads one reference, and a reader racing a swap gets either tracer,
+# both valid.
+_TRACER_LOCK = threading.Lock()
 
 
 def get_tracer():
@@ -238,16 +247,18 @@ def enable(out_dir: str, *, process_index: Optional[int] = None) -> EventTrace:
     os.makedirs(out_dir, exist_ok=True)
     name = ("events.jsonl" if process_index == 0
             else f"events.rank{process_index}.jsonl")
-    if isinstance(_tracer, EventTrace):
-        _tracer.close()
-    _tracer = EventTrace(os.path.join(out_dir, name),
-                         process_index=process_index)
-    return _tracer
+    with _TRACER_LOCK:
+        if isinstance(_tracer, EventTrace):
+            _tracer.close()
+        _tracer = EventTrace(os.path.join(out_dir, name),
+                             process_index=process_index)
+        return _tracer
 
 
 def disable() -> None:
     """Close any active trace and restore the no-op tracer."""
     global _tracer
-    if isinstance(_tracer, EventTrace):
-        _tracer.close()
-    _tracer = _NULL
+    with _TRACER_LOCK:
+        if isinstance(_tracer, EventTrace):
+            _tracer.close()
+        _tracer = _NULL

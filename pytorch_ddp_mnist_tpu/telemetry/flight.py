@@ -146,6 +146,11 @@ def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
 
 
 _sigterm_installed = False
+# signal.signal only works from the main thread, but nothing stops two
+# threads RACING the installed-flag check (each would chain the other's
+# handler — the "never a loop" promise breaks); the lock makes the
+# check-then-install atomic (statics rule MUT002).
+_SIGTERM_LOCK = threading.Lock()
 
 
 def install_sigterm_flush() -> bool:
@@ -154,30 +159,31 @@ def install_sigterm_flush() -> bool:
     signals are unsupported; repeat installs are no-ops (one chain link,
     never a loop)."""
     global _sigterm_installed
-    if _sigterm_installed:
+    with _SIGTERM_LOCK:
+        if _sigterm_installed:
+            return True
+
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _flush_and_chain(signum, frame):
+                _RECORDER.dump(reason="SIGTERM")
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev is signal.SIG_IGN:
+                    # the run was launched ignoring SIGTERM (supervisor
+                    # choice): preserve that — dump, keep living
+                    return
+                else:
+                    # SIG_DFL (or an unknowable non-Python handler, prev is
+                    # None): restore the default disposition and re-deliver,
+                    # so the process still dies by SIGTERM (exit status
+                    # intact)
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+            signal.signal(signal.SIGTERM, _flush_and_chain)
+        except (ValueError, OSError):  # non-main thread / unsupported
+            return False               # platform
+        _sigterm_installed = True
         return True
-
-    try:
-        prev = signal.getsignal(signal.SIGTERM)
-
-        def _flush_and_chain(signum, frame):
-            _RECORDER.dump(reason="SIGTERM")
-            if callable(prev):
-                prev(signum, frame)
-            elif prev is signal.SIG_IGN:
-                # the run was launched ignoring SIGTERM (supervisor
-                # choice): preserve that — dump, keep living
-                return
-            else:
-                # SIG_DFL (or an unknowable non-Python handler, prev is
-                # None): restore the default disposition and re-deliver,
-                # so the process still dies by SIGTERM (exit status
-                # intact)
-                signal.signal(signum, signal.SIG_DFL)
-                os.kill(os.getpid(), signum)
-
-        signal.signal(signal.SIGTERM, _flush_and_chain)
-    except (ValueError, OSError):  # non-main thread / unsupported platform
-        return False
-    _sigterm_installed = True
-    return True

@@ -9,9 +9,16 @@ may lack `jax.monitoring`), so telemetry can be enabled unconditionally.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 _process_index: Optional[int] = None
+# One lock for this module's lazy singletons (_process_index,
+# _compile_counter): the Prometheus scrape thread and serve's event loop
+# resolve these concurrently with the train loop (statics rule MUT002).
+# Hot-path reads stay lock-free (double-checked; a benign duplicate
+# resolution is idempotent, a torn install is not).
+_LOCK = threading.Lock()
 
 
 def process_index_cached() -> int:
@@ -25,11 +32,15 @@ def process_index_cached() -> int:
     the first post-init call still resolves the real rank."""
     global _process_index
     if _process_index is None:
-        try:
-            import jax
-            _process_index = int(jax.process_index())
-        except Exception:
-            return 0
+        with _LOCK:
+            if _process_index is None:
+                try:
+                    import jax
+                    _process_index = int(jax.process_index())
+                except Exception:  # statics-baseline: any client error
+                    # pre-init (jax absent, backend down) deliberately
+                    # degrades to rank 0 without caching
+                    return 0
     return _process_index
 
 
@@ -57,23 +68,24 @@ def install_compile_listener(registry=None,
     global _compile_counter
     from .registry import get_registry
     reg = registry or get_registry()
-    if _compile_counter is not None:
-        # peek, don't create: a mismatched re-install must not leave a
-        # zero-reading counter behind in the unfed registry
-        return reg._counters.get(counter_name) is _compile_counter
-    try:
-        from jax import monitoring
-    except Exception:
-        return False  # no counter created: the stamp reads absent, not 0
-    counter = reg.counter(counter_name)
+    with _LOCK:
+        if _compile_counter is not None:
+            # peek, don't create: a mismatched re-install must not leave a
+            # zero-reading counter behind in the unfed registry
+            return reg._counters.get(counter_name) is _compile_counter
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False  # no counter created: the stamp reads absent, not 0
+        counter = reg.counter(counter_name)
 
-    def _on_duration(key: str, duration: float, **kw) -> None:
-        if "backend_compile" in key:
-            counter.inc()
+        def _on_duration(key: str, duration: float, **kw) -> None:
+            if "backend_compile" in key:
+                counter.inc()
 
-    monitoring.register_event_duration_secs_listener(_on_duration)
-    _compile_counter = counter
-    return True
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _compile_counter = counter
+        return True
 
 
 def record_engine_compiles(registry, compile_count: int,
@@ -94,7 +106,9 @@ def device_memory_stats() -> Optional[dict]:
         import jax
         stats = jax.local_devices()[0].memory_stats()
         return dict(stats) if stats else None
-    except Exception:
+    except (ImportError, RuntimeError, IndexError, AttributeError):
+        # jax absent / backend not up / zero devices / no memory_stats on
+        # this backend — all mean "no device memory picture", not an error
         return None
 
 
@@ -114,8 +128,8 @@ def host_rss_bytes() -> Optional[int]:
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         # Linux reports KiB, macOS bytes; this branch only runs off-Linux
         return int(rss) if os.uname().sysname == "Darwin" else int(rss) * 1024
-    except Exception:
-        return None
+    except (ImportError, AttributeError, OSError, ValueError):
+        return None  # no resource module / no uname: no RSS source
 
 
 def collect_memory(registry=None) -> dict:

@@ -63,6 +63,7 @@ from __future__ import annotations
 import math
 import os
 import signal
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -268,6 +269,13 @@ def _env_injector() -> FaultInjector:
     return FaultInjector(parse_faults(os.environ.get(FAULT_ENV)), rank=rank)
 
 
+# install()/get_injector() lazily (re)build the process-wide injector;
+# loader readahead threads hit fire() concurrently with a late install
+# (statics rule MUT002). fire()'s fast path reads one reference unlocked —
+# a reader racing a swap gets either injector, both consistent.
+_INJ_LOCK = threading.Lock()
+
+
 def install(extra: Optional[str] = None, rank: Optional[int] = None) -> "FaultInjector":
     """(Re)build the process-wide injector: $PDMT_FAULT specs + `extra`
     (the CLI --fault value), rank-gated to `rank` when given. Returns the
@@ -277,7 +285,8 @@ def install(extra: Optional[str] = None, rank: Optional[int] = None) -> "FaultIn
     inj.specs.extend(parse_faults(extra))
     if rank is not None:
         inj.rank = int(rank)
-    _INJECTOR = inj
+    with _INJ_LOCK:
+        _INJECTOR = inj
     return inj
 
 
@@ -290,7 +299,9 @@ def set_rank(rank: int) -> None:
 def get_injector() -> FaultInjector:
     global _INJECTOR
     if _INJECTOR is None:
-        _INJECTOR = _env_injector()
+        with _INJ_LOCK:
+            if _INJECTOR is None:
+                _INJECTOR = _env_injector()
     return _INJECTOR
 
 

@@ -138,10 +138,14 @@ def run_variant(argv, epochs: int):
         # Unfiltered tracebacks: a failed row's artifact error must carry
         # the real exception, not jax's "internal frames removed" banner
         # (which is all the r05 threefry-row failure recorded).
+        # PDMT_STATICS_STAMP=0: every cell would recompute the identical
+        # per-process lint+audit stamp; the matrix stamps ONCE at the
+        # artifact level instead (main(), the multichip_smoke pattern).
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=1200,
                              env=dict(os.environ,
-                                      JAX_TRACEBACK_FILTERING="off"))
+                                      JAX_TRACEBACK_FILTERING="off",
+                                      PDMT_STATICS_STAMP="0"))
     except subprocess.TimeoutExpired:
         return None, ["timeout after 1200s"]
     if out.returncode != 0:
@@ -309,10 +313,22 @@ def main(argv=None) -> int:
 
     if a.out:
         import datetime
+        info = _backend_info()
+        # One statics stamp per MATRIX, not per cell (cells run with
+        # PDMT_STATICS_STAMP=0). The audit traces example arrays, so it
+        # needs the live backend the info probe just verified — a
+        # backendless matrix (probe error recorded in `info`) keeps its
+        # artifact and simply lacks the stamp, the same degradation rule
+        # as the probe itself.
+        statics = None
+        if info.get("backend"):
+            from bench import statics_stamp_fields
+            statics = statics_stamp_fields()
         artifact = {"timestamp": datetime.datetime.now(
                         datetime.timezone.utc).isoformat(timespec="seconds"),
                     "epochs_per_window": epochs,
-                    **_backend_info(),
+                    **info,
+                    **({"statics": statics} if statics is not None else {}),
                     "variants": rows}
         with open(a.out, "w") as f:
             json.dump(artifact, f, indent=1)

@@ -26,6 +26,7 @@ Exit 0 on success; nonzero with the failed promise named on stderr.
 
 from __future__ import annotations
 
+import http.client
 import json
 import glob
 import os
@@ -96,7 +97,9 @@ def main() -> int:
              "within 120s", "".join(stderr_lines))
     try:
         body = urllib.request.urlopen(url, timeout=10).read().decode()
-    except Exception as e:
+    except (OSError, ValueError, http.client.HTTPException) as e:
+        # URLError/timeout are OSErrors; a half-started endpoint dropping
+        # mid-response raises http.client (BadStatusLine/IncompleteRead)
         proc.kill()
         fail(f"live scrape of {url} failed: {e}")
     for needle in ("# TYPE", "health_worst_severity_level"):

@@ -118,6 +118,7 @@ def main(argv=None) -> int:
         # sizes its own fake pool in a subprocess, so it cannot catch it).
         sys.path.insert(0, str(REPO))
         import jax
+        from bench import ddp_strategy_rows, statics_stamp_fields
         artifact["backend"] = jax.default_backend()
         artifact["device_kind"] = getattr(jax.devices()[0], "device_kind",
                                           str(jax.devices()[0]))
@@ -130,7 +131,6 @@ def main(argv=None) -> int:
                     f"{a.n_devices} for virtual CPU devices)")
             # n_devices pinned: with --fake the pool holds a +1 spare for
             # the dry run's simulator that must not join the measured mesh
-            from bench import ddp_strategy_rows
             rows = ddp_strategy_rows(per_chip_batch=a.batch_size,
                                      epochs=a.epochs,
                                      n_devices=a.n_devices,
@@ -146,6 +146,14 @@ def main(argv=None) -> int:
             print(f"multichip_smoke: strategy rows failed: {e}",
                   file=sys.stderr)
             artifact["strategies_error"] = str(e)[:500]
+        # Same env-gated statics stamp as every bench.py artifact line
+        # (the MULTICHIP JSON records whether the measured build honored
+        # the static contracts) — OUTSIDE the rows try, so a stamp
+        # problem can never be mislabeled a measurement failure; the
+        # stamp itself degrades to null fields + error, never raises.
+        statics = statics_stamp_fields()
+        if statics is not None:
+            artifact["statics"] = statics
     artifact["strategies"] = rows
 
     out = json.dumps(artifact, indent=2) + "\n"

@@ -9,9 +9,13 @@ import sys
 
 # The bench must run on the host backend here: the suite's virtual-CPU
 # setup (conftest) is in-process only, and a spawned bench would otherwise
-# grab a possibly-absent TPU tunnel.
+# grab a possibly-absent TPU tunnel. PDMT_STATICS_STAMP=0 keeps the many
+# bench subprocesses below off the per-process lint+audit stamp cost; the
+# stamp itself is pinned by test_bench_statics_stamp_in_artifact here and
+# tests/test_statics.py in-process.
 ENV = dict(os.environ, JAX_PLATFORMS="cpu",
-           XLA_FLAGS="--xla_force_host_platform_device_count=1")
+           XLA_FLAGS="--xla_force_host_platform_device_count=1",
+           PDMT_STATICS_STAMP="0")
 
 
 def _run(args):
@@ -118,6 +122,20 @@ def test_ddp_mode_contract_8_fake_devices():
             < 0.27 * by["pmean"]["bytes_on_wire_per_step_per_device"])
     assert 0 < by["int8"]["parity_max_abs_diff_vs_pmean"] < 1e-3
     assert not any(r["overlap"] for r in recs)
+
+
+def test_bench_statics_stamp_in_artifact():
+    """With the stamp enabled (the real-artifact default), every device-
+    mode JSON line carries statics: {lint_findings, audit_ok} — the
+    MULTICHIP/BENCH regression visibility the statics/ subsystem adds."""
+    env = dict(ENV, PDMT_STATICS_STAMP="1")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "eval", "--epochs", "2"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    (line,) = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    rec = json.loads(line)
+    assert rec["statics"] == {"lint_findings": 0, "audit_ok": True}
 
 
 def test_ddp_comm_knob_rejected_outside_ddp_mode():

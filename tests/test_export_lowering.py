@@ -198,77 +198,36 @@ def test_dp_ring_threefry_lowers():
 # ---------------------------------------------------------------------------
 # Gradient-communication strategies (parallel/collectives.py): every comm
 # program of the DP train step — pmean, bucketized reduce-scatter +
-# sharded update + all-gather, bf16-compressed allreduce — must lower for
-# an 8-device TPU mesh from this CPU host. The collectives are plain XLA
-# (no Mosaic), but psum_scatter/all_gather layouts and the bf16 reduce
-# still go through the client-side TPU lowering pipeline here.
+# sharded update + all-gather, bf16-compressed allreduce, int8 quantized —
+# must lower for an 8-device TPU mesh from this CPU host, AND honor the
+# structural contracts (collective kinds/counts, wire dtypes, ring-model
+# bytes). Both assertions run through statics/jaxpr_audit.py's SHARED
+# program builders: the program these tests export-lower is byte-for-byte
+# the program the auditor walks, so the tool and the tests cannot drift —
+# the ad-hoc per-test checks this section used to hand-write are now the
+# auditor's contract table (docs/STATIC_ANALYSIS.md).
 # ---------------------------------------------------------------------------
 
+from pytorch_ddp_mnist_tpu.statics import jaxpr_audit  # noqa: E402
+
 
 @pytest.mark.parametrize("comm,overlap", [
-    ("pmean", False), ("sharded", False), ("bf16", False),
+    ("pmean", False), ("sharded", False), ("bf16", False), ("int8", False),
     ("pmean", True), ("bf16", True)])
-def test_dp_comm_strategy_step_lowers(comm, overlap):
-    from pytorch_ddp_mnist_tpu.parallel.ddp import dp_step_program
-
-    n = 8
-    mesh = abstract_mesh((n,), ("dp",))
-    prog = dp_step_program(mesh, 0.01, comm=comm, overlap=overlap)
-    params = init_mlp(jax.random.PRNGKey(0))
-    key = jax.random.PRNGKey(1)
-    x = jnp.zeros((n * B, 784), jnp.float32)
-    y = jnp.zeros((n * B,), jnp.int32)
-    _export_tpu(prog, params, key, x, y)
-
-
-def test_dp_comm_int8_step_lowers():
-    # int8's all_to_all reduce-scatter / re-quantized all_gather phases +
-    # the error-feedback state threading (dp-sharded resid in AND out)
-    from pytorch_ddp_mnist_tpu.parallel import collectives
-    from pytorch_ddp_mnist_tpu.parallel.ddp import dp_step_program
-
-    n = 8
-    mesh = abstract_mesh((n,), ("dp",))
-    prog = dp_step_program(mesh, 0.01, comm="int8")
-    params = init_mlp(jax.random.PRNGKey(0))
-    key = jax.random.PRNGKey(1)
-    resid = jnp.zeros((n, collectives.comm_state_elems(params, n)),
-                      jnp.float32)
-    x = jnp.zeros((n * B, 784), jnp.float32)
-    y = jnp.zeros((n * B,), jnp.int32)
-    _export_tpu(prog, params, key, resid, x, y)
+def test_dp_comm_strategy_step_lowers_and_audits(comm, overlap):
+    prog, args = jaxpr_audit.build_step_program(comm, overlap)
+    _export_tpu(prog, *args)           # Mosaic/TPU client-side legality
+    report = jaxpr_audit.audit_program(prog, args, comm, overlap, "step")
+    assert report.ok and report.wire_bytes_program == report.wire_bytes_model
 
 
 @pytest.mark.parametrize("comm,overlap", [
-    ("sharded", False), ("bf16", False), ("pmean", True)])
-def test_dp_comm_strategy_scan_program_lowers(comm, overlap):
+    ("sharded", False), ("bf16", False), ("pmean", True), ("int8", False)])
+def test_dp_comm_strategy_scan_program_lowers_and_audits(comm, overlap):
     # the epoch-scanned form (make_dp_run_fn threads comm through
-    # _dp_step_body) over the same 8-device abstract mesh
-    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
-
-    n = 8
-    mesh = abstract_mesh((n,), ("dp",))
-    run = make_dp_run_fn(mesh, lr=0.01, comm=comm, overlap=overlap)
-    params = init_mlp(jax.random.PRNGKey(0))
-    key = jax.random.PRNGKey(1)
-    x_all = jnp.zeros((n * 2 * B, 784), jnp.uint8)
-    y_all = jnp.zeros((n * 2 * B,), jnp.int32)
-    idxs = jnp.zeros((1, 2, n * B), jnp.int32)
-    _export_tpu(run, params, key, x_all, y_all, idxs)
-
-
-def test_dp_comm_int8_scan_program_lowers():
-    from pytorch_ddp_mnist_tpu.parallel import collectives
-    from pytorch_ddp_mnist_tpu.train.scan import make_dp_run_fn
-
-    n = 8
-    mesh = abstract_mesh((n,), ("dp",))
-    run = make_dp_run_fn(mesh, lr=0.01, comm="int8")
-    params = init_mlp(jax.random.PRNGKey(0))
-    key = jax.random.PRNGKey(1)
-    resid = jnp.zeros((n, collectives.comm_state_elems(params, n)),
-                      jnp.float32)
-    x_all = jnp.zeros((n * 2 * B, 784), jnp.uint8)
-    y_all = jnp.zeros((n * 2 * B,), jnp.int32)
-    idxs = jnp.zeros((1, 2, n * B), jnp.int32)
-    _export_tpu(run, params, key, x_all, y_all, idxs, resid)
+    # _dp_step_body) over the same 8-device abstract mesh; int8 threads
+    # the dp-sharded error-feedback resid in AND out
+    run, args = jaxpr_audit.build_run_program(comm, overlap)
+    _export_tpu(run, *args)
+    report = jaxpr_audit.audit_program(run, args, comm, overlap, "run")
+    assert report.ok and report.wire_bytes_program == report.wire_bytes_model

@@ -1,0 +1,538 @@
+"""statics/ — the JAX-aware lint + jaxpr program auditor.
+
+Three layers, mirroring the subsystem:
+
+  * rule-by-rule fixture matrix: every rule ID in the catalog is exercised
+    with BOTH a triggering and a non-triggering source fixture, so a rule
+    that stops firing (or starts over-firing) is caught by name;
+  * baseline semantics: new finding fails, baselined finding passes, stale
+    entry warns, --prune-baseline rewrites the file;
+  * the program auditor: the full comm x overlap x {step, run} matrix
+    passes on the real step builders, a deliberately mismatched program
+    fails with the NAMED contract (the acceptance pin: an int8 audit fed
+    an f32-allreduce program dies on wire-dtype), and the audited wire
+    bytes equal the ddp.bytes_on_wire cost model to the byte.
+
+The lint engine itself is exercised through the public API (lint_source /
+lint_paths / main) — the same entry points `python -m pytorch_ddp_mnist_tpu
+lint` dispatches to.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from pytorch_ddp_mnist_tpu.statics import jaxpr_audit, lint
+from pytorch_ddp_mnist_tpu.statics.rules import RULES
+
+
+def rules_of(src):
+    return {f.rule for f in lint.lint_source(textwrap.dedent(src), "fix.py")}
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: (rule id, triggering source, non-triggering source)
+# ---------------------------------------------------------------------------
+
+FIXTURES = [
+    ("SYNC001", """
+        import jax
+        import numpy as np
+
+        def step(x):
+            return np.asarray(x) + 1
+
+        fast = jax.jit(step)
+     """, """
+        import numpy as np
+
+        def host_helper(x):          # not traced: np.asarray is host work
+            return np.asarray(x) + 1
+     """),
+    ("SYNC001", """
+        import jax
+
+        def step(x):
+            return float(x.sum())
+
+        fast = jax.jit(step)
+     """, """
+        import jax
+
+        def step(x):
+            return x.sum() * float("inf")   # literal: not a tracer coerce
+
+        fast = jax.jit(step)
+     """),
+    ("SYNC002", """
+        import jax
+        import time
+
+        def step(x):
+            return x * time.time()
+
+        fast = jax.jit(step)
+     """, """
+        import time
+
+        def measure(fn):             # untraced host timing is the POINT
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+     """),
+    ("SYNC003", """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            if jnp.max(x) > 0:
+                return x
+            return -x
+
+        fast = jax.jit(step)
+     """, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            if x.shape[0] > 2:       # static metadata: legal specialization
+                return x
+            if x.dtype == jnp.uint8:
+                return x
+            return -x
+
+        fast = jax.jit(step)
+     """),
+    ("DT001", """
+        import jax.numpy as jnp
+
+        SCALE = jnp.float64(1.0)
+     """, """
+        import numpy as np
+
+        def host_stats(losses):      # host f64 statistics are fine
+            return np.asarray(losses, np.float64).mean()
+     """),
+    ("DT001", """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            return x.astype(jnp.float64)
+
+        fast = jax.jit(step)
+     """, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            return x.astype(jnp.float32)
+
+        fast = jax.jit(step)
+     """),
+    ("COLL001", """
+        import jax
+
+        def body(g):
+            return jax.lax.psum(g)
+     """, """
+        import jax
+
+        def body(g):
+            a = jax.lax.psum(g, "dp")
+            b = jax.lax.pmean(g, axis_name="dp")
+            return a + b + jax.lax.axis_index("dp")
+     """),
+    ("EXC001", """
+        def fragile():
+            try:
+                work()
+            except Exception:
+                pass
+     """, """
+        def careful():
+            try:
+                work()
+            except ValueError:
+                pass
+            try:
+                work()
+            except Exception:
+                cleanup()
+                raise            # re-raising handlers don't swallow
+     """),
+    ("MUT001", """
+        def collect(item, acc=[]):
+            acc.append(item)
+            return acc
+     """, """
+        def collect(item, acc=None):
+            acc = [] if acc is None else acc
+            acc.append(item)
+            return acc
+     """),
+    ("MUT002", """
+        _CACHE = None
+
+        def get():
+            global _CACHE
+            if _CACHE is None:
+                _CACHE = build()
+            return _CACHE
+     """, """
+        import threading
+
+        _CACHE = None
+        _LOCK = threading.Lock()
+
+        def get():
+            global _CACHE
+            with _LOCK:
+                if _CACHE is None:
+                    _CACHE = build()
+            return _CACHE
+     """),
+]
+
+
+def test_every_rule_id_has_fixture_coverage():
+    covered = {rule for rule, _bad, _good in FIXTURES}
+    assert covered == set(RULES), (
+        f"rule catalog and fixture matrix drifted: "
+        f"uncovered={set(RULES) - covered} unknown={covered - set(RULES)}")
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         FIXTURES, ids=[f"{r}-{i}" for i, (r, _b, _g)
+                                        in enumerate(FIXTURES)])
+def test_rule_fires_on_bad_not_on_good(rule, bad, good):
+    assert rule in rules_of(bad), f"{rule} missed its triggering fixture"
+    assert rule not in rules_of(good), \
+        f"{rule} fired on its non-triggering fixture"
+
+
+def test_partial_hop_marks_traced():
+    # step = partial(body, ...) then lax.scan(step, ...) must mark `body`
+    src = """
+        import jax
+        from functools import partial
+
+        def body(carry, x, lr):
+            return carry + float(x), None
+
+        def run(xs):
+            step = partial(body, lr=0.1)
+            return jax.lax.scan(step, 0.0, xs)
+    """
+    assert "SYNC001" in rules_of(src)
+
+
+def test_decorated_jit_marks_traced():
+    src = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(x):
+            return x.item()
+    """
+    assert "SYNC001" in rules_of(src)
+
+
+def test_findings_carry_location_and_hint():
+    f = lint.lint_source("def f(xs=[]):\n    return xs\n", "somefile.py")[0]
+    assert (f.rule, f.path, f.line) == ("MUT001", "somefile.py", 1)
+    assert f.hint == RULES["MUT001"].hint
+    assert "xs=[]" in f.content
+    assert "somefile.py:1" in f.render()
+
+
+# ---------------------------------------------------------------------------
+# the real tree: zero unbaselined findings (the acceptance gate), and every
+# baseline entry carries a reason
+# ---------------------------------------------------------------------------
+
+def test_lint_runs_clean_on_the_real_package():
+    findings, n_files = lint.lint_paths(lint.default_targets())
+    baseline = lint.load_baseline(lint.default_baseline_path())
+    new, suppressed, stale = lint.apply_baseline(findings, baseline)
+    assert n_files > 40          # the package + bench.py + scripts
+    assert new == [], "unbaselined lint findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_lint_loads_by_file_path_without_framework():
+    # the check_telemetry.py discipline: the lint must run on hosts without
+    # jax or the package installed — loaded by file path, stdlib only
+    import subprocess
+    import sys
+    import pytorch_ddp_mnist_tpu.statics.lint as lint_mod
+    code = f"""
+import importlib.util, sys
+spec = importlib.util.spec_from_file_location("sl", {lint_mod.__file__!r})
+mod = importlib.util.module_from_spec(spec)
+sys.modules["sl"] = mod
+spec.loader.exec_module(mod)
+(f,) = mod.lint_source("def f(xs=[]):\\n    return xs\\n", "x.py")
+assert f.rule == "MUT001", f
+assert "jax" not in sys.modules and "pytorch_ddp_mnist_tpu" not in sys.modules
+print("ok")
+"""
+    out = subprocess.run([sys.executable, "-c", code], cwd="/tmp",
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and out.stdout.strip() == "ok", out.stderr
+
+
+def test_baseline_entries_all_have_reasons():
+    baseline = lint.load_baseline(lint.default_baseline_path())
+    assert baseline["entries"], "the committed baseline should carry the " \
+                                "deliberate catch-all handlers"
+    for e in baseline["entries"]:
+        assert e["reason"].strip(), f"reasonless baseline entry: {e}"
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics through the CLI entry point (in-process main())
+# ---------------------------------------------------------------------------
+
+BAD_SRC = "def f(xs=[]):\n    return xs\n"
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_new_finding_fails(tmp_path, capsys):
+    target = _write(tmp_path, "mod.py", BAD_SRC)
+    empty = _write(tmp_path, "base.json",
+                   '{"version": 1, "entries": []}')
+    rc = lint.main([target, "--baseline", empty])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "MUT001" in out.out and "FAIL" in out.err
+
+
+def test_baselined_finding_passes(tmp_path, capsys):
+    target = _write(tmp_path, "mod.py", BAD_SRC)
+    findings = lint.lint_source(BAD_SRC, target)  # path must match verbatim
+    entry = {"rule": findings[0].rule, "file": findings[0].path,
+             "content": findings[0].content, "reason": "test fixture"}
+    base = _write(tmp_path, "base.json",
+                  json.dumps({"version": 1, "entries": [entry]}))
+    rc = lint.main([target, "--baseline", base])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "1 baselined" in out.out
+    assert "stale" not in out.err
+
+
+def test_stale_entry_warns_and_prune_rewrites(tmp_path, capsys):
+    target = _write(tmp_path, "mod.py", "x = 1\n")   # clean file
+    stale_entry = {"rule": "MUT001", "file": "gone.py",
+                   "content": "def f(xs=[]):", "reason": "obsolete"}
+    base = _write(tmp_path, "base.json",
+                  json.dumps({"version": 1, "entries": [stale_entry]}))
+    rc = lint.main([target, "--baseline", base])
+    out = capsys.readouterr()
+    assert rc == 0                      # stale-only is clean...
+    assert "stale baseline entry" in out.err   # ...but warned
+
+    rc = lint.main([target, "--baseline", base, "--prune-baseline"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "pruned 1 stale" in out.err
+    assert json.loads((tmp_path / "base.json").read_text())["entries"] == []
+    # pruned file: a re-run is quiet
+    rc = lint.main([target, "--baseline", base])
+    assert "stale" not in capsys.readouterr().err and rc == 0
+
+
+def test_malformed_baseline_is_usage_error(tmp_path, capsys):
+    target = _write(tmp_path, "mod.py", "x = 1\n")
+    base = _write(tmp_path, "base.json",
+                  '{"version": 1, "entries": [{"rule": "EXC001"}]}')
+    rc = lint.main([target, "--baseline", base])
+    assert rc == 2
+    assert "missing" in capsys.readouterr().err
+
+
+def test_json_report_shape(tmp_path, capsys):
+    target = _write(tmp_path, "mod.py", BAD_SRC)
+    empty = _write(tmp_path, "base.json", '{"version": 1, "entries": []}')
+    rc = lint.main([target, "--baseline", empty, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["files"] == 1 and report["suppressed"] == 0
+    (finding,) = report["findings"]
+    assert finding["rule"] == "MUT001" and finding["line"] == 1
+
+
+def test_front_door_dispatches_lint(tmp_path, capsys):
+    # `python -m pytorch_ddp_mnist_tpu lint` routes here with argv passed
+    # through (and the exit code preserved)
+    from pytorch_ddp_mnist_tpu.__main__ import main as front_door
+    target = _write(tmp_path, "mod.py", BAD_SRC)
+    empty = _write(tmp_path, "base.json", '{"version": 1, "entries": []}')
+    rc = front_door(["lint", target, "--baseline", empty])
+    assert rc == 1
+    assert "MUT001" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# the program auditor
+# ---------------------------------------------------------------------------
+
+ALL_CONFIGS = [(c, ov) for c in jaxpr_audit.COMMS for ov in (False, True)]
+
+
+@pytest.mark.parametrize("comm,overlap", ALL_CONFIGS,
+                         ids=[f"{c}{'-overlap' if ov else ''}"
+                              for c, ov in ALL_CONFIGS])
+def test_audit_step_matrix_passes(comm, overlap):
+    report = jaxpr_audit.audit_step_program(comm, overlap)
+    assert report.ok
+    assert report.wire_bytes_program == report.wire_bytes_model
+    assert report.n_buckets == 1          # 118k-param MLP: one bucket
+
+
+@pytest.mark.parametrize("comm,overlap",
+                         [("pmean", False), ("sharded", False),
+                          ("bf16", True), ("int8", False)])
+def test_audit_run_matrix_passes(comm, overlap):
+    # the fit_cached scan body: collectives audited at the innermost scan
+    # depth (the per-run pmean re-replication is correctly outside)
+    report = jaxpr_audit.audit_run_program(comm, overlap)
+    assert report.ok and report.form == "run"
+    assert report.wire_bytes_program == report.wire_bytes_model
+
+
+def test_audit_multi_bucket_layout():
+    # a small bucket budget splits the MLP into 5 buckets; counts and the
+    # byte model must follow the layout, not the single-bucket constants
+    report = jaxpr_audit.audit_step_program("int8", bucket_elems=16384)
+    assert report.n_buckets == 5
+    assert report.wire_bytes_program == report.wire_bytes_model
+    from pytorch_ddp_mnist_tpu.parallel import collectives
+    import jax
+    from pytorch_ddp_mnist_tpu.models.mlp import init_mlp
+    assert report.wire_bytes_model == collectives.bytes_on_wire(
+        init_mlp(jax.random.PRNGKey(0)), 8, "int8", bucket_elems=16384)
+
+
+def test_broken_program_fails_wire_dtype():
+    # THE acceptance pin: an "int8" path that actually allreduces f32
+    # gradients (here: the pmean program audited under the int8 contract)
+    # must fail with the NAMED wire-dtype contract, exit-code-visible.
+    prog, args = jaxpr_audit.build_step_program("pmean")
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_program(prog, args, "int8", False, "step")
+    assert exc.value.contract == "wire-dtype"
+    assert "float32" in str(exc.value)
+
+
+def test_broken_program_fails_collective_shape():
+    # a sharded program audited as pmean: right dtypes, wrong collective
+    # kinds — the shape contract catches what the dtype contract cannot
+    prog, args = jaxpr_audit.build_step_program("sharded")
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_program(prog, args, "pmean", False, "step")
+    assert exc.value.contract == "collective-shape"
+
+
+def test_cost_model_drift_fails_wire_bytes(monkeypatch):
+    from pytorch_ddp_mnist_tpu.parallel import collectives
+    real = collectives.bytes_on_wire
+    monkeypatch.setattr(collectives, "bytes_on_wire",
+                        lambda *a, **k: real(*a, **k) + 1)
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_step_program("bf16")
+    assert exc.value.contract == "wire-bytes"
+
+
+def test_synthetic_contracts_f64_callback_axis():
+    mk = lambda **kw: jaxpr_audit.CollectiveOp(  # noqa: E731
+        prim=kw.get("prim", "psum"), kind=kw.get("kind", "allreduce"),
+        dtype=kw.get("dtype", "float32"), in_elems=kw.get("in_elems", 100),
+        out_elems=kw.get("out_elems", 100),
+        axes=kw.get("axes", ("dp",)), scan_depth=0, eqn_id=1)
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_collected([], [("add", "float64")], [],
+                                    "pmean", False, "step")
+    assert exc.value.contract == "no-f64"
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_collected([], [], ["pure_callback"],
+                                    "pmean", False, "step")
+    assert exc.value.contract == "no-callback"
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_collected([mk(axes=("mp",))], [], [],
+                                    "pmean", False, "step")
+    assert exc.value.contract == "collective-axis"
+
+
+def test_audit_cli_exit_codes(capsys, monkeypatch):
+    rc = jaxpr_audit.main(["--comm", "int8", "--form", "step"])
+    out = capsys.readouterr()
+    assert rc == 0 and "every contract holds" in out.out
+
+    monkeypatch.setattr(
+        jaxpr_audit, "audit_matrix",
+        lambda *a, **k: (_ for _ in ()).throw(jaxpr_audit.AuditViolation(
+            "wire-dtype", "comm=int8", "patched")))
+    rc = jaxpr_audit.main(["--comm", "int8", "--form", "step"])
+    out = capsys.readouterr()
+    assert rc == 3 and "[wire-dtype]" in out.err
+
+
+def test_audit_cli_json_report(capsys):
+    rc = jaxpr_audit.main(["--comm", "pmean", "--form", "step", "--json"])
+    reports = json.loads(capsys.readouterr().out)
+    assert rc == 0 and len(reports) == 1
+    (r,) = reports
+    assert r["comm"] == "pmean" and r["ok"]
+    assert r["wire_bytes_program"] == r["wire_bytes_model"]
+    assert all(op["axes"] == ["dp"] for op in r["payload_ops"])
+
+
+def test_bench_statics_stamp():
+    # the artifact-line stamp: lint count + audit verdict, process-cached
+    import bench
+    bench.statics_stamp.cache_clear()
+    stamp = bench.statics_stamp()
+    assert stamp == {"lint_findings": 0, "audit_ok": True}
+    assert bench.statics_stamp() is stamp       # cached second read
+
+
+def test_bench_statics_stamp_never_raises(monkeypatch):
+    # a broken lint surface (unparsable scratch file, malformed baseline)
+    # must degrade to null fields + error, never kill a finished
+    # measurement (the registry_stamp contract)
+    import bench
+    from pytorch_ddp_mnist_tpu.statics import lint as lint_mod
+    bench.statics_stamp.cache_clear()
+    monkeypatch.setattr(
+        lint_mod, "load_baseline",
+        lambda p: (_ for _ in ()).throw(ValueError("malformed baseline")))
+    try:
+        stamp = bench.statics_stamp()
+    finally:
+        bench.statics_stamp.cache_clear()   # don't cache the broken stamp
+    assert stamp["lint_findings"] is None
+    assert "malformed baseline" in stamp["error"]
+    assert stamp["audit_ok"] is True        # the audit half still ran
+
+
+def test_lint_cli_unparsable_target_is_usage_error(tmp_path, capsys):
+    # documented exit contract: unreadable/unparsable target -> 2 (usage),
+    # named on stderr — never a raw traceback
+    rc = lint.main([str(tmp_path / "missing.py")])
+    assert rc == 2
+    assert "cannot lint target" in capsys.readouterr().err
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    rc = lint.main([str(bad)])
+    assert rc == 2
+    assert "broken.py" in capsys.readouterr().err
