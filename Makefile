@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -22,6 +22,27 @@ bench:
 # engine) end-to-end on the host backend: one JSON line or a nonzero exit.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --mode serve --requests 300 --offered_rps 1500
+
+# Request-tracing round trip (docs/OBSERVABILITY.md §Request tracing): a
+# loadgen burst against a live traced engine, then the emitted
+# request/batch spans are schema- AND contract-validated (non-empty
+# request_id, batch links resolving, pipeline-ordered stages, serve.*
+# registry metrics present), the tail-latency attribution report renders
+# (per-stage p50/p95/p99, %-of-e2e, slowest-request trees), and the
+# Perfetto export with request/batch flow arrows is checked non-empty.
+serve-trace-smoke:
+	rm -rf /tmp/pdmt_serve_trace
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu serve \
+		--selftest 300 --offered_rps 1500 \
+		--telemetry /tmp/pdmt_serve_trace
+	$(PY) scripts/check_telemetry.py --require serve. /tmp/pdmt_serve_trace
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --serve /tmp/pdmt_serve_trace
+	$(PY) -m pytorch_ddp_mnist_tpu trace export /tmp/pdmt_serve_trace \
+		-o /tmp/pdmt_serve_trace/trace.chrome.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/pdmt_serve_trace/trace.chrome.json')); \
+		assert any(e.get('ph') == 's' for e in d['traceEvents']), \
+		'no request->batch flow arrows in chrome trace'"
 
 # Observability smoke: 1 CPU epoch with --telemetry, then schema-validate
 # the emitted JSONL trace (nonzero exit on malformed/unordered records).
@@ -107,8 +128,8 @@ audit-program:
 static-smoke: lint audit-program
 
 # The committed pre-merge gate: static contracts first (seconds), then the
-# fast test tier.
-check: static-smoke test-fast
+# serve request-tracing round trip (also seconds), then the fast test tier.
+check: static-smoke serve-trace-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -385,6 +385,10 @@ def _serve_bench(a) -> None:
                         if rps else None),
         "offered_rps": out["offered_rps"],
         "p50_ms": lat["p50"], "p95_ms": lat["p95"], "p99_ms": lat["p99"],
+        # client-perceived minus server-side e2e at matched percentiles:
+        # the front-door (event-loop scheduling / transport) overhead the
+        # server histogram cannot see (serve/loadgen.py)
+        "front_door_overhead_ms": out["front_door_overhead_ms"],
         "reject_rate": out["reject_rate"],
         # the absolute queue-rejection count (reject_rate alone cannot
         # distinguish 1/10 from 100/1000): overload behavior is auditable

@@ -26,6 +26,13 @@ Two front doors over the same `serve.ServeService` request path:
 Without `--checkpoint` the engine serves freshly initialized params
 (`--seed`) — the full path exercisable anywhere, including under
 JAX_PLATFORMS=cpu where the whole subsystem behaves identically.
+
+`--telemetry DIR` turns on request-scoped tracing: every request/batch
+leaves schema-v1 spans under DIR (read back with `trace report --serve
+DIR`), and the drain flushes the slowest-request exemplars + any rejects
+to a flight-recorder dump beside them. `--admit predicted_p99` switches
+admission from the raw depth budget to the SLO boundary (`--slo_p99_ms`)
+— docs/SERVING.md §Admission modes.
 """
 
 from __future__ import annotations
@@ -68,12 +75,19 @@ async def handle_request(service, req: dict) -> dict:
       {"op": "stats"}          -> {"registry": <telemetry registry
                                    snapshot — serve.* counters/histograms,
                                    compile counter, memory gauges>,
-                                   "serve": <dashboard snapshot>}
+                                   "serve": <dashboard snapshot, incl. the
+                                   "attribution" section: per-stage
+                                   p50/p99 under the serve/tracing.py
+                                   stage names + current predicted_p99 —
+                                   the same names the JSONL trace uses,
+                                   so the health op and the trace can
+                                   never disagree>}
       {"op": "health"}         -> the LIVE health view: the rolling-window
                                    SLO monitor (rolling p50/p99, observed
-                                   service rate over the recent window —
-                                   what SLO-aware admission will consume)
-                                   plus the instantaneous queue depth
+                                   service rate over the recent window),
+                                   the predicted p99 the admission SLO
+                                   boundary consumes, plus the
+                                   instantaneous queue depth
     """
     op = req.get("op")
     if op == "metrics":
@@ -85,8 +99,12 @@ async def handle_request(service, req: dict) -> dict:
         return {"ok": True, "registry": reg.snapshot(),
                 "serve": service.metrics.snapshot()}
     if op == "health":
+        pred = service.metrics.predicted_p99()
         return {"ok": True,
                 "health": {**service.metrics.slo.snapshot(),
+                           "predicted_p99_ms": (round(pred * 1e3, 3)
+                                                if pred is not None
+                                                else None),
                            "queue_depth": service.admission.depth,
                            "draining": service.admission.draining}}
     pixels = np.asarray(req["pixels"])
@@ -160,6 +178,20 @@ def main(argv=None) -> int:
     p.add_argument("--queue_depth", type=int, default=256,
                    help="admission budget: in-flight requests beyond this "
                         "are rejected with a retry-after hint")
+    p.add_argument("--admit", choices=("depth", "predicted_p99"),
+                   default="depth",
+                   help="admission mode: raw queue-depth budget, or reject "
+                        "when the PREDICTED p99 (rolling p99 + queue-drain "
+                        "time from the live SLO window) would bust "
+                        "--slo_p99_ms (docs/SERVING.md §Admission)")
+    p.add_argument("--slo_p99_ms", type=float, default=50.0,
+                   help="the p99 SLO (ms) the predicted_p99 admission mode "
+                        "protects; ignored under --admit depth")
+    p.add_argument("--telemetry", default=None, metavar="DIR",
+                   help="emit request/batch spans (schema-v1 JSONL) and "
+                        "drain-time flight dumps under DIR; read back with "
+                        "`trace report --serve DIR` "
+                        "(docs/OBSERVABILITY.md §Request tracing)")
     p.add_argument("--input_dtype", choices=("float32", "uint8"),
                    default="float32",
                    help="request payload dtype: pre-normalized float32 "
@@ -184,9 +216,12 @@ def main(argv=None) -> int:
             p.error(f"--{name} must be >= 1")
     if a.max_delay_ms < 0:
         p.error("--max_delay_ms must be >= 0")
+    if a.admit == "predicted_p99" and a.slo_p99_ms <= 0:
+        p.error("--slo_p99_ms must be > 0 under --admit predicted_p99")
 
     from ..serve import ServeService
     from .. import telemetry
+    from ..telemetry import flight
     # Serve metrics publish into the process-wide registry so the
     # {"op": "stats"} endpoint answers one unified snapshot; the compile
     # listener is armed BEFORE the engine warms its bucket ladder so the
@@ -194,13 +229,38 @@ def main(argv=None) -> int:
     # be visible evidence of a cold compile).
     telemetry.install_compile_listener()
     reg = telemetry.get_registry()
+    if a.telemetry:
+        # request/batch spans into DIR (the tracer swap happens BEFORE the
+        # first request, so every request_id is on the record), and the
+        # flight recorder's drain dump lands beside the trace
+        telemetry.enable(a.telemetry)
+        flight.set_dump_dir(a.telemetry)
     engine = build_engine(a)
     telemetry.record_engine_compiles(reg, engine.compile_count)
-    service = ServeService(engine, max_delay_ms=a.max_delay_ms,
-                           max_depth=a.queue_depth, registry=reg)
+    service = ServeService(
+        engine, max_delay_ms=a.max_delay_ms, max_depth=a.queue_depth,
+        registry=reg, admit_mode=a.admit,
+        slo_p99_s=(a.slo_p99_ms / 1e3 if a.admit == "predicted_p99"
+                   else None))
     print(f"engine warm: buckets={list(engine.buckets)} "
           f"compiles={engine.compile_count} "
-          f"input_dtype={engine.input_dtype}", file=sys.stderr, flush=True)
+          f"input_dtype={engine.input_dtype} admit={a.admit}",
+          file=sys.stderr, flush=True)
+
+    def _close_telemetry(reason: str, dump: bool = True) -> None:
+        """End-of-run trace hygiene: stamp the final registry snapshot
+        (check_telemetry --require serve. gates on it), flush the flight
+        ring (slow-request exemplars + rejects; skipped when the TCP
+        drain already dumped it), close the JSONL file."""
+        if not a.telemetry:
+            return
+        telemetry.get_tracer().snapshot(reg)
+        if dump:
+            path = flight.dump(reason=reason)
+            if path:
+                print(f"flight recorder: {path}", file=sys.stderr,
+                      flush=True)
+        telemetry.disable()
 
     if a.selftest is not None:
         if a.selftest < 1:
@@ -209,10 +269,12 @@ def main(argv=None) -> int:
         out = run_loadgen(service, offered_rps=a.offered_rps,
                           n_requests=a.selftest, seed=a.seed)
         out.pop("predictions")          # counters, not payloads
+        _close_telemetry("serve selftest")
         print(json.dumps(out))
         return 0
 
     asyncio.run(_serve_tcp(service, a.host, a.port))
+    _close_telemetry("serve drain", dump=False)  # _serve_tcp just dumped
     print(json.dumps(service.metrics.snapshot()))
     return 0
 

@@ -5,8 +5,18 @@ JSONL event traces training and serving emit.
     python -m pytorch_ddp_mnist_tpu trace report /tmp/obs --json > new.json
     python -m pytorch_ddp_mnist_tpu trace report /tmp/obs \
         --baseline old_run/ --threshold 1.5      # exit 3 past threshold
+    python -m pytorch_ddp_mnist_tpu trace report --serve /tmp/serve_obs
+                                                 # serve-path attribution
     python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
                                                  # load in Perfetto
+
+`report --serve` reads the request/batch spans a `--telemetry`-enabled
+serve run emits (serve/tracing.py) and prints the tail-latency
+attribution: per-stage p50/p95/p99 and each stage's share of end-to-end
+time (admission / queue / batch_form / pad_h2d / compute / reply — they
+telescope, so the shares genuinely decompose the e2e story), batch
+occupancy / padding waste / coalesce-reason counts, and the slowest-K
+requests as full stage trees.
 
 `report` merges every per-process `events*.jsonl` under the target (a
 --telemetry dir, a single file, or several), reconstructs the span tree,
@@ -92,6 +102,28 @@ def _load_report(target: str):
 def _cmd_report(a) -> int:
     from ..telemetry import analysis
 
+    if a.serve:
+        # the serve-path attribution report (docs/OBSERVABILITY.md
+        # §Request tracing): per-stage p50/p95/p99 + %-of-e2e, batch
+        # occupancy/padding waste, slowest-request exemplar trees
+        paths = analysis.trace_files(a.target)
+        if not paths:
+            print(f"trace report: {a.target}: no events*.jsonl found",
+                  file=sys.stderr)
+            return 1
+        report = analysis.serve_report(paths)
+        if report["requests"] == 0:
+            print(f"trace report: {a.target}: no serve.request spans "
+                  f"(serve with --telemetry DIR to emit them)",
+                  file=sys.stderr)
+            return 1
+        if a.json:
+            print(json.dumps(report,
+                             indent=2 if sys.stdout.isatty() else None))
+        else:
+            print(analysis.format_serve_report(report))
+        return 0
+
     report, err = _load_report(a.target)
     if err:
         print(f"trace report: {err}", file=sys.stderr)
@@ -156,6 +188,12 @@ def main(argv=None) -> int:
     r.add_argument("target",
                    help="a --telemetry dir (merges every events*.jsonl), "
                         "one trace file, or a saved --json report")
+    r.add_argument("--serve", action="store_true",
+                   help="the serve-path tail-latency attribution report "
+                        "instead of the train phase report: per-stage "
+                        "p50/p95/p99 + %% of e2e, batch occupancy and "
+                        "padding waste, slowest-request exemplars "
+                        "(docs/OBSERVABILITY.md §Request tracing)")
     r.add_argument("--baseline", metavar="OLD", default=None,
                    help="diff against another run (trace dir/file or saved "
                         "--json report); exit 3 when any phase p50/p95 "
@@ -177,8 +215,12 @@ def main(argv=None) -> int:
     e.set_defaults(run=_cmd_export)
 
     a = p.parse_args(argv)
-    if a.cmd == "report" and a.threshold <= 0:
-        p.error("--threshold must be > 0")
+    if a.cmd == "report":
+        if a.threshold <= 0:
+            p.error("--threshold must be > 0")
+        if a.serve and a.baseline:
+            p.error("--serve has no baseline gate (the step-time/"
+                    "efficiency gates are the non-serve report's)")
     return a.run(a)
 
 

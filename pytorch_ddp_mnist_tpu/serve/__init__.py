@@ -5,11 +5,14 @@ package is the other half of the north star ("serves heavy traffic"): an
 engine that pre-compiles a bucketed ladder of batch shapes so no request
 ever pays a cold XLA compile (`engine.py`), an asyncio micro-batcher that
 coalesces requests up to a size/deadline knob (`batcher.py`), bounded-queue
-admission control with backpressure and graceful drain (`admission.py`),
-latency-percentile metrics (`metrics.py`), and an open-loop Poisson load
-generator (`loadgen.py`). `ServeService` wires them into the one request
-path every front door (cli/serve.py TCP server, bench.py --mode serve,
-tests) shares.
+admission control with backpressure, graceful drain and an optional
+predicted-p99 SLO boundary (`admission.py`), latency-percentile metrics
+with per-stage attribution (`metrics.py`), request-scoped stage tracing —
+request_id at the front door, a telescoped admission/queue/batch_form/
+pad_h2d/compute/reply breakdown at the back (`tracing.py`) — and an
+open-loop Poisson load generator (`loadgen.py`). `ServeService` wires them
+into the one request path every front door (cli/serve.py TCP server,
+bench.py --mode serve, tests) shares.
 
 Everything runs identically under JAX_PLATFORMS=cpu — the full request path
 is exercised by tier-1 tests without hardware.
@@ -19,66 +22,89 @@ from __future__ import annotations
 
 import asyncio
 
-from .admission import AdmissionController, Rejected  # noqa: F401
+from .admission import ADMIT_MODES, AdmissionController, Rejected  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
 from .engine import InferenceEngine, bucket_ladder  # noqa: F401
 from .metrics import LatencyHistogram, ServeMetrics, SLOWindow  # noqa: F401
+from .tracing import ServeTracer  # noqa: F401
 
 
 class ServeService:
-    """admission -> batcher -> engine, with per-request latency metrics.
+    """admission -> batcher -> engine, with per-request latency metrics
+    and request-scoped stage tracing.
 
     `handle(row)` is the whole request path: admit (or raise `Rejected`),
     coalesce, run, scatter, record. Construction wires the metrics' queue-
-    depth gauge to the controller and the batcher's occupancy recorder to
-    the same metrics object, so a snapshot is always internally consistent.
+    depth gauge to the controller, the batcher's occupancy recorder to the
+    same metrics object, and one `ServeTracer` (serve/tracing.py) through
+    all three — every request gets a request_id at the front door and a
+    per-stage latency breakdown at the back, so a snapshot is always
+    internally consistent AND decomposable.
+
+    `admit_mode="predicted_p99"` (+ `slo_p99_s`) switches admission from
+    the raw depth budget to the SLO boundary: reject when the metrics'
+    predicted p99 (rolling p99 + queue-drain time) would bust the SLO —
+    see serve/admission.py.
     """
 
     def __init__(self, engine: InferenceEngine, *, max_batch=None,
                  max_delay_ms: float = 2.0, max_depth: int = 256,
-                 retry_after_s: float = 0.05, clock=None, registry=None):
+                 retry_after_s: float = 0.05, clock=None, registry=None,
+                 admit_mode: str = "depth", slo_p99_s=None):
         import time
         clock = clock or time.monotonic
         self.engine = engine
-        self.admission = AdmissionController(max_depth,
-                                             retry_after_s=retry_after_s)
         # registry=None keeps the service hermetic (its own private
         # registry); the CLI/bench front doors pass telemetry.get_registry()
         # so serve.* metrics publish into the process-wide snapshot.
         self.metrics = ServeMetrics(depth_fn=lambda: self.admission.depth,
                                     clock=clock, registry=registry)
+        self.admission = AdmissionController(
+            max_depth, retry_after_s=retry_after_s, mode=admit_mode,
+            slo_p99_s=slo_p99_s,
+            predictor=(self.metrics.predicted_p99
+                       if admit_mode == "predicted_p99" else None))
+        self.tracer = ServeTracer(clock=clock, metrics=self.metrics)
         self.batcher = MicroBatcher(engine, max_batch=max_batch,
                                     max_delay_ms=max_delay_ms,
-                                    metrics=self.metrics, clock=clock)
+                                    metrics=self.metrics, clock=clock,
+                                    tracer=self.tracer)
         self.clock = clock
 
     async def handle(self, row) -> int:
         """Serve one request row -> predicted class. Raises `Rejected`
         under backpressure or drain (metrics count it either way)."""
-        self.metrics.record_arrival()
+        rctx = self.tracer.begin()      # request_id + arrival stamp, even
+        self.metrics.record_arrival()   # for requests admission refuses
         try:
             self.admission.admit()
         except Rejected:
             self.metrics.record_reject()
             raise
+        self.tracer.admitted(rctx)
         t0 = self.clock()
         try:
-            pred = await self.batcher.submit(row)
+            pred = await self.batcher.submit(row, rctx)
         except Exception:
             # admitted but errored (bad payload, engine failure): counted —
             # a fault storm must not read as a healthy low-traffic interval
             self.metrics.record_failure()
+            self.tracer.finish(rctx, ok=False)
             raise
         finally:
             self.admission.release()
         self.metrics.record_done(self.clock() - t0)
+        self.tracer.finish(rctx, ok=True)
         return pred
 
     async def shutdown(self) -> None:
-        """Graceful drain: refuse new work, serve everything admitted."""
+        """Graceful drain: refuse new work, serve everything admitted,
+        then leave the slowest-request exemplar trees in the flight ring
+        (the post-mortem the drain-time dump carries)."""
         self.admission.begin_drain()
         await self.batcher.drain()
         await self.admission.drained()
+        self.tracer.flush_exemplars()
 
 
 def run_until_drained(service: ServeService, coro):

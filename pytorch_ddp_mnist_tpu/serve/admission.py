@@ -13,14 +13,34 @@ the batcher protects the MXU).
 Shutdown is a drain, not a drop: `begin_drain()` closes the door (new
 arrivals rejected as draining) while everything already admitted runs to
 completion; `await drained()` returns once in-flight work hits zero.
+
+Two admission modes (cli/serve.py `--admit`, docs/SERVING.md):
+
+  * `depth` (default): reject when in-flight depth hits `max_depth` — the
+    original bounded queue. Simple, but it only reacts AFTER the queue is
+    long: every request admitted on the way there still eats the full
+    backlog's latency.
+  * `predicted_p99`: reject when the PREDICTED p99 — the rolling observed
+    p99 plus this request's expected queue-drain time (depth / observed
+    service rate, both from the serve metrics' SLO window) — exceeds
+    `slo_p99_s`. This turns the SLO itself into the admission boundary:
+    under overload the controller starts refusing while the queue is
+    still short, keeping the ADMITTED population inside its latency
+    budget instead of uniformly degrading everyone (ROADMAP item 4's
+    SLO-aware admission). `max_depth` stays as the memory backstop, the
+    mode degrades to it until the predictor has observations, and an
+    EMPTY server (depth 0) always admits — the probe that refreshes a
+    stale window, without which a transient overload would reject forever.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Optional
+from typing import Callable, Optional
 
 from ..telemetry import flight
+
+ADMIT_MODES = ("depth", "predicted_p99")
 
 
 class Rejected(Exception):
@@ -34,14 +54,34 @@ class Rejected(Exception):
 
 
 class AdmissionController:
-    def __init__(self, max_depth: int = 256, *, retry_after_s: float = 0.05):
+    def __init__(self, max_depth: int = 256, *, retry_after_s: float = 0.05,
+                 mode: str = "depth", slo_p99_s: Optional[float] = None,
+                 predictor: Optional[Callable[[], Optional[float]]] = None):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1; got {max_depth}")
+        if mode not in ADMIT_MODES:
+            raise ValueError(f"mode must be one of {ADMIT_MODES}; "
+                             f"got {mode!r}")
+        if mode == "predicted_p99":
+            if slo_p99_s is None or slo_p99_s <= 0:
+                raise ValueError(f"predicted_p99 mode needs slo_p99_s > 0; "
+                                 f"got {slo_p99_s!r}")
+            if predictor is None:
+                raise ValueError("predicted_p99 mode needs a predictor "
+                                 "(ServeMetrics.predicted_p99 — ServeService "
+                                 "wires it)")
         self.max_depth = int(max_depth)
         self.retry_after_s = float(retry_after_s)
+        self.mode = mode
+        self.slo_p99_s = float(slo_p99_s) if slo_p99_s is not None else None
+        # zero-arg callable -> predicted p99 seconds (None until the SLO
+        # window has observations — the mode degrades to the depth
+        # backstop until then, never rejects on a guess)
+        self.predictor = predictor
         self.depth = 0          # admitted and not yet released
         self.admitted = 0
         self.rejected = 0
+        self.rejected_predicted = 0  # rejects owed to the SLO boundary
         self.draining = False
         self._empty: Optional[asyncio.Event] = None
 
@@ -67,6 +107,25 @@ class AdmissionController:
             raise Rejected(
                 f"queue depth {self.depth} at budget {self.max_depth}",
                 self.retry_after_s)
+        # An EMPTY server always admits (depth 0 skips the SLO boundary):
+        # the queue-drain term is zero, and the admitted request is the
+        # probe that refreshes the rolling window. Without it a transient
+        # overload livelocks — the window only updates on completions, so
+        # a stale past-SLO p99 would reject 100% of traffic forever on an
+        # otherwise idle server.
+        if self.mode == "predicted_p99" and self.depth > 0:
+            predicted = self.predictor()
+            if predicted is not None and predicted > self.slo_p99_s:
+                self.rejected += 1
+                self.rejected_predicted += 1
+                flight.record("serve_reject", reason="predicted_p99",
+                              predicted_p99_s=round(float(predicted), 6),
+                              slo_p99_s=self.slo_p99_s, depth=self.depth,
+                              rejected_total=self.rejected)
+                raise Rejected(
+                    f"predicted p99 {predicted * 1e3:.1f}ms past SLO "
+                    f"{self.slo_p99_s * 1e3:.1f}ms (depth {self.depth})",
+                    self.retry_after_s)
         self.depth += 1
         self.admitted += 1
 

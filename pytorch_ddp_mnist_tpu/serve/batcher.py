@@ -37,7 +37,7 @@ class MicroBatcher:
 
     def __init__(self, engine, *, max_batch: Optional[int] = None,
                  max_delay_ms: float = 2.0, metrics=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic, tracer=None):
         self.engine = engine
         self.max_batch = int(max_batch or engine.max_batch)
         if not 1 <= self.max_batch <= engine.max_batch:
@@ -49,9 +49,15 @@ class MicroBatcher:
         self.max_delay_s = max_delay_ms / 1000.0
         self.metrics = metrics
         self.clock = clock
+        # serve.tracing.ServeTracer (or None for the standalone/legacy
+        # construction): stamps the per-flush BatchCtx and links every
+        # member request's ctx to it
+        self.tracer = tracer
         self.engine_in_dim = IN_DIM
-        # (row, future, t_enqueue) triples awaiting a flush
-        self._pending: List[Tuple[np.ndarray, asyncio.Future, float]] = []
+        # (row, future, t_enqueue, rctx) tuples awaiting a flush; rctx is
+        # the request's tracing context (None from bare submit() callers)
+        self._pending: List[Tuple[np.ndarray, asyncio.Future, float,
+                                  object]] = []
         self._timer: Optional[asyncio.TimerHandle] = None
         self.flushes = 0
 
@@ -68,8 +74,10 @@ class MicroBatcher:
             return True
         return now - self._pending[0][2] >= self.max_delay_s
 
-    async def submit(self, row) -> int:
+    async def submit(self, row, rctx=None) -> int:
         """Enqueue one request row; resolves to its predicted class.
+        `rctx` (a `serve.tracing.RequestCtx`) gets the enqueue stamp and,
+        at flush time, a link to the batch that carried the request.
 
         A malformed row raises HERE, synchronously to its own caller — it
         must never reach the flush, where one bad row would poison the
@@ -82,9 +90,15 @@ class MicroBatcher:
                              f"pixels; got shape {np.asarray(row).shape}")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((row, fut, self.clock()))
+        t_enq = self.clock()
+        if rctx is not None and self.tracer is not None:
+            # one stamp serves both the flush-deadline bookkeeping and the
+            # queue stage — they must never disagree about when waiting
+            # started
+            self.tracer.enqueued(rctx, t_enq)
+        self._pending.append((row, fut, t_enq, rctx))
         if len(self._pending) >= self.max_batch:
-            self.flush()
+            self.flush(reason="size")
         elif self._timer is None:
             # one timer per oldest-pending request: it fires at that
             # request's deadline and flush() re-arms for the next batch
@@ -94,40 +108,54 @@ class MicroBatcher:
     def _on_timer(self) -> None:
         self._timer = None
         if self.flush_due(self.clock()):
-            self.flush()
+            self.flush(reason="deadline")
         elif self._pending:
             # injected-clock drift (tests): re-arm for the remainder
             remain = self.max_delay_s - (self.clock() - self._pending[0][2])
             self._timer = asyncio.get_event_loop().call_later(
                 max(remain, 0.0), self._on_timer)
 
-    def flush(self) -> int:
+    def flush(self, reason: str = "manual") -> int:
         """Run every pending row through the engine now; returns the number
         of rows flushed. Fills each request's future (result or the
-        engine's exception)."""
+        engine's exception). `reason` records WHY the batch formed (size /
+        deadline / drain / manual) on its tracing context — the coalescing
+        knob's observable output."""
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
         batch, self._pending = self._pending, []
         if not batch:
             return 0
+        bctx = (self.tracer.batch_begin(reason)
+                if self.tracer is not None else None)
         try:
-            rows = np.stack([r for r, _, _ in batch])
-            _, preds, bucket = self.engine._run_bucket(
-                self.engine._as_rows(rows))
+            rows = np.stack([r for r, _, _, _ in batch])
+            x = self.engine._as_rows(rows)
+            if bctx is not None:
+                bctx.mark_formed()
+            # the bctx arg only when tracing is wired: duck-typed engine
+            # wrappers with the original one-arg _run_bucket keep working
+            _, preds, bucket = (self.engine._run_bucket(x, bctx)
+                                if bctx is not None
+                                else self.engine._run_bucket(x))
         except Exception as e:  # scatter the failure — a waiter must never
-            for _, fut, _ in batch:                       # hang on a crash
+            for _, fut, _, _ in batch:                    # hang on a crash
                 if not fut.done():
                     fut.set_exception(e)
             return len(batch)
         self.flushes += 1
         if self.metrics is not None:
             self.metrics.record_batch(len(batch), bucket)
-        for (_, fut, _), pred in zip(batch, preds):
+        if bctx is not None:
+            self.tracer.batch_end(bctx, n_real=len(batch))
+        for (_, fut, _, rctx), pred in zip(batch, preds):
+            if rctx is not None:
+                rctx.batch = bctx
             if not fut.done():
                 fut.set_result(int(pred))
         return len(batch)
 
     async def drain(self) -> None:
         """Flush whatever is pending and return once it is served."""
-        self.flush()
+        self.flush(reason="drain")

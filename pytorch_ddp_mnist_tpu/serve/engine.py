@@ -149,9 +149,13 @@ class InferenceEngine:
         raise ValueError(f"batch of {n} rows exceeds the largest bucket "
                          f"{self.buckets[-1]} (max_batch {self.max_batch})")
 
-    def _run_bucket(self, x: np.ndarray):
+    def _run_bucket(self, x: np.ndarray, bctx=None):
         """Pad `x` to its bucket and run the compiled executable. Returns
-        (logits, preds) for the REAL rows only."""
+        (logits, preds) for the REAL rows only. `bctx` (a
+        `serve.tracing.BatchCtx`) receives the pad/H2D and compute stage
+        stamps — plain clock reads, no extra device sync: the `np.asarray`
+        fetch below already blocks on the executable, so the compute stamp
+        lands when the results are truly on the host."""
         n = x.shape[0]
         bucket = self.bucket_for(n)
         if n != bucket:
@@ -159,8 +163,13 @@ class InferenceEngine:
             x = np.concatenate([x, pad], axis=0)
         xd = (jax.device_put(x, self._x_sharding)
               if self._x_sharding is not None else jnp.asarray(x))
+        if bctx is not None:
+            bctx.mark_h2d(bucket)
         logits, preds = self._compiled[bucket](self._params, xd)
-        return np.asarray(logits)[:n], np.asarray(preds)[:n], bucket
+        out = np.asarray(logits)[:n], np.asarray(preds)[:n], bucket
+        if bctx is not None:
+            bctx.mark_computed()
+        return out
 
     def _as_rows(self, x) -> np.ndarray:
         x = np.asarray(x, self._np_dtype)

@@ -18,10 +18,12 @@ dashboard in one dict — unchanged.
 from __future__ import annotations
 
 import collections
+import math
 import time
 from typing import Callable, Optional
 
 from ..telemetry.registry import Histogram, MetricsRegistry
+from .tracing import STAGES
 
 # Rolling SLO window length: big enough for a stable p99 (>=100 samples
 # past the 99th percentile boundary), small enough that the monitor
@@ -29,6 +31,21 @@ from ..telemetry.registry import Histogram, MetricsRegistry
 # the cumulative histogram answers "how was the run", this answers "how
 # is the service RIGHT NOW".
 SLO_WINDOW = 512
+
+
+def nearest_rank(sorted_vals, q: float) -> float:
+    """Exact nearest-rank q-quantile of an ALREADY-SORTED sequence; 0.0
+    when empty. The one percentile convention the serve side shares
+    (SLOWindow, the loadgen's client-side clock) — two copies of the
+    rounding rule would let client-vs-server deltas compare values ranked
+    under different conventions. Deliberately the SAME ceil(q*n) formula
+    as `telemetry.analysis._percentile` (which must stay framework-free
+    and so cannot import this module): `trace report --serve` and the
+    live SLO window must never disagree on identical samples."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(1, math.ceil(q * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
 
 
 class SLOWindow:
@@ -40,9 +57,12 @@ class SLOWindow:
     reject on PREDICTED p99 = queue depth x observed service rate instead
     of raw queue length): the cumulative `serve.latency_s` histogram
     cannot answer it — a morning of fast traffic forever dilutes an
-    afternoon collapse. Constant memory (two bounded deques), O(window)
-    only when a percentile is actually read (a snapshot/scrape, never the
-    request path)."""
+    afternoon collapse. Constant memory (two bounded deques plus one
+    cached sorted copy); the sort is paid at most once per COMPLETION
+    (the cache invalidates on `record`), never per read — predicted-p99
+    admission reads a percentile on every arrival, and re-sorting 512
+    floats per offered request would make the admission check inflate the
+    very queue delay it predicts."""
 
     def __init__(self, window: int = SLO_WINDOW):
         if window < 2:
@@ -52,10 +72,12 @@ class SLOWindow:
             maxlen=self.window)
         self._done_t: "collections.deque[float]" = collections.deque(
             maxlen=self.window)
+        self._sorted: "Optional[list]" = None
 
     def record(self, latency_s: float, t_done: float) -> None:
         self._lat.append(float(latency_s))
         self._done_t.append(float(t_done))
+        self._sorted = None
 
     @property
     def n(self) -> int:
@@ -63,12 +85,9 @@ class SLOWindow:
 
     def percentile(self, q: float) -> float:
         """Exact q-quantile over the window (nearest-rank); 0.0 empty."""
-        if not self._lat:
-            return 0.0
-        ordered = sorted(self._lat)
-        rank = max(0, min(len(ordered) - 1,
-                          int(q * len(ordered) + 0.5) - 1))
-        return ordered[rank]
+        if self._sorted is None:
+            self._sorted = sorted(self._lat)
+        return nearest_rank(self._sorted, q)
 
     def service_rate(self) -> Optional[float]:
         """Completions/sec over the window's first..last completion wall
@@ -163,6 +182,24 @@ class ServeMetrics:
             lambda: self.slo.percentile(0.99) if self.slo.n else None)
         self.registry.gauge("serve.service_rate_rps").set_fn(
             self.slo.service_rate)
+        # Request-scoped attribution (serve/tracing.py): one histogram per
+        # pipeline stage, fed by ServeTracer.finish on every completed
+        # request — the same stage names the JSONL spans and the
+        # `trace report --serve` table use. Per-stage observed service
+        # rate (completions / stage-busy-seconds = 1 / mean stage time)
+        # rides as a derived gauge: the capacity number a fleet router
+        # needs per stage, not just end-to-end. `serve.predicted_p99_s`
+        # is the admission predictor — rolling p99 + depth / service rate
+        # (what a request arriving NOW should expect its tail to be).
+        self._stage_hists = {}
+        for stage in STAGES:
+            h = self.registry.histogram(f"serve.stage.{stage}_s")
+            self._stage_hists[stage] = h
+            self.registry.gauge(f"serve.stage.{stage}_rate_rps").set_fn(
+                (lambda hist: lambda: (hist.n / hist.total
+                                       if hist.total > 0 else None))(h))
+        self.registry.gauge("serve.predicted_p99_s").set_fn(
+            self.predicted_p99)
 
     # counter values under their historical attribute names
     @property
@@ -221,7 +258,48 @@ class ServeMetrics:
         self._batched_rows.inc(real_rows)
         self._bucket_rows.inc(bucket)
 
+    def record_stages(self, stages: dict) -> None:
+        """One completed request's per-stage durations (`<stage>_s` keys,
+        serve/tracing.py's telescoped breakdown) into the stage
+        histograms."""
+        for stage, hist in self._stage_hists.items():
+            v = stages.get(f"{stage}_s")
+            if isinstance(v, (int, float)) and v >= 0:
+                hist.record(v)
+
+    def predicted_p99(self) -> Optional[float]:
+        """The admission predictor (seconds): rolling observed p99 plus
+        the time the CURRENT queue takes to drain at the observed service
+        rate — what a request arriving this instant should expect its
+        tail to be. None until the SLO window has both a percentile and a
+        rate (predicting from nothing would reject on a guess)."""
+        if not self.slo.n:
+            return None
+        rate = self.slo.service_rate()
+        if rate is None or rate <= 0:
+            return None
+        depth = self.depth_fn() if self.depth_fn is not None else 0
+        return self.slo.percentile(0.99) + depth / rate
+
     # -- snapshot ---------------------------------------------------------
+
+    def attribution(self) -> dict:
+        """The live per-stage latency attribution — stage p50/p99 (ms) in
+        pipeline order plus the current predicted p99 — under EXACTLY the
+        stage names the JSONL trace uses (serve/tracing.py STAGES): the
+        `{"op": "stats"}` dashboard and `trace report --serve` must never
+        disagree on naming."""
+        pred = self.predicted_p99()
+        return {
+            "stages": {
+                stage: {"n": h.n,
+                        "p50_ms": round(h.percentile(0.50) * 1e3, 3),
+                        "p99_ms": round(h.percentile(0.99) * 1e3, 3)}
+                for stage, h in self._stage_hists.items() if h.n
+            },
+            "predicted_p99_ms": (round(pred * 1e3, 3)
+                                 if pred is not None else None),
+        }
 
     def snapshot(self) -> dict:
         """JSON-able state: the serving dashboard in one dict."""
@@ -257,4 +335,8 @@ class ServeMetrics:
             # the rolling SLO view (recent window), beside the cumulative
             # percentiles above — "right now" vs "the whole run"
             "slo": self.slo.snapshot(),
+            # request-scoped tail attribution: per-stage p50/p99 + the
+            # predicted p99 admission signal (docs/OBSERVABILITY.md
+            # §Request tracing)
+            "attribution": self.attribution(),
         }

@@ -22,7 +22,10 @@ shared by train, serve, and bench alike:
   * `analysis.py`  — the READ side: load one or many per-process JSONL
     traces, reconstruct the span tree (structural validation shared with
     `scripts/check_telemetry.py`), per-phase p50/p95/max, per-epoch trend,
-    cross-process straggler skew, and the baseline-diff regression gate.
+    cross-process straggler skew, the baseline-diff regression gate, and
+    the serve-path tail-latency attribution report (`serve_report`:
+    per-stage p50/p95/p99 + %-of-e2e from the request/batch spans
+    `serve/tracing.py` emits, behind `trace report --serve`).
   * `export.py`    — merged trace -> Chrome trace-event JSON (Perfetto /
     `chrome://tracing`: one track per process, counter tracks from registry
     snapshots); `profiler_trace` is the op-level jax.profiler hatch.
@@ -59,6 +62,7 @@ from .runtime import (collect_memory, device_memory_stats,  # noqa: F401
                       host_rss_bytes, install_compile_listener,
                       process_index_cached, record_engine_compiles)
 from .analysis import (analyze, compare, load_trace,  # noqa: F401
+                       serve_report, serve_structure_errors,
                        span_structure_errors, trace_files)
 from .export import chrome_trace, profiler_trace, write_chrome_trace  # noqa: F401
 from .flight import (FlightRecorder, get_flight_recorder)  # noqa: F401

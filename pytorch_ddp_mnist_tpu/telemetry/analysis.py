@@ -29,6 +29,7 @@ installed (the checker file-loads this module to stay framework-free).
 from __future__ import annotations
 
 import glob
+import heapq
 import json
 import math
 import os
@@ -41,6 +42,22 @@ PHASES = ("data_wait", "step_compute", "eval", "fused_run")
 # parent's duration is computed a few instructions after its child's, so
 # exact float equality is not guaranteed at the boundary.
 _EPS = 1e-6
+
+# -- the serve-side request/batch span contract (serve/tracing.py emits
+# these; kept as LITERALS here so this module stays framework-free for the
+# file-loading checker — tests pin the two catalogs against each other) --
+SERVE_REQUEST_SPAN = "serve.request"
+SERVE_BATCH_SPAN = "serve.batch"
+SERVE_STAGES = ("admission", "queue", "batch_form", "pad_h2d", "compute",
+                "reply")
+SERVE_COALESCE_REASONS = ("size", "deadline", "drain", "manual")
+# batch stage children in pipeline order: their start stamps must be
+# monotone in this order within one batch (a violation means the stamps
+# were reordered or two batches' ids collided)
+SERVE_BATCH_STAGE_ORDER = ("serve.batch_form", "serve.pad_h2d",
+                           "serve.compute")
+# How many slowest-request exemplar trees a serve report carries.
+SERVE_EXEMPLAR_K = 8
 
 
 def skew(values) -> Tuple[float, float]:
@@ -203,6 +220,93 @@ def span_structure_errors(segment: List[dict]) -> List[Tuple[int, str]]:
     return errors
 
 
+def serve_structure_errors(segment: List[dict]) -> List[Tuple[int, str]]:
+    """Violations of the serve request/batch span contract within ONE
+    segment, as (line_no, message) pairs — shared by the file-loading
+    checker exactly like `span_structure_errors`. Checks:
+
+      * every `serve.request` span carries a NON-EMPTY string
+        `request_id` (the attribution key every reader joins on);
+      * a request's `batch` link resolves to a real `serve.batch` span's
+        `batch_id` in the same segment (N requests -> the one batch that
+        carried them; a dangling link means the batch span was lost and
+        the shared-stage attribution is unverifiable);
+      * `serve.batch` spans carry a non-empty `batch_id`, a known
+        `coalesce` reason, and a bucket >= n_real >= 1 (occupancy > 1
+        would mean rows the engine never computed);
+      * a batch's stage children start in pipeline order
+        (batch_form -> pad_h2d -> compute, monotone t0).
+    """
+    errors: List[Tuple[int, str]] = []
+    batch_ids = set()
+    # parent span id -> [(t0, name, line)] for batch stage children
+    children: Dict[object, List[Tuple[float, str, int]]] = {}
+    requests: List[dict] = []
+    for rec in segment:
+        if rec.get("kind") != "span":
+            continue
+        name, line = rec.get("name"), rec.get("_line", 0)
+        attrs = rec.get("attrs") or {}
+        if name == SERVE_REQUEST_SPAN:
+            requests.append(rec)
+            rid = attrs.get("request_id")
+            if not (isinstance(rid, str) and rid):
+                errors.append((line, f"serve.request span missing a "
+                                     f"non-empty request_id (got {rid!r})"))
+        elif name == SERVE_BATCH_SPAN:
+            bid = attrs.get("batch_id")
+            if not (isinstance(bid, str) and bid):
+                errors.append((line, f"serve.batch span missing a "
+                                     f"non-empty batch_id (got {bid!r})"))
+            else:
+                batch_ids.add(bid)
+            reason = attrs.get("coalesce")
+            if reason not in SERVE_COALESCE_REASONS:
+                errors.append((line, f"unknown coalesce reason {reason!r}; "
+                                     f"known: {SERVE_COALESCE_REASONS}"))
+            bucket, n_real = attrs.get("bucket"), attrs.get("n_real")
+            if not (isinstance(bucket, int) and isinstance(n_real, int)
+                    and not isinstance(bucket, bool)
+                    and not isinstance(n_real, bool)):
+                # absent or mistyped fields are themselves a contract
+                # violation — a guard that silently skips them could not
+                # catch the occupancy story going missing
+                errors.append((line, f"serve.batch span missing int "
+                                     f"bucket/n_real fields (got "
+                                     f"bucket={bucket!r}, "
+                                     f"n_real={n_real!r})"))
+            elif not 1 <= n_real <= bucket:
+                errors.append((line, f"batch n_real {n_real} outside "
+                                     f"[1, bucket {bucket}]"))
+        elif name in SERVE_BATCH_STAGE_ORDER:
+            iv = _span_interval(rec)
+            parent = rec.get("parent")
+            if iv is not None and parent is not None:
+                children.setdefault(parent, []).append(
+                    (iv[0], name, line))
+    for rec in requests:
+        attrs = rec.get("attrs") or {}
+        link = attrs.get("batch")
+        if link is not None and link not in batch_ids:
+            errors.append((rec.get("_line", 0),
+                           f"request {attrs.get('request_id')!r} links to "
+                           f"batch {link!r} but no serve.batch span with "
+                           f"that batch_id exists in this segment"))
+    order = {n: i for i, n in enumerate(SERVE_BATCH_STAGE_ORDER)}
+    for stages in children.values():
+        stages.sort(key=lambda it: it[0])   # by start stamp
+        last = -1
+        for _t0, name, line in stages:
+            if order[name] < last:
+                errors.append((line, f"batch stage {name} starts before "
+                                     f"an earlier pipeline stage ended "
+                                     f"its turn (stage order must be "
+                                     f"{SERVE_BATCH_STAGE_ORDER})"))
+            last = max(last, order[name])
+    errors.sort(key=lambda e: e[0])
+    return errors
+
+
 # ---------------------------------------------------------------------------
 # statistics
 # ---------------------------------------------------------------------------
@@ -216,16 +320,24 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[min(rank, len(sorted_vals)) - 1]
 
 
-def _stats(vals: List[float]) -> dict:
+def _stats(vals: List[float], with_p99: bool = False) -> dict:
+    """n/p50/p95/max/mean/total over `vals`; the serve report adds p99
+    (tail attribution is ABOUT the p99) via `with_p99` — one builder, so
+    a fix to either caller's stats cannot miss the other."""
     s = sorted(vals)
-    return {
+    out = {
         "n": len(s),
         "p50_s": _percentile(s, 0.50),
         "p95_s": _percentile(s, 0.95),
+    }
+    if with_p99:
+        out["p99_s"] = _percentile(s, 0.99)
+    out.update({
         "max_s": s[-1] if s else 0.0,
         "mean_s": (sum(s) / len(s)) if s else 0.0,
         "total_s": sum(s),
-    }
+    })
+    return out
 
 
 def clock_offset(records: List[dict]) -> float:
@@ -376,6 +488,196 @@ def analyze(paths: List[str]) -> dict:
         "epochs": epochs,
         "straggler": straggler,
     }
+
+
+# ---------------------------------------------------------------------------
+# the serve report: tail-latency attribution
+# ---------------------------------------------------------------------------
+
+def _serve_stats(vals: List[float]) -> dict:
+    return _stats(vals, with_p99=True)
+
+
+def serve_report(paths: List[str], exemplar_k: int = SERVE_EXEMPLAR_K) -> dict:
+    """One or many serve trace files -> the tail-latency attribution
+    report (`trace report --serve`):
+
+      * per-stage latency statistics (p50/p95/p99) for every stage in
+        `SERVE_STAGES`, with each stage's share of total end-to-end time
+        (`pct_of_e2e`) — where the tail actually comes from;
+      * `attribution_coverage`: sum of stage totals / e2e total. The
+        stages telescope (each duration ends where the next begins), so
+        this must sit near 1.0 — the acceptance test pins it within 5%.
+        A hole here means a stage went missing, not jitter;
+      * batch statistics: occupancy, padding waste (bucket rows computed
+        that carried no request), coalesce-reason counts — the
+        size-vs-deadline knob's observable output;
+      * the slowest-`exemplar_k` requests as full stage trees (the same
+        shape the live path leaves in the flight recorder at drain).
+
+    Only completed requests with a full stage breakdown contribute to the
+    stage table (a failed request has no honest decomposition); their
+    count vs total is reported so silently dropped coverage is visible.
+    """
+    records, parse_errors = load_traces(paths)
+    span_errors = list(parse_errors)
+    stage_durs: Dict[str, List[float]] = {s: [] for s in SERVE_STAGES}
+    e2e_durs: List[float] = []
+    requests = attributed = 0
+    exemplars: List[Tuple[float, int, dict]] = []
+    batches: List[dict] = []
+    procs = set()
+
+    by_file: Dict[str, List[dict]] = {}
+    for rec in records:
+        by_file.setdefault(rec["_file"], []).append(rec)
+
+    for path, recs in by_file.items():
+        for seg in split_segments(recs):
+            span_errors.extend(
+                f"{path}:{line}: {msg}"
+                for line, msg in span_structure_errors(seg))
+            span_errors.extend(
+                f"{path}:{line}: {msg}"
+                for line, msg in serve_structure_errors(seg))
+            for rec in seg:
+                if rec.get("kind") != "span":
+                    continue
+                procs.add(rec.get("proc", 0))
+                name = rec.get("name")
+                attrs = rec.get("attrs") or {}
+                if name == SERVE_BATCH_SPAN:
+                    batches.append(attrs)
+                if name != SERVE_REQUEST_SPAN:
+                    continue
+                requests += 1
+                dur = rec.get("dur_s")
+                stages = {s: attrs.get(f"{s}_s") for s in SERVE_STAGES}
+                if (not isinstance(dur, (int, float))
+                        or not all(isinstance(v, (int, float))
+                                   for v in stages.values())):
+                    continue   # failed / partial request: counted above
+                attributed += 1
+                e2e_durs.append(float(dur))
+                for s, v in stages.items():
+                    stage_durs[s].append(float(v))
+                tree = {"request_id": attrs.get("request_id"),
+                        "e2e_s": float(dur),
+                        "stages": {f"{s}_s": float(v)
+                                   for s, v in stages.items()},
+                        "batch_id": attrs.get("batch")}
+                item = (float(dur), attributed, tree)
+                if len(exemplars) < exemplar_k:
+                    heapq.heappush(exemplars, item)
+                elif dur > exemplars[0][0]:
+                    heapq.heapreplace(exemplars, item)
+
+    e2e_total = sum(e2e_durs)
+    stages_out = {}
+    for s in SERVE_STAGES:
+        durs = stage_durs[s]
+        if not durs:
+            continue
+        st = _serve_stats(durs)
+        st["pct_of_e2e"] = (100.0 * st["total_s"] / e2e_total
+                            if e2e_total > 0 else 0.0)
+        stages_out[s] = st
+    stage_total = sum(st["total_s"] for st in stages_out.values())
+
+    real_rows = sum(b.get("n_real", 0) for b in batches
+                    if isinstance(b.get("n_real"), int))
+    bucket_rows = sum(b.get("bucket", 0) for b in batches
+                      if isinstance(b.get("bucket"), int))
+    occs = [b["occupancy"] for b in batches
+            if isinstance(b.get("occupancy"), (int, float))]
+    coalesce: Dict[str, int] = {}
+    for b in batches:
+        r = b.get("coalesce")
+        if isinstance(r, str):
+            coalesce[r] = coalesce.get(r, 0) + 1
+
+    return {
+        "report": "serve_trace_attribution",
+        "v": 1,
+        "files": sorted(by_file),
+        "processes": sorted(procs),
+        "requests": requests,
+        "attributed": attributed,
+        "span_errors": span_errors,
+        "e2e": _serve_stats(e2e_durs),
+        "stages": stages_out,
+        # stage totals / e2e total: the stages must ~cover the e2e story
+        "attribution_coverage": (stage_total / e2e_total
+                                 if e2e_total > 0 else None),
+        "batches": {
+            "count": len(batches),
+            "mean_occupancy": (sum(occs) / len(occs) if occs else None),
+            # bucket rows computed that carried no request — the padding
+            # bill the coalescing knobs are paying
+            "padding_waste_pct": (100.0 * (1.0 - real_rows / bucket_rows)
+                                  if bucket_rows else None),
+            "coalesce": coalesce,
+        },
+        "slowest": [t for _, _, t in sorted(exemplars,
+                                            key=lambda it: -it[0])],
+    }
+
+
+def format_serve_report(report: dict) -> str:
+    """Human rendering of `serve_report` (the --json flag prints the dict
+    itself)."""
+    lines = [f"serve trace report: {report['requests']} request(s), "
+             f"{report['attributed']} with full stage attribution, "
+             f"{report['batches']['count']} batch(es)"]
+    if report["stages"]:
+        e2e = report["e2e"]
+        lines.append(f"{'stage':<12} {'n':>6} {'p50_ms':>9} {'p95_ms':>9} "
+                     f"{'p99_ms':>9} {'% of e2e':>9}")
+        for s in SERVE_STAGES:
+            st = report["stages"].get(s)
+            if st:
+                lines.append(f"{s:<12} {st['n']:>6} "
+                             f"{st['p50_s'] * 1e3:>9.3f} "
+                             f"{st['p95_s'] * 1e3:>9.3f} "
+                             f"{st['p99_s'] * 1e3:>9.3f} "
+                             f"{st['pct_of_e2e']:>8.1f}%")
+        lines.append(f"{'e2e':<12} {e2e['n']:>6} {e2e['p50_s'] * 1e3:>9.3f} "
+                     f"{e2e['p95_s'] * 1e3:>9.3f} "
+                     f"{e2e['p99_s'] * 1e3:>9.3f} {'100.0%':>9}")
+        cov = report["attribution_coverage"]
+        lines.append(f"attribution coverage: {100.0 * cov:.1f}% of e2e "
+                     f"accounted to stages" if cov is not None else
+                     "attribution coverage: n/a")
+    elif report["requests"]:
+        lines.append(f"no fully attributed requests: {report['requests']} "
+                     f"serve.request span(s) present but none carry a "
+                     f"complete stage breakdown (all-failed requests, or "
+                     f"a partial/torn trace)")
+    else:
+        lines.append("no serve.request spans found (serve with --telemetry "
+                     "DIR to emit them)")
+    b = report["batches"]
+    if b["count"]:
+        occ = (f"{b['mean_occupancy']:.3f}" if b["mean_occupancy"]
+               is not None else "n/a")
+        waste = (f"{b['padding_waste_pct']:.1f}%"
+                 if b["padding_waste_pct"] is not None else "n/a")
+        reasons = ", ".join(f"{k}={v}" for k, v in
+                            sorted(b["coalesce"].items())) or "none"
+        lines.append(f"batches: {b['count']} (mean occupancy {occ}, "
+                     f"padding waste {waste}; coalesce: {reasons})")
+    for i, t in enumerate(report["slowest"], 1):
+        worst = max(t["stages"].items(), key=lambda kv: kv[1])
+        lines.append(f"slow #{i}: {t['request_id']} "
+                     f"e2e {t['e2e_s'] * 1e3:.3f}ms "
+                     f"(worst stage {worst[0]} {worst[1] * 1e3:.3f}ms, "
+                     f"batch {t['batch_id']})")
+    if report["span_errors"]:
+        lines.append(f"span structure: {len(report['span_errors'])} "
+                     f"violation(s) — run scripts/check_telemetry.py")
+    else:
+        lines.append("span structure: OK")
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

@@ -103,6 +103,8 @@ class EventTrace:
     one trace per process (the module-level tracer), written from the train
     or serve loop's thread, exactly like the print-based epoch line."""
 
+    enabled = True
+
     def __init__(self, path: str, *, process_index: Optional[int] = None):
         self.path = str(path)
         if process_index is None:
@@ -163,6 +165,27 @@ class EventTrace:
                    parent_id=self._current_span_id(), dur_s=dur_s,
                    attrs=attrs or None)
 
+    def emit_span(self, name: str, *, t0_mono: float, t0_wall: float,
+                  dur_s: float, parent: Optional[int] = None,
+                  attrs: Optional[dict] = None) -> int:
+        """Emit a LIVE span (real [t0, t0+dur] interval) with EXPLICIT
+        parentage, outside the context-manager stack — the serve-path
+        contract: concurrent requests overlap without nesting, so the
+        stack's strict-containment invariant cannot hold for them; each
+        caller-threaded context stamps its own interval and names its own
+        parent (or none). Returns the allocated span id so a caller can
+        parent further spans under it (the per-batch stage children).
+        Stamps must be in this process's perf_counter/time.time frames —
+        the structure validator checks t0_mono + dur_s against the
+        record's own emission stamp."""
+        sid = self._next_id()
+        a = dict(attrs) if attrs else {}
+        a["t0_mono"] = float(t0_mono)
+        a["t0_wall"] = float(t0_wall)
+        self._emit("span", name, span_id=sid, parent_id=parent,
+                   dur_s=dur_s, attrs=a)
+        return sid
+
     def point(self, name: str, **attrs) -> None:
         """One instantaneous event record."""
         self._emit("point", name, attrs=attrs or None)
@@ -199,12 +222,21 @@ class NullTracer:
             return None
 
     _SPAN = _NullSpan()
+    # call sites that must not even BUILD their attrs payload when
+    # telemetry is off (the serve request path) branch on this instead of
+    # an isinstance check
+    enabled = False
 
     def span(self, name: str, **attrs) -> "_NullSpan":
         return self._SPAN
 
     def complete_span(self, name: str, dur_s: float, **attrs) -> None:
         pass
+
+    def emit_span(self, name: str, *, t0_mono: float, t0_wall: float,
+                  dur_s: float, parent: Optional[int] = None,
+                  attrs: Optional[dict] = None) -> int:
+        return 0
 
     def point(self, name: str, **attrs) -> None:
         pass

@@ -34,13 +34,21 @@ import contextlib
 import json
 from typing import List, Optional
 
-from .analysis import (clock_offset, load_traces, split_segments,
-                       _span_interval)
+from .analysis import (SERVE_BATCH_SPAN, SERVE_BATCH_STAGE_ORDER,
+                       SERVE_REQUEST_SPAN, clock_offset, load_traces,
+                       split_segments, _span_interval)
 
 # Thread ids within each process track: the real span timeline, the
 # per-epoch aggregate durations, and instants/counters ride on spans' tid.
+# Serve traces add two more: concurrent request spans (which overlap
+# without nesting — they would render as a garbled stack on the spans
+# thread) and the batch pipeline, connected by flow arrows so clicking a
+# request walks to the batch that carried it.
 _TID_SPANS = 0
 _TID_AGGREGATES = 1
+_TID_REQUESTS = 2
+_TID_BATCHES = 3
+_SERVE_BATCH_TRACK = (SERVE_BATCH_SPAN,) + SERVE_BATCH_STAGE_ORDER
 
 
 def _scale_us(seconds: float) -> float:
@@ -87,8 +95,21 @@ def chrome_trace(paths: List[str]) -> dict:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
     t_base = min(start for start, _rec in aligned)
 
+    # serve flow arrows (request -> the batch that carried it) need the
+    # batch slice's position BEFORE the request slices render: one pass
+    # over the aligned records maps batch_id -> (pid, ts).
+    batch_pos = {}
+    for start, rec in aligned:
+        if (rec.get("kind") == "span"
+                and rec.get("name") == SERVE_BATCH_SPAN):
+            bid = (rec.get("attrs") or {}).get("batch_id")
+            if isinstance(bid, str) and bid:
+                batch_pos[bid] = (int(rec.get("proc", 0)),
+                                  _scale_us(start - t_base))
+
     events: List[dict] = []
     named_pids = set()
+    flow_seq = 0
     for start, rec in sorted(aligned, key=lambda it: it[0]):
         pid = int(rec.get("proc", 0))
         if pid not in named_pids:
@@ -101,20 +122,47 @@ def chrome_trace(paths: List[str]) -> dict:
             events.append({"ph": "M", "name": "thread_name", "pid": pid,
                            "tid": _TID_AGGREGATES,
                            "args": {"name": "aggregates"}})
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": _TID_REQUESTS,
+                           "args": {"name": "serve requests"}})
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": _TID_BATCHES,
+                           "args": {"name": "serve batches"}})
         ts = _scale_us(start - t_base)
         kind = rec.get("kind")
         if kind == "span":
             live = _span_interval(rec) is not None
             attrs = {k: v for k, v in (rec.get("attrs") or {}).items()
                      if k not in ("t0_mono", "t0_wall")}
+            name = rec.get("name", "span")
+            if name == SERVE_REQUEST_SPAN:
+                tid = _TID_REQUESTS
+            elif name in _SERVE_BATCH_TRACK:
+                tid = _TID_BATCHES
+            else:
+                tid = _TID_SPANS if live else _TID_AGGREGATES
             events.append({
-                "ph": "X", "name": rec.get("name", "span"),
+                "ph": "X", "name": name,
                 "cat": "span" if live else "aggregate",
                 "ts": ts, "dur": _scale_us(float(rec["dur_s"])),
                 "pid": pid,
-                "tid": _TID_SPANS if live else _TID_AGGREGATES,
+                "tid": tid,
                 "args": attrs,
             })
+            link = attrs.get("batch")
+            if (name == SERVE_REQUEST_SPAN and isinstance(link, str)
+                    and link in batch_pos):
+                # one flow arrow per request: starts inside the request
+                # slice, lands at the batch slice's start — Perfetto
+                # renders the N-requests-into-one-batch coalescing
+                bpid, bts = batch_pos[link]
+                flow_seq += 1
+                flow = {"cat": "serve_flow", "name": "batch",
+                        "id": flow_seq}
+                events.append({"ph": "s", "ts": ts, "pid": pid,
+                               "tid": _TID_REQUESTS, **flow})
+                events.append({"ph": "f", "bp": "e", "ts": bts,
+                               "pid": bpid, "tid": _TID_BATCHES, **flow})
         elif kind == "point":
             events.append({"ph": "i", "name": rec.get("name", "point"),
                            "cat": "point", "ts": ts, "pid": pid,

@@ -13,7 +13,10 @@ never captured. The recorder closes that gap without becoming a logger:
     on the happy path;
   * producers are the paths that only matter when things go wrong:
     `wait_for_backend`'s probe/retry loop (every error, hang, health poll
-    and recovery) and `serve/admission.py`'s reject path;
+    and recovery), `serve/admission.py`'s reject path (incl. the
+    predicted-p99 SLO boundary, with the predicted value that fired), and
+    `serve/tracing.py`'s drain-time slowest-request exemplars (the full
+    stage trees of the worst tails a killed server ever served);
   * `dump(reason)` flushes the ring as one JSON file — into the configured
     dump dir (`set_dump_dir`, wired to `--telemetry DIR` by cli/train),
     else `$PDMT_FLIGHT_DIR`, else the system temp dir — and returns the

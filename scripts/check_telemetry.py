@@ -18,7 +18,12 @@ exactly so this holds; an appended file holds one segment per
 `trace_start` record), negative span durations, or span-STRUCTURE
 violations: parent references that never appear in their segment, duplicate
 span ids, a recorded exit with no matching enter (t0_mono + dur_s past the
-emission stamp), and child spans crossing their parent's interval. The
+emission stamp), and child spans crossing their parent's interval. Serve
+traces get the request/batch contract on top (`serve.request` spans must
+carry a non-empty `request_id`, their `batch` link must resolve to a real
+`serve.batch` span in the segment, and a batch's stage children must start
+in pipeline order — use `--require serve.` to also gate on the serve
+registry metrics, the serve-trace-smoke pattern). The
 structural checks are the span-tree reconstructor shared with
 `pytorch_ddp_mnist_tpu/telemetry/analysis.py` (file-loaded, not
 package-imported, so no framework import happens); when the analysis
@@ -90,7 +95,15 @@ def _fallback_structure_errors(segment):
 
 def span_structure_errors(segment):
     if _analysis is not None:
-        return _analysis.span_structure_errors(segment)
+        errors = list(_analysis.span_structure_errors(segment))
+        # the serve request/batch span contract (serve/tracing.py):
+        # non-empty request_id, batch links resolving to a real
+        # serve.batch span, pipeline-ordered batch stages. hasattr-guarded
+        # so this checker still runs beside an older analysis.py.
+        if hasattr(_analysis, "serve_structure_errors"):
+            errors.extend(_analysis.serve_structure_errors(segment))
+            errors.sort(key=lambda e: e[0])
+        return errors
     return _fallback_structure_errors(segment)
 
 

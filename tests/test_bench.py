@@ -81,6 +81,12 @@ def test_serve_mode_contract():
     assert rec["offered_rps"] == 2000.0
     assert 0 < rec["p50_ms"] <= rec["p95_ms"] <= rec["p99_ms"]
     assert 0 <= rec["reject_rate"] <= 1
+    # client-perceived minus server-side latency at matched percentiles
+    # (front-door overhead): present at every gated percentile, and the
+    # client can never be meaningfully FASTER than the server it awaited
+    fd = rec["front_door_overhead_ms"]
+    assert set(fd) == {"p50", "p95", "p99"}
+    assert all(v > -1.0 for v in fd.values())
     assert 0 < rec["batch_occupancy"] <= 1
     # bucket ladder 1..16 -> exactly 5 warmup compiles, none at serve time
     assert rec["compile_count"] == 5

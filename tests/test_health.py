@@ -63,7 +63,11 @@ def test_healthy_run_fires_nothing():
 
 def test_nan_loss_is_fatal():
     from pytorch_ddp_mnist_tpu.telemetry.flight import get_flight_recorder
-    before = len(get_flight_recorder().snapshot())
+    # filter by monotonic seq, not a length-based slice: the ring is
+    # BOUNDED, so once 256 earlier entries exist (e.g. the serve tracing
+    # tests' reject/exemplar traffic) len() stops growing and a [before:]
+    # slice of a full ring is forever empty
+    seq_before = get_flight_recorder().recorded
     wd, reg = _wd()
     (ev,) = wd.observe(np.array([1.0, float("nan"), 1.0]), epoch=0, step=3)
     assert (ev.detector, ev.severity) == ("nan", "fatal")
@@ -72,8 +76,8 @@ def test_nan_loss_is_fatal():
     assert health_summary(reg)["worst_severity"] == "fatal"
     # acceptance: the event reaches the flight recorder too (the
     # post-mortem ring), not just the trace + registry
-    tail = [e for e in get_flight_recorder().snapshot()[before:]
-            if e["kind"] == "health"]
+    tail = [e for e in get_flight_recorder().snapshot()
+            if e["kind"] == "health" and e["seq"] >= seq_before]
     assert tail and tail[0]["detector"] == "nan" \
         and tail[0]["severity"] == "fatal"
 

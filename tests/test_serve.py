@@ -321,13 +321,17 @@ def test_metrics_snapshot_schema():
     assert set(snap) == {"requests", "completed", "rejected", "failed",
                          "reject_rate", "achieved_rps", "latency_ms",
                          "batches", "batch_occupancy", "mean_batch_size",
-                         "queue_depth", "slo"}
+                         "queue_depth", "slo", "attribution"}
     # the rolling SLO view rides along: exact-window percentiles + the
     # observed service rate (what SLO-aware admission will consume)
     assert set(snap["slo"]) == {"window_n", "rolling_p50_ms",
                                 "rolling_p99_ms", "service_rate_rps"}
     assert snap["slo"]["window_n"] == 4
     assert snap["slo"]["rolling_p99_ms"] == pytest.approx(100.0, rel=1e-6)
+    # request-scoped attribution rides along too: per-stage p50/p99 under
+    # the tracing stage names + the predicted-p99 admission signal
+    assert set(snap["attribution"]) == {"stages", "predicted_p99_ms"}
+    assert snap["attribution"]["predicted_p99_ms"] is not None
     assert snap["requests"] == 5 and snap["completed"] == 4
     assert snap["reject_rate"] == 0.2
     assert snap["queue_depth"] == 3
