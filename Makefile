@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -127,9 +127,19 @@ audit-program:
 
 static-smoke: lint audit-program
 
+# Runtime-sanitizer smoke (docs/STATIC_ANALYSIS.md §Runtime sanitizers):
+# the serve selftest and a 2-epoch train under no_host_sync (zero
+# block_until_ready; fetches exactly 2/flush on serve, epoch-granular in
+# training), event_loop_stall (no single serve-loop callback past the
+# threshold — the PR 9 sort-per-request class), and lock_trace (no
+# runtime lock-order cycles — LOCK002's runtime confirmation).
+sanitize-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/sanitize_smoke.py
+
 # The committed pre-merge gate: static contracts first (seconds), then the
-# serve request-tracing round trip (also seconds), then the fast test tier.
-check: static-smoke serve-trace-smoke test-fast
+# runtime sanitizers on the live paths, then the serve request-tracing
+# round trip (also seconds), then the fast test tier.
+check: static-smoke sanitize-smoke serve-trace-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -98,23 +98,29 @@ CALIBRATION_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 @functools.lru_cache(maxsize=1)
 def statics_stamp() -> dict:
-    """{lint_findings, audit_ok[, error]} — computed once per process
-    (lru_cache) and stamped on every artifact line, so a MULTICHIP/BENCH
-    JSON records whether the measured build also honored the static
-    contracts (docs/STATIC_ANALYSIS.md). The audit covers the 8
-    comm x overlap step programs (the form every measured strategy runs).
-    The stamp NEVER kills a finished measurement: a named contract
-    violation reads as audit_ok=false, and an unexpected stamp failure
-    (a scratch file under scripts/ that doesn't parse, a malformed
-    baseline, a backendless process) degrades to null fields plus an
-    `error` string instead of an exception."""
+    """{lint_findings, concurrency_findings, audit_ok[, error]} — computed
+    once per process (lru_cache) and stamped on every artifact line, so a
+    MULTICHIP/BENCH JSON records whether the measured build also honored
+    the static contracts (docs/STATIC_ANALYSIS.md). `lint_findings` counts
+    the PR 8 source-lint rules, `concurrency_findings` the ASYNC/LOCK
+    auditor's (both post-baseline); the audit covers the 8 comm x overlap
+    step programs (the form every measured strategy runs). The stamp NEVER
+    kills a finished measurement: a named contract violation reads as
+    audit_ok=false, and an unexpected stamp failure (a scratch file under
+    scripts/ that doesn't parse, a malformed baseline, a backendless
+    process) degrades to null fields plus an `error` string instead of an
+    exception."""
     from pytorch_ddp_mnist_tpu.statics import jaxpr_audit, lint
-    out = {"lint_findings": None, "audit_ok": None}
+    from pytorch_ddp_mnist_tpu.statics.rules import CONCURRENCY_RULES
+    out = {"lint_findings": None, "concurrency_findings": None,
+           "audit_ok": None}
     try:
         findings, _ = lint.lint_paths(lint.default_targets())
         new, _, _ = lint.apply_baseline(
             findings, lint.load_baseline(lint.default_baseline_path()))
-        out["lint_findings"] = len(new)
+        n_conc = sum(1 for f in new if f.rule in CONCURRENCY_RULES)
+        out["lint_findings"] = len(new) - n_conc
+        out["concurrency_findings"] = n_conc
     except (OSError, SyntaxError, UnicodeDecodeError, ValueError) as e:
         out["error"] = f"lint: {e}"[:300]
     try:

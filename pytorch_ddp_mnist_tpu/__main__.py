@@ -10,9 +10,11 @@ framework's executables (each also runs standalone as its own module):
     convert    IDX -> NetCDF converter (data/convert.py; the
                mnist_to_netcdf.ipynb workflow)
     download   mirrored, checksum-verified MNIST IDX fetch (data/download.py)
-    lint       JAX-aware source lint — host syncs in traced code, wire
-               dtypes, overbroad excepts, unlocked globals... with a
-               committed baseline (statics/lint.py; docs/STATIC_ANALYSIS.md)
+    lint       JAX-aware source lint + concurrency auditor — host syncs in
+               traced code, wire dtypes, overbroad excepts, unlocked
+               globals, blocking calls on the serve event loop, lock-order
+               cycles... with a committed baseline (statics/lint.py +
+               statics/concurrency.py; docs/STATIC_ANALYSIS.md)
     audit-program
                lower the comm x overlap step-program matrix and assert the
                collective/dtype/wire-byte contracts per strategy

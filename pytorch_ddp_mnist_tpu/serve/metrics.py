@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import math
+import threading
 import time
 from typing import Callable, Optional
 
@@ -73,11 +74,25 @@ class SLOWindow:
         self._done_t: "collections.deque[float]" = collections.deque(
             maxlen=self.window)
         self._sorted: "Optional[list]" = None
+        # The window is WRITTEN by the serve event loop (record() per
+        # completion) and READ by the Prometheus scrape thread through the
+        # serve.rolling_p99_s / serve.service_rate_rps gauge callables —
+        # and percentile()'s "read" also WRITES the sorted cache, so a
+        # scrape thread mutates state the loop is concurrently
+        # invalidating (the LOCK001 class; prom.py's lock-light-scrape
+        # contract assumes reads are READ-only). Under the GIL the
+        # observable failure is a stale/over-written cache, not a crash —
+        # still a data race by contract, and a real one on free-threaded
+        # builds. One lock makes each method atomic; the sort-at-most-
+        # once-per-completion cost story is unchanged, and no caller
+        # holds this across an await.
+        self._lock = threading.Lock()
 
     def record(self, latency_s: float, t_done: float) -> None:
-        self._lat.append(float(latency_s))
-        self._done_t.append(float(t_done))
-        self._sorted = None
+        with self._lock:
+            self._lat.append(float(latency_s))
+            self._done_t.append(float(t_done))
+            self._sorted = None
 
     @property
     def n(self) -> int:
@@ -85,20 +100,22 @@ class SLOWindow:
 
     def percentile(self, q: float) -> float:
         """Exact q-quantile over the window (nearest-rank); 0.0 empty."""
-        if self._sorted is None:
-            self._sorted = sorted(self._lat)
-        return nearest_rank(self._sorted, q)
+        with self._lock:
+            if self._sorted is None:
+                self._sorted = sorted(self._lat)
+            return nearest_rank(self._sorted, q)
 
     def service_rate(self) -> Optional[float]:
         """Completions/sec over the window's first..last completion wall
         span; None until two completions exist or when the span is zero
         (injected clocks)."""
-        if len(self._done_t) < 2:
-            return None
-        span = self._done_t[-1] - self._done_t[0]
-        if span <= 0:
-            return None
-        return (len(self._done_t) - 1) / span
+        with self._lock:
+            if len(self._done_t) < 2:
+                return None
+            span = self._done_t[-1] - self._done_t[0]
+            if span <= 0:
+                return None
+            return (len(self._done_t) - 1) / span
 
     def snapshot(self) -> dict:
         rate = self.service_rate()

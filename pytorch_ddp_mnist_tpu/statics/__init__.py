@@ -1,7 +1,7 @@
 """statics/ — JAX-aware static analysis: the repo's contracts, machine-checked.
 
-Two passes behind one CLI (`python -m pytorch_ddp_mnist_tpu lint` /
-`... audit-program`):
+Three static passes behind one CLI (`python -m pytorch_ddp_mnist_tpu lint`
+/ `... audit-program`) plus a runtime-sanitizer layer:
 
   * **Source lint** (`rules.py` + `lint.py`, stdlib `ast` only — the
     check_telemetry.py discipline: loadable by file path on hosts without
@@ -13,6 +13,15 @@ Two passes behind one CLI (`python -m pytorch_ddp_mnist_tpu lint` /
     race, as a rule). A committed `baseline.json` suppresses accepted
     findings with a reason string, so CI fails only on NEW ones.
 
+  * **Concurrency auditor** (`concurrency.py`, same discipline, same
+    baseline/CLI plumbing): a thread-entry map (async defs + loop-
+    scheduled callbacks, `threading.Thread` targets, signal handlers) and
+    the interaction rules PR 8's per-statement lint cannot see — blocking
+    calls on the serve event loop (ASYNC001, the PR 9 sort-per-request
+    class), `await` under a sync lock (ASYNC002), shared state written
+    both under and outside a lock (LOCK001, the snapshot-race class), and
+    lock-acquisition-order cycles over a cross-file graph (LOCK002).
+
   * **Program auditor** (`jaxpr_audit.py`): lower the full step-program
     matrix (comm x overlap x {streaming step, fit_cached scan body}) over
     a deviceless 8-way AbstractMesh and walk the jaxpr asserting the
@@ -22,18 +31,29 @@ Two passes behind one CLI (`python -m pytorch_ddp_mnist_tpu lint` /
     bytes-on-wire recomputed from the audited program matching the
     `ddp.bytes_on_wire` cost model.
 
-`lint` imports nothing outside the stdlib; `jaxpr_audit` imports jax (and
-the step builders) lazily inside its functions, so importing this package
-stays cheap.
+  * **Runtime sanitizers** (`sanitize.py`): what the static passes cannot
+    prove, checked on a live run — `no_host_sync()` (the PR 6/9 test
+    interception technique as a context manager: block_until_ready +
+    device-fetch budgets), `event_loop_stall()` (per-callback stall
+    detector on the asyncio loop), `lock_trace()` (runtime acquisition-
+    order recording that confirms/refutes LOCK002). `make sanitize-smoke`
+    arms all three over the serve selftest and a short training run.
+
+`lint`/`concurrency`/`sanitize` import nothing outside the stdlib at
+module scope; `jaxpr_audit` (and `no_host_sync.__enter__`) import jax
+lazily, so importing this package stays cheap.
 
 docs/STATIC_ANALYSIS.md carries the rule catalog, the per-strategy audit
-contract table, and the baseline workflow.
+contract table, the baseline workflow, and the sanitizer guide.
 """
 
 from __future__ import annotations
 
-from .rules import RULES, Finding, Rule  # noqa: F401
-from .lint import lint_paths, lint_source, load_baseline  # noqa: F401
+from .rules import CONCURRENCY_RULES, RULES, Finding, Rule  # noqa: F401
+from .lint import check_docs, lint_paths, lint_source, load_baseline  # noqa: F401
+from .concurrency import ConcurrencyAuditor, analyze_source  # noqa: F401
+from . import sanitize  # noqa: F401
 
-__all__ = ["RULES", "Rule", "Finding", "lint_source", "lint_paths",
-           "load_baseline"]
+__all__ = ["RULES", "CONCURRENCY_RULES", "Rule", "Finding", "lint_source",
+           "lint_paths", "load_baseline", "check_docs",
+           "ConcurrencyAuditor", "analyze_source", "sanitize"]

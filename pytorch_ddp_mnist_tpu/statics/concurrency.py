@@ -1,0 +1,473 @@
+"""Concurrency auditor — thread-entry map + ASYNC/LOCK rules, stdlib `ast`.
+
+PR 8's lint reads single statements; the bugs that survived it were
+*interaction* bugs: a blocking call on the serve event loop (the PR 9
+SLOWindow sort), shared state written with and without its lock (the PR 6
+snapshot race), check-then-install races on module globals. This engine
+checks those classes the same way `lint.py` checks the sync/dtype
+contracts — purely syntactic, loadable by file path on hosts without the
+framework, flowing into the same baseline/exit-code machinery.
+
+Three passes per file:
+
+  1. **Thread-entry map**: which functions run on which execution context.
+     Event-loop residents are every `async def`, every function whose NAME
+     is scheduled onto the loop (`call_soon`/`call_later`/`call_at`/
+     `call_soon_threadsafe`/`create_task`/`ensure_future`/
+     `run_coroutine_threadsafe`), and — fixpoint — every same-module
+     function a resident calls (by bare or method name: the lint's
+     name-within-module over-approximation). `threading.Thread(target=...)`
+     targets and `signal.signal`/`add_signal_handler` handlers land in the
+     map too (`ConcurrencyAuditor.entries`, for reports and docs).
+  2. **ASYNC rules** over loop-resident bodies: ASYNC001 (blocking call —
+     `time.sleep`, file/`subprocess`/`shutil` IO, `sorted()`/`.sort()`
+     over a stored window, `block_until_ready`/`device_sync`, lock
+     `.acquire()` with no timeout) and ASYNC002 (`await` lexically inside
+     a sync `with <lock>:` block; `async with` is exempt).
+  3. **LOCK rules**: LOCK001 — a `self.X` attribute (grouped per class)
+     or a `global` name written under a lock at one site and bare at
+     another (constructors exempt); LOCK002 — the lock-order graph from
+     nested with-blocks/`.acquire()` sites, lock identity by qualified
+     name (`self._lock` -> `ClassName._lock`, module globals by name, so
+     the graph unions across files), any cycle flagged at the edges that
+     close it. The lexical graph cannot see cross-module call chains —
+     `statics.sanitize.lock_trace()` is the runtime half that can.
+
+"Lock-ish" matching is by name, like MUT002: a context expression whose
+dotted spelling contains ``lock``/``mutex``, or a direct
+``threading.Lock()/RLock()`` call.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+try:
+    from .rules import (RULES, Finding, dotted_name as _dotted,
+                        last_segment as _last, root_segment as _root)
+except ImportError:
+    # Loaded BY FILE PATH with no package context (the check_telemetry.py
+    # copied-alone pattern): pull the sibling rules.py the same way,
+    # reusing lint.py's module instance when it got there first.
+    import importlib.util as _ilu
+    if "_pdmt_statics_rules" in sys.modules:
+        _rules = sys.modules["_pdmt_statics_rules"]
+    else:
+        _spec = _ilu.spec_from_file_location(
+            "_pdmt_statics_rules",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "rules.py"))
+        _rules = _ilu.module_from_spec(_spec)
+        sys.modules["_pdmt_statics_rules"] = _rules
+        _spec.loader.exec_module(_rules)
+    RULES, Finding = _rules.RULES, _rules.Finding
+    _dotted, _last, _root = (_rules.dotted_name, _rules.last_segment,
+                             _rules.root_segment)
+
+# Call sites whose function-valued arguments run on the event loop even
+# though they are not themselves `async def`.
+LOOP_CALLBACK_SINKS = {
+    "call_soon", "call_later", "call_at", "call_soon_threadsafe",
+    "create_task", "ensure_future", "run_coroutine_threadsafe",
+}
+
+# ASYNC001's blocking-call vocabulary (module-rooted).
+_BLOCKING_ROOTS = {"subprocess", "shutil"}      # any call under these
+_OS_BLOCKING = {"makedirs", "replace", "rename", "remove", "unlink",
+                "fsync", "stat", "listdir", "system", "popen"}
+_LOCK_FACTORIES = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
+                   "Condition"}
+
+
+def _is_lockish(expr) -> bool:
+    """Name-based lock detection (the MUT002 convention: name your locks
+    `*lock*`). A Call is unwrapped so `threading.Lock()` inline counts."""
+    if isinstance(expr, ast.Call):
+        if _last(expr.func) in _LOCK_FACTORIES:
+            return True
+        expr = expr.func
+    d = _dotted(expr) or ""
+    low = d.lower()
+    return "lock" in low or "mutex" in low
+
+
+def _scoped_walk(root) -> Iterable[ast.AST]:
+    """Walk `root`'s body without descending into nested function/class
+    definitions (they own their own residency/locking story)."""
+    stack = list(root.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+_CTOR_NAMES = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+class _FileAudit:
+    """One file's concurrency pass. Produces per-file findings plus the
+    file's lock-order edges for the cross-file LOCK002 graph."""
+
+    def __init__(self, tree: ast.Module, path: str, lines):
+        self.tree = tree
+        self.path = path
+        self.lines = lines
+        self.findings: List[Finding] = []
+        # (src_lock_id, dst_lock_id, line, col, content)
+        self.edges: List[Tuple[str, str, int, int, str]] = []
+        self.entries: Dict[str, Set[str]] = {
+            "loop": set(), "thread": set(), "signal": set()}
+        # def name -> [(node, class name or None)]
+        self._defs: Dict[str, List[Tuple[ast.AST, Optional[str]]]] = {}
+        self._reason: Dict[int, str] = {}   # id(def node) -> residency why
+
+    # -- plumbing ----------------------------------------------------------
+
+    def flag(self, rule_id: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        content = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        self.findings.append(Finding(
+            rule=rule_id, path=self.path, line=line, col=col,
+            message=message, content=content, hint=RULES[rule_id].hint))
+
+    # -- pass 1: thread-entry map ------------------------------------------
+
+    def _collect_defs(self) -> None:
+        def visit(node, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    self._defs.setdefault(child.name, []).append(
+                        (child, cls))
+                    visit(child, cls)
+                else:
+                    visit(child, cls)
+        visit(self.tree, None)
+
+    def _arg_names(self, exprs) -> Set[str]:
+        names: Set[str] = set()
+        for e in exprs:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    names.add(n.attr)
+        return names
+
+    def _mark_residents(self) -> List[Tuple[ast.AST, Optional[str]]]:
+        resident: Dict[int, Tuple[ast.AST, Optional[str]]] = {}
+        for name, defs in self._defs.items():
+            for node, cls in defs:
+                if isinstance(node, ast.AsyncFunctionDef):
+                    resident[id(node)] = (node, cls)
+                    self._reason[id(node)] = f"async def '{name}'"
+                    self.entries["loop"].add(name)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            sink = _last(node.func)
+            if sink in LOOP_CALLBACK_SINKS:
+                for nm in self._arg_names(node.args):
+                    for fn, cls in self._defs.get(nm, ()):
+                        resident.setdefault(id(fn), (fn, cls))
+                        self._reason.setdefault(
+                            id(fn), f"'{nm}' scheduled on the event loop "
+                                    f"via {sink}()")
+                        self.entries["loop"].add(nm)
+            elif sink == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        self.entries["thread"] |= self._arg_names([kw.value])
+            elif sink in ("signal", "add_signal_handler") \
+                    and len(node.args) >= 2:
+                self.entries["signal"] |= self._arg_names(node.args[1:])
+        # fixpoint: a resident's same-module callees become resident
+        changed = True
+        while changed:
+            changed = False
+            for fn, cls in list(resident.values()):
+                for sub in _scoped_walk(fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = _last(sub.func)
+                    for cand, ccls in self._defs.get(callee, ()):
+                        if id(cand) not in resident:
+                            resident[id(cand)] = (cand, ccls)
+                            self._reason[id(cand)] = (
+                                f"'{callee}' called from event-loop-"
+                                f"resident '{fn.name}'")
+                            self.entries["loop"].add(callee)
+                            changed = True
+        return list(resident.values())
+
+    # -- pass 2: ASYNC rules -----------------------------------------------
+
+    def _check_async001(self, fn) -> None:
+        where = self._reason.get(id(fn), f"'{fn.name}'")
+        for node in _scoped_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            d = _dotted(callee) or ""
+            last = _last(callee)
+            root = _root(callee)
+            what = None
+            if d == "time.sleep":
+                what = "time.sleep() parks the whole loop, not one task"
+            elif root in _BLOCKING_ROOTS:
+                what = f"{d}() does blocking process/file IO"
+            elif root == "os" and last in _OS_BLOCKING:
+                what = f"{d}() does blocking file IO"
+            elif isinstance(callee, ast.Name) and callee.id == "open":
+                what = "open() does blocking file IO"
+            elif last in ("block_until_ready", "device_sync"):
+                what = (f"{d or last}() forces a device drain on the "
+                        f"loop thread")
+            elif last == "acquire":
+                kwargs = {k.arg for k in node.keywords}
+                nonblocking = (node.args
+                               and isinstance(node.args[0], ast.Constant)
+                               and node.args[0].value is False)
+                if "timeout" not in kwargs and not nonblocking:
+                    what = (f"{d or '.acquire'}() with no timeout can "
+                            f"block the loop behind another thread")
+            elif isinstance(callee, ast.Name) and callee.id == "sorted" \
+                    and node.args \
+                    and isinstance(node.args[0],
+                                   (ast.Name, ast.Attribute)):
+                what = (f"sorted({_dotted(node.args[0])}) re-sorts a "
+                        f"stored window per call (the PR 9 SLOWindow "
+                        f"class)")
+            elif isinstance(callee, ast.Attribute) and callee.attr == "sort" \
+                    and isinstance(callee.value, (ast.Name, ast.Attribute)):
+                what = (f"{d}() sorts a stored window in place on the "
+                        f"loop thread")
+            if what:
+                self.flag("ASYNC001", node,
+                          f"{what} — reachable from the serve event loop "
+                          f"({where})")
+
+    def _check_async002(self, fn) -> None:
+        def walk(node, lock_node) -> None:
+            if isinstance(node, ast.Await) and lock_node is not None:
+                self.flag("ASYNC002", node,
+                          f"await inside `with "
+                          f"{_dotted(lock_node) or 'lock'}:` in "
+                          f"'{fn.name}' holds a sync lock across a "
+                          f"suspension point")
+                # keep walking: one with-block can hold several awaits
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)) \
+                    and node is not fn:
+                return
+            held = lock_node
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if _is_lockish(item.context_expr):
+                        held = item.context_expr
+                        break
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+        walk(fn, None)
+
+    # -- pass 3: LOCK rules ------------------------------------------------
+
+    def _lock_id(self, expr, cls: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        d = _dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and cls:
+            return f"{cls}.{d[5:]}"
+        return d
+
+    def _check_lock001_and_edges(self) -> None:
+        # (kind, scope, name) -> [(locked, node, fn name)]
+        writes: Dict[Tuple[str, str, str],
+                     List[Tuple[bool, ast.AST, str]]] = {}
+        for name, defs in self._defs.items():
+            for fn, cls in defs:
+                self._scan_fn(fn, cls, writes)
+        for (kind, scope, name), sites in writes.items():
+            if not any(locked for locked, _, _ in sites):
+                continue
+            spelled = f"self.{name}" if kind == "attr" else name
+            guarded_in = sorted({f for locked, _, f in sites if locked})
+            for locked, node, fname in sites:
+                if locked:
+                    continue
+                self.flag("LOCK001", node,
+                          f"{spelled} written in '{fname}' without the "
+                          f"lock that guards it in "
+                          f"{', '.join(repr(g) for g in guarded_in)} — "
+                          f"the unlocked write races every locked "
+                          f"reader/writer")
+
+    def _scan_fn(self, fn, cls: Optional[str], writes) -> None:
+        declared_globals: Set[str] = set()
+        for node in _scoped_walk(fn):
+            if isinstance(node, ast.Global):
+                declared_globals |= set(node.names)
+        is_ctor = fn.name in _CTOR_NAMES
+
+        def record_write(target, locked: bool, node) -> None:
+            if is_ctor:
+                return
+            if isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" and cls:
+                writes.setdefault(("attr", cls, target.attr), []).append(
+                    (locked, node, fn.name))
+            elif isinstance(target, ast.Name) \
+                    and target.id in declared_globals:
+                writes.setdefault(("global", self.path, target.id),
+                                  []).append((locked, node, fn.name))
+
+        def walk(node, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)) \
+                    and node is not fn:
+                return
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    record_write(t, bool(held), node)
+            elif isinstance(node, ast.AugAssign) or (
+                    isinstance(node, ast.AnnAssign)
+                    and node.value is not None):
+                # a value-less AnnAssign (`self._n: int`) is a pure
+                # annotation: no store happens at runtime
+                record_write(node.target, bool(held), node)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire" \
+                    and _is_lockish(node.func.value):
+                lid = self._lock_id(node.func.value, cls)
+                if lid is not None:
+                    for h in held:
+                        if h != lid:
+                            self._edge(h, lid, node)
+            new_held = held
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if _is_lockish(item.context_expr):
+                        lid = self._lock_id(item.context_expr, cls)
+                        if lid is not None:
+                            for h in held:
+                                if h != lid:
+                                    self._edge(h, lid, item.context_expr)
+                            if lid not in new_held:
+                                new_held = new_held + (lid,)
+            for child in ast.iter_child_nodes(node):
+                walk(child, new_held)
+
+        walk(fn, ())
+
+    def _edge(self, src: str, dst: str, node) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        content = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        self.edges.append((src, dst, line, col, content))
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._collect_defs()
+        for fn, _cls in self._mark_residents():
+            self._check_async001(fn)
+            if isinstance(fn, ast.AsyncFunctionDef):
+                self._check_async002(fn)
+        self._check_lock001_and_edges()
+
+
+class ConcurrencyAuditor:
+    """Feed files with `add_source`; `finish()` runs LOCK002 over the
+    union lock-order graph (lock ids are class-/name-qualified, not
+    path-qualified, so a lock nested differently in two files still forms
+    a cycle) and returns every finding."""
+
+    def __init__(self):
+        self._findings: List[Finding] = []
+        # (src, dst) -> (path, line, col, content) of the first such edge
+        self._edges: Dict[Tuple[str, str],
+                          Tuple[str, int, int, str]] = {}
+        self.entries: Dict[str, Set[str]] = {
+            "loop": set(), "thread": set(), "signal": set()}
+
+    def add_source(self, src: str, path: str = "<string>", *,
+                   tree: Optional[ast.Module] = None) -> List[Finding]:
+        """Audit one file; returns (and retains) its per-file findings.
+        Pass `tree` when the caller already parsed `src` (lint_paths
+        does) — parsing dominates the pass, so the engines share one."""
+        if tree is None:
+            tree = ast.parse(src, filename=path)
+        audit = _FileAudit(tree, path, src.splitlines())
+        audit.run()
+        self._findings.extend(audit.findings)
+        for key, names in audit.entries.items():
+            self.entries[key] |= names
+        for src_id, dst_id, line, col, content in audit.edges:
+            self._edges.setdefault((src_id, dst_id),
+                                   (path, line, col, content))
+        return audit.findings
+
+    def edge_graph(self) -> Dict[str, Set[str]]:
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self._edges:
+            adj.setdefault(a, set()).add(b)
+        return adj
+
+    def _cycle_through(self, a: str, b: str) -> Optional[List[str]]:
+        """A path b -> ... -> a in the edge graph (so edge a->b closes a
+        cycle), or None."""
+        adj = self.edge_graph()
+        stack, seen = [(b, [b])], set()
+        while stack:
+            node, path = stack.pop()
+            if node == a:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in sorted(adj.get(node, ())):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def finish(self) -> List[Finding]:
+        """LOCK002 over the union graph, then every finding, sorted."""
+        for (a, b), (path, line, col, content) in sorted(
+                self._edges.items()):
+            cycle = self._cycle_through(a, b)
+            if cycle is not None:
+                loop = " -> ".join([a] + cycle)
+                self._findings.append(Finding(
+                    rule="LOCK002", path=path, line=line, col=col,
+                    message=f"lock order cycle {loop}: this edge "
+                            f"acquires {b} while holding {a}, the "
+                            f"reverse order exists elsewhere (potential "
+                            f"deadlock)",
+                    content=content, hint=RULES["LOCK002"].hint))
+        uniq = {}
+        for f in self._findings:
+            uniq[(f.rule, f.path, f.line, f.col, f.message)] = f
+        return sorted(uniq.values(),
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def analyze_source(src: str, path: str = "<string>", *,
+                   tree: Optional[ast.Module] = None) -> List[Finding]:
+    """Single-file audit (LOCK002 sees only this file's edges)."""
+    auditor = ConcurrencyAuditor()
+    auditor.add_source(src, path, tree=tree)
+    return auditor.finish()

@@ -23,12 +23,19 @@ the file without it); anything else fails the run. Keying on line CONTENT
 instead of line number keeps entries stable as unrelated code moves, and
 re-surfaces the finding the moment the flagged line itself is edited.
 
+The concurrency auditor (`concurrency.py`: thread-entry map, ASYNC001/
+ASYNC002/LOCK001/LOCK002) runs as a third pass through the same engine —
+per file here, with one union lock-order graph in `lint_paths` — and its
+findings flow into the same baseline/exit-code machinery.
+
 CLI (also reachable as `python -m pytorch_ddp_mnist_tpu lint`):
 
     python -m pytorch_ddp_mnist_tpu.statics.lint [paths...]
         [--json] [--baseline FILE] [--no-baseline] [--prune-baseline]
+        [--check-docs]
 
-Exit codes: 0 clean (stale-only is clean), 1 new findings, 2 usage.
+Exit codes: 0 clean (stale-only is clean), 1 new findings (or doc drift
+under --check-docs), 2 usage.
 """
 
 from __future__ import annotations
@@ -40,20 +47,32 @@ import sys
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 try:
-    from .rules import RULES, Finding
+    from .rules import (RULES, Finding, dotted_name as _dotted,
+                        last_segment as _last, root_segment as _root)
+    from . import concurrency
 except ImportError:
     # Loaded BY FILE PATH with no package context (the check_telemetry.py
     # copied-alone pattern — a CI host without the framework installed):
-    # pull the sibling rules.py the same way.
+    # pull the sibling rules.py and concurrency.py the same way.
     import importlib.util as _ilu
-    _spec = _ilu.spec_from_file_location(
-        "_pdmt_statics_rules",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "rules.py"))
-    _rules = _ilu.module_from_spec(_spec)
-    sys.modules["_pdmt_statics_rules"] = _rules   # dataclasses needs it
-    _spec.loader.exec_module(_rules)
+
+    def _load_sibling(stem: str):
+        key = f"_pdmt_statics_{stem}"
+        if key in sys.modules:
+            return sys.modules[key]
+        spec = _ilu.spec_from_file_location(
+            key, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              f"{stem}.py"))
+        mod = _ilu.module_from_spec(spec)
+        sys.modules[key] = mod   # dataclasses needs it
+        spec.loader.exec_module(mod)
+        return mod
+
+    _rules = _load_sibling("rules")
     RULES, Finding = _rules.RULES, _rules.Finding
+    _dotted, _last, _root = (_rules.dotted_name, _rules.last_segment,
+                             _rules.root_segment)
+    concurrency = _load_sibling("concurrency")
 
 # Call sites whose function-valued arguments become traced code. Last
 # dotted segment is matched, so `jax.jit`, `jax.lax.scan` and a bare
@@ -85,26 +104,9 @@ _HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _NP_SYNC_CALLS = {"asarray", "array", "copyto", "save", "savez"}
 
 
-def _dotted(node) -> Optional[str]:
-    """'a.b.c' for a Name/Attribute chain, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _last(node) -> Optional[str]:
-    d = _dotted(node)
-    return d.rsplit(".", 1)[-1] if d else None
-
-
-def _root(node) -> Optional[str]:
-    d = _dotted(node)
-    return d.split(".", 1)[0] if d else None
+# _dotted/_last/_root live in rules.py (dotted_name/last_segment/
+# root_segment) — shared with concurrency.py so the two engines can never
+# drift on name resolution.
 
 
 def _scoped_body(func) -> Iterable[ast.AST]:
@@ -420,9 +422,13 @@ def repo_root() -> str:
 
 
 def lint_source(src: str, path: str = "<string>") -> List[Finding]:
-    """Lint one source string; `path` is stamped into findings verbatim."""
+    """Lint one source string — the PR 8 rule set plus the concurrency
+    auditor (LOCK002 sees only this file's lock-order edges; lint_paths
+    runs it over the union graph). `path` is stamped verbatim."""
     tree = ast.parse(src, filename=path)
-    return _Linter(tree, path, src.splitlines()).run()
+    findings = _Linter(tree, path, src.splitlines()).run()
+    findings.extend(concurrency.analyze_source(src, path, tree=tree))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 def _iter_py_files(paths: Iterable[str]) -> List[str]:
@@ -443,9 +449,12 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None
                ) -> Tuple[List[Finding], int]:
     """Lint files/directories; returns (findings, files checked). Finding
     paths are repo-root-relative ('/'-separated) so baseline entries are
-    machine-independent."""
+    machine-independent. The concurrency auditor runs with ONE shared
+    lock-order graph across every file, so LOCK002 catches a lock pair
+    nested one way in module A and the other way in module B."""
     root = root or repo_root()
     findings: List[Finding] = []
+    auditor = concurrency.ConcurrencyAuditor()
     files = _iter_py_files(paths)
     for path in files:
         with open(path, encoding="utf-8") as f:
@@ -453,7 +462,12 @@ def lint_paths(paths: Iterable[str], root: Optional[str] = None
         rel = os.path.relpath(os.path.abspath(path), root)
         if rel.startswith(".."):
             rel = os.path.abspath(path)
-        findings.extend(lint_source(src, rel.replace(os.sep, "/")))
+        rel = rel.replace(os.sep, "/")
+        tree = ast.parse(src, filename=rel)
+        findings.extend(_Linter(tree, rel, src.splitlines()).run())
+        auditor.add_source(src, rel, tree=tree)
+    findings.extend(auditor.finish())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, len(files)
 
 
@@ -519,6 +533,36 @@ def prune_baseline(path: str, baseline: dict, stale: List[dict]) -> int:
     return len(baseline.get("entries", [])) - len(kept)
 
 
+# -- rule-catalog / doc drift ------------------------------------------------
+
+def default_docs_path() -> str:
+    return os.path.join(repo_root(), "docs", "STATIC_ANALYSIS.md")
+
+
+def check_docs(doc_path: Optional[str] = None) -> List[str]:
+    """Assert the rule catalog and docs/STATIC_ANALYSIS.md agree: every
+    rule ID in rules.py has a `| \\`ID\\` |` table row, and every ID the
+    doc tables name exists in the catalog. Returns human-readable drift
+    messages ([] = in sync). The doc side matches backticked IDs at the
+    start of a table row, so prose mentions of retired rules don't count
+    as rows."""
+    import re
+    path = doc_path or default_docs_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return [f"cannot read rule-catalog doc: {e}"]
+    doc_ids = set(re.findall(r"^\|\s*`([A-Z]+[0-9]{3})`", text,
+                             flags=re.MULTILINE))
+    errors = [f"rule {rid} has no table row in {os.path.basename(path)}"
+              for rid in sorted(set(RULES) - doc_ids)]
+    errors += [f"{os.path.basename(path)} documents unknown rule {rid} "
+               f"(retired? drop the row)"
+               for rid in sorted(doc_ids - set(RULES))]
+    return errors
+
+
 # -- CLI ---------------------------------------------------------------------
 
 def default_targets() -> List[str]:
@@ -554,7 +598,22 @@ def main(argv=None) -> int:
                    help="ignore the baseline (report everything)")
     p.add_argument("--prune-baseline", action="store_true",
                    help="rewrite the baseline without stale entries")
+    p.add_argument("--check-docs", action="store_true",
+                   help="check rule-catalog/doc drift instead of linting: "
+                        "every rule ID in statics/rules.py must have a "
+                        "table row in docs/STATIC_ANALYSIS.md and vice "
+                        "versa (exit 1 on drift)")
     a = p.parse_args(argv)
+
+    if a.check_docs:
+        drift = check_docs()
+        for msg in drift:
+            print(f"lint: doc drift: {msg}", file=sys.stderr)
+        if drift:
+            return 1
+        print(f"lint: OK — rule catalog and docs/STATIC_ANALYSIS.md "
+              f"agree on {len(RULES)} rule(s)")
+        return 0
 
     try:
         findings, n_files = lint_paths(a.paths or default_targets())

@@ -16,12 +16,44 @@ Scope vocabulary used below:
     function NAME within the module — a deliberate over-approximation
     (two defs sharing a name are both marked) that keeps the pass purely
     syntactic.
+  * "event loop" — the body of a function the concurrency auditor
+    (`concurrency.py`) marks as event-loop-resident: every `async def`,
+    plus any same-module function whose name is scheduled onto the loop
+    (`call_soon`/`call_later`/`call_at`/`create_task`/`ensure_future`/...)
+    or called from a resident function — the same name-within-module
+    over-approximation as "traced", applied to the thread-entry map.
   * "anywhere" — the whole file.
 """
 
 from __future__ import annotations
 
+import ast
 from dataclasses import dataclass, field
+from typing import Optional
+
+
+def dotted_name(node) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None — the ONE dotted-name
+    resolution both engines (lint.py, concurrency.py) share, so they can
+    never disagree on what a callee is."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node) -> Optional[str]:
+    d = dotted_name(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def root_segment(node) -> Optional[str]:
+    d = dotted_name(node)
+    return d.split(".", 1)[0] if d else None
 
 
 @dataclass(frozen=True)
@@ -160,4 +192,64 @@ RULES = {r.id: r for r in [
         hint="guard the read-modify-write with a module-level "
              "threading.Lock",
         scope="anywhere"),
+    Rule(
+        id="ASYNC001",
+        title="blocking call on the event loop",
+        rationale=(
+            "time.sleep / file & subprocess IO / a sorted()/.sort() over a "
+            "shared window / block_until_ready / an untimeout'd lock "
+            ".acquire() inside a coroutine (or a callback the loop "
+            "schedules) stalls EVERY in-flight request, not just its own — "
+            "the PR 9 bug was exactly this: an O(W log W) sort on the "
+            "serve loop per offered request, inflating the very queue "
+            "delay its admission predictor was computing."),
+        hint="await the async spelling (asyncio.sleep, executors), cache "
+             "the sort per completion, or move the work off-loop",
+        scope="event loop"),
+    Rule(
+        id="ASYNC002",
+        title="await while holding a sync lock",
+        rationale=(
+            "`with threading.Lock(): await ...` parks the coroutine with "
+            "the lock still held; any OTHER thread (the Prometheus scrape "
+            "thread, a readahead worker) then blocks on that lock for as "
+            "long as the await takes — and if resuming the coroutine "
+            "needs that thread, the process deadlocks. Sync locks must "
+            "not span suspension points."),
+        hint="release before awaiting, or use asyncio.Lock (async with) "
+             "for loop-side exclusion",
+        scope="event loop"),
+    Rule(
+        id="LOCK001",
+        title="shared state written both under and outside a lock",
+        rationale=(
+            "An attribute/global assigned under a lock in one method and "
+            "bare in another means the lock guards nothing: the unlocked "
+            "writer races every locked reader — the "
+            "MetricsRegistry.snapshot()-vs-scrape-thread class (PR 6), "
+            "and the SLOWindow sorted-cache written from both the serve "
+            "loop and the /metrics scrape thread. Construction "
+            "(`__init__`) is exempt: it happens-before publication."),
+        hint="take the same lock at every write site (or stop locking any "
+             "of them and document why the state is single-threaded)",
+        scope="anywhere"),
+    Rule(
+        id="LOCK002",
+        title="inconsistent lock-acquisition order",
+        rationale=(
+            "Nesting lock B inside lock A in one function and A inside B "
+            "in another is a deadlock waiting for the right interleaving "
+            "— two threads each holding one and blocking on the other. "
+            "Detection is lexical (with-blocks and .acquire() sites per "
+            "file, lock identity by qualified name); the runtime "
+            "`sanitize.lock_trace()` confirms or refutes findings across "
+            "the real cross-module call graph."),
+        hint="pick one global order for the lock pair and acquire in that "
+             "order everywhere (or collapse to one lock)",
+        scope="anywhere"),
 ]}
+
+# The concurrency auditor's rule IDs (engine: concurrency.py) — the split
+# bench.py's artifact stamp reports as `concurrency_findings` beside the
+# source lint's `lint_findings`.
+CONCURRENCY_RULES = frozenset({"ASYNC001", "ASYNC002", "LOCK001", "LOCK002"})

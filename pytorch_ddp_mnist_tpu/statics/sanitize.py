@@ -1,0 +1,382 @@
+"""Runtime contract sanitizers — the hand-rolled test interceptions,
+promoted to one reusable layer.
+
+The source rules (`lint.py` + `concurrency.py`) read what the code SAYS;
+these context managers check what a RUN actually does. Each one packages a
+technique the test suite invented ad hoc and re-implemented per test:
+
+  * `no_host_sync()` — the PR 6 watchdog pin + PR 9 serve-tracing pin as
+    one tool: intercepts `jax.block_until_ready`, `jax.device_get` and
+    `np.asarray`-of-a-`jax.Array` (the repo's two fetch choke points) for
+    the duration of the block, counts them, and — against optional budgets
+    — fails the block that silently grew a per-step host sync. The lint's
+    SYNC001 catches the *traced-code* spellings statically; this catches
+    the host-side loop that fetches too often, which no source rule can.
+  * `event_loop_stall(threshold_ms)` — the PR 9 bug (an O(W log W) sort on
+    the serve event loop per offered request) as a harness: times every
+    callback and coroutine step through `asyncio.events.Handle._run` (the
+    one choke point all of them pass), records any single run past the
+    threshold, and fails the block. Needs no debug mode and no control of
+    how the loop was created.
+  * `lock_trace()` — LOCK002's runtime half: patches the
+    `threading.Lock`/`RLock` factories so every lock created inside the
+    block records its acquisition order (per-thread held-stack -> directed
+    edges keyed by creation site), then fails on any cycle in the observed
+    graph. Confirms or refutes the lexical auditor's findings across the
+    real cross-module call graph. Locks created BEFORE the block (module
+    import time) are not traced — arm it early; the lexical pass covers
+    the import-time singletons.
+
+All three are pure stdlib at import time (numpy/jax resolve lazily inside
+`no_host_sync.__enter__`, gated — a jax-less host degrades to unarmed with
+zero counts), patch process-wide entry points only for the duration of the
+`with` block, restore them on exit even when the block raises, and raise a
+`SanitizerError` subclass only when the block itself succeeded (a primary
+failure is never masked by the sanitizer's verdict).
+
+`scripts/sanitize_smoke.py` (`make sanitize-smoke`) runs the serve
+selftest and a short real training run under all three.
+"""
+
+from __future__ import annotations
+
+import asyncio.events
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class SanitizerError(AssertionError):
+    """A runtime contract the sanitized block was supposed to honor did
+    not hold. Subclasses AssertionError so test harnesses treat it as a
+    failed assertion, not an infrastructure error."""
+
+
+class HostSyncError(SanitizerError):
+    pass
+
+
+class EventLoopStallError(SanitizerError):
+    pass
+
+
+class LockOrderError(SanitizerError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# no_host_sync
+# ---------------------------------------------------------------------------
+
+class no_host_sync:
+    """Count (and budget) device->host synchronizations inside the block.
+
+        with no_host_sync() as s:                 # zero block_until_ready
+            fit(...)
+        assert s.fetches <= epochs * 6            # epoch-granular fetches
+
+    Counters: `block_until_ready_calls` (explicit drains — budget
+    `max_block_until_ready`, default 0: the zero-sync invariant) and
+    `fetches` (`np.asarray` of a `jax.Array` + `jax.device_get` — budget
+    `max_fetches`, default None: count only, callers assert their own
+    shape, e.g. "exactly 2 per flush"). Exceeding a budget raises
+    `HostSyncError` at exit. `armed` is False when jax is unavailable
+    (counters stay 0 and no budget can fail — there is no device to sync
+    with). Nestable; each level restores what it saw."""
+
+    def __init__(self, *, max_block_until_ready: Optional[int] = 0,
+                 max_fetches: Optional[int] = None):
+        self.max_block_until_ready = max_block_until_ready
+        self.max_fetches = max_fetches
+        self.block_until_ready_calls = 0
+        self.fetches = 0
+        self.armed = False
+
+    def __enter__(self) -> "no_host_sync":
+        try:
+            import jax
+            import numpy as np
+        except ImportError:     # jax-less host: nothing can sync
+            return self
+        self._jax, self._np = jax, np
+        self._orig_bur = jax.block_until_ready
+        self._orig_dget = jax.device_get
+        self._orig_asarray = np.asarray
+        san = self
+
+        def counting_bur(tree):
+            san.block_until_ready_calls += 1
+            return san._orig_bur(tree)
+
+        def counting_dget(x, *args, **kw):
+            san.fetches += 1
+            return san._orig_dget(x, *args, **kw)
+
+        def counting_asarray(a, *args, **kw):
+            if isinstance(a, san._jax.Array):
+                san.fetches += 1
+            return san._orig_asarray(a, *args, **kw)
+
+        jax.block_until_ready = counting_bur
+        jax.device_get = counting_dget
+        np.asarray = counting_asarray
+        self.armed = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.armed:
+            self._jax.block_until_ready = self._orig_bur
+            self._jax.device_get = self._orig_dget
+            self._np.asarray = self._orig_asarray
+        if exc_type is not None:
+            return
+        problems = []
+        if (self.max_block_until_ready is not None
+                and self.block_until_ready_calls
+                > self.max_block_until_ready):
+            problems.append(
+                f"{self.block_until_ready_calls} block_until_ready "
+                f"call(s) (budget {self.max_block_until_ready}) — the "
+                f"zero-host-sync invariant broke")
+        if self.max_fetches is not None and self.fetches > self.max_fetches:
+            problems.append(
+                f"{self.fetches} device->host fetch(es) (budget "
+                f"{self.max_fetches}) — fetch cadence grew")
+        if problems:
+            raise HostSyncError("no_host_sync: " + "; ".join(problems))
+
+
+# ---------------------------------------------------------------------------
+# event_loop_stall
+# ---------------------------------------------------------------------------
+
+def _describe_handle(handle) -> str:
+    cb = getattr(handle, "_callback", None)
+    return repr(cb)[:160] if cb is not None else repr(handle)[:160]
+
+
+class event_loop_stall:
+    """Fail when any single event-loop callback (including coroutine
+    steps) runs longer than `threshold_ms` inside the block.
+
+        with event_loop_stall(threshold_ms=50) as loop_guard:
+            asyncio.run(scenario())
+        # loop_guard.stalls == [] on a healthy loop
+
+    `stalls` holds `{"dur_ms", "callback"}` dicts for every offending run;
+    more than `max_stalls` of them (default 0) raises
+    `EventLoopStallError` at exit. The patch point is
+    `asyncio.events.Handle._run`, so `call_soon`/`call_later` callbacks
+    and task steps are all on the clock whatever loop policy created the
+    loop."""
+
+    def __init__(self, threshold_ms: float = 50.0, *, max_stalls: int = 0):
+        if threshold_ms <= 0:
+            raise ValueError(f"threshold_ms must be > 0; got {threshold_ms}")
+        self.threshold_s = float(threshold_ms) / 1e3
+        self.max_stalls = int(max_stalls)
+        self.stalls: List[dict] = []
+
+    def __enter__(self) -> "event_loop_stall":
+        self._orig_run = asyncio.events.Handle._run
+        san = self
+        orig = self._orig_run
+
+        def timed_run(handle):
+            t0 = time.perf_counter()
+            try:
+                return orig(handle)
+            finally:
+                dt = time.perf_counter() - t0
+                if dt >= san.threshold_s:
+                    san.stalls.append({
+                        "dur_ms": round(dt * 1e3, 3),
+                        "callback": _describe_handle(handle)})
+
+        asyncio.events.Handle._run = timed_run
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        asyncio.events.Handle._run = self._orig_run
+        if exc_type is not None:
+            return
+        if len(self.stalls) > self.max_stalls:
+            worst = max(self.stalls, key=lambda s: s["dur_ms"])
+            raise EventLoopStallError(
+                f"event_loop_stall: {len(self.stalls)} callback(s) over "
+                f"{self.threshold_s * 1e3:.0f}ms (budget "
+                f"{self.max_stalls}); worst {worst['dur_ms']}ms in "
+                f"{worst['callback']}")
+
+
+# ---------------------------------------------------------------------------
+# lock_trace
+# ---------------------------------------------------------------------------
+
+# The ACTIVE trace, module-level: wrapper objects outlive the `with` block
+# that created them (a service built inside one lock_trace keeps its
+# instrumented locks forever), so they must report to whichever trace is
+# armed NOW — not to the trace that happened to exist at creation. With no
+# trace armed, a wrapper is a near-free passthrough. The per-thread held
+# stack is likewise module-level, so a lock still held when a new trace
+# arms is accounted in that trace's edges.
+_ACTIVE_TRACE: "Optional[lock_trace]" = None
+# guards the arm/disarm swap (statics rule MUT002); created at import,
+# before any factory patching, so it is never itself traced
+_ARM_LOCK = threading.Lock()
+_HELD = threading.local()
+
+
+def _held_stack() -> list:
+    if not hasattr(_HELD, "stack"):
+        _HELD.stack = []
+    return _HELD.stack
+
+
+class _TracedLock:
+    """A threading.Lock/RLock wrapper that reports acquisition order to
+    the currently armed lock_trace (if any). Everything not intercepted
+    proxies to the real lock (so `threading.Condition` keeps working;
+    acquisitions a Condition performs through `_release_save`/
+    `_acquire_restore` bypass tracing, which is consistent: the owning
+    thread is blocked in wait() and acquires nothing else meanwhile)."""
+
+    def __init__(self, real, site: str):
+        self._real = real
+        self.site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            held = _held_stack()
+            trace = _ACTIVE_TRACE
+            if trace is not None:
+                trace._note_edges(held, self)
+            held.append(self)
+        return got
+
+    def release(self) -> None:
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self._real.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+class lock_trace:
+    """Record the runtime lock-acquisition-order graph; fail on cycles.
+
+        with lock_trace() as locks:
+            run_the_system()
+        locks.edges()     # [(held_site, acquired_site, count), ...]
+
+    Lock identity is the `threading.Lock()`/`RLock()` creation site
+    (file:line), so every instance a class creates aggregates to one node
+    — the granularity LOCK002's lexical ids approximate. Edges record "B
+    acquired while A held" per thread (RLock re-entry adds no self-edge);
+    a cycle at exit raises `LockOrderError` naming it (suppress with
+    `fail_on_cycle=False` to inspect instead).
+
+    Instrumented lock OBJECTS outlive the block that created them (a
+    service built inside one trace holds its locks forever), so they
+    report to whichever trace is armed at acquisition time: a later
+    lock_trace sees cycles on locks an earlier one created, and with no
+    trace armed the wrappers are near-free passthroughs. Only one
+    lock_trace may be armed at a time (nesting raises)."""
+
+    def __init__(self, *, fail_on_cycle: bool = True):
+        self.fail_on_cycle = fail_on_cycle
+        self._edges: Dict[Tuple[str, str], int] = {}
+        self._meta = threading.Lock()   # created pre-patch: never traced
+
+    # -- bookkeeping (called from _TracedLock.acquire) --------------------
+
+    def _note_edges(self, held: list, lock: _TracedLock) -> None:
+        new_edges = [(h.site, lock.site) for h in held
+                     if h.site != lock.site]
+        if new_edges:
+            with self._meta:
+                for e in new_edges:
+                    self._edges[e] = self._edges.get(e, 0) + 1
+
+    # -- the graph --------------------------------------------------------
+
+    def edges(self) -> List[Tuple[str, str, int]]:
+        with self._meta:
+            return sorted((a, b, n) for (a, b), n in self._edges.items())
+
+    def cycles(self) -> List[List[str]]:
+        """Every distinct cycle in the observed order graph (each reported
+        once, rotated to start at its smallest node)."""
+        with self._meta:
+            adj: Dict[str, set] = {}
+            for a, b in self._edges:
+                adj.setdefault(a, set()).add(b)
+        found: Dict[Tuple[str, ...], List[str]] = {}
+
+        def dfs(node: str, path: List[str], on_path: set) -> None:
+            for nxt in sorted(adj.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):]
+                    k = min(range(len(cyc)), key=lambda i: cyc[i])
+                    canon = tuple(cyc[k:] + cyc[:k])
+                    found.setdefault(canon, list(canon))
+                elif nxt not in path:
+                    dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(adj):
+            dfs(start, [start], {start})
+        return list(found.values())
+
+    # -- patching ---------------------------------------------------------
+
+    def __enter__(self) -> "lock_trace":
+        global _ACTIVE_TRACE
+        with _ARM_LOCK:
+            if _ACTIVE_TRACE is not None:
+                raise RuntimeError("a lock_trace is already armed; traces "
+                                   "do not nest (their edge graphs would "
+                                   "be ambiguous)")
+            self._orig_lock = threading.Lock
+            self._orig_rlock = threading.RLock
+
+            def make(factory):
+                def traced_factory(*a, **kw):
+                    frame = sys._getframe(1)
+                    site = (f"{os.path.basename(frame.f_code.co_filename)}"
+                            f":{frame.f_lineno}")
+                    return _TracedLock(factory(*a, **kw), site)
+                return traced_factory
+
+            threading.Lock = make(self._orig_lock)
+            threading.RLock = make(self._orig_rlock)
+            _ACTIVE_TRACE = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        global _ACTIVE_TRACE
+        with _ARM_LOCK:
+            _ACTIVE_TRACE = None
+            threading.Lock = self._orig_lock
+            threading.RLock = self._orig_rlock
+        if exc_type is not None:
+            return
+        if self.fail_on_cycle:
+            cyc = self.cycles()
+            if cyc:
+                pretty = "; ".join(" -> ".join(c + [c[0]]) for c in cyc)
+                raise LockOrderError(
+                    f"lock_trace: {len(cyc)} acquisition-order cycle(s) "
+                    f"observed (potential deadlock): {pretty}")

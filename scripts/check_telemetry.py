@@ -93,17 +93,37 @@ def _fallback_structure_errors(segment):
     return errors
 
 
+_degrade_noted: "set[str]" = set()   # print-once latch (single-threaded CLI)
+
+
+def _note_degraded(why: str) -> None:
+    """One stderr line naming exactly which checks were skipped — a
+    checker copied beside an older/missing analysis.py must say it
+    degraded, or a partial copy masquerades as a full pass."""
+    if _degrade_noted:
+        return
+    _degrade_noted.add(why)
+    print(f"check_telemetry: note: {why}; skipping the serve span "
+          f"contract (serve.request request_id, batch links resolving, "
+          f"pipeline-ordered batch stages)", file=sys.stderr)
+
+
 def span_structure_errors(segment):
     if _analysis is not None:
         errors = list(_analysis.span_structure_errors(segment))
         # the serve request/batch span contract (serve/tracing.py):
         # non-empty request_id, batch links resolving to a real
         # serve.batch span, pipeline-ordered batch stages. hasattr-guarded
-        # so this checker still runs beside an older analysis.py.
+        # so this checker still runs beside an older analysis.py — but
+        # NOT silently: the degradation is named once on stderr.
         if hasattr(_analysis, "serve_structure_errors"):
             errors.extend(_analysis.serve_structure_errors(segment))
             errors.sort(key=lambda e: e[0])
+        else:
+            _note_degraded("analysis.py predates serve_structure_errors")
         return errors
+    _note_degraded("analysis.py not found beside this script (span "
+                   "structure degrades to orphaned-parent detection)")
     return _fallback_structure_errors(segment)
 
 

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Sanitize smoke (docs/STATIC_ANALYSIS.md §Runtime sanitizers): drive the
+REAL serve request path and a short REAL training run with all three
+runtime sanitizers armed, and fail loudly if any runtime contract breaks.
+
+    JAX_PLATFORMS=cpu python scripts/sanitize_smoke.py     # = make sanitize-smoke
+
+What each phase pins:
+
+  * serve selftest (open-loop loadgen through admission -> micro-batcher
+    -> bucketed AOT engine, telemetry DISABLED):
+      - `no_host_sync`: zero block_until_ready calls, and EXACTLY two
+        device->host fetches (logits + preds) per batcher flush — the
+        NullTracer zero-overhead contract from tests/test_serve_trace.py,
+        now checked against the live request path;
+      - `event_loop_stall`: no single event-loop callback (coroutine step
+        or timer) runs longer than the threshold — the PR 9
+        sort-per-offered-request bug class as a harness ($PDMT_STALL_MS,
+        default 250: generous enough for an honest CPU engine flush,
+        far below any sleep/sort/IO stall worth catching).
+  * 2-epoch training run (synthetic MNIST, the tests' tiny-fit shape):
+      - `no_host_sync`: zero block_until_ready, and fetches bounded
+        EPOCH-granularly (<= 6 per epoch: loss curve, health aux, eval —
+        the tests/test_health.py budget), never per step.
+  * both phases run inside one `lock_trace`: every lock created during
+    the run records its acquisition order, and any observed order cycle
+    (LOCK002's runtime confirmation) fails the smoke.
+
+Prints one JSON line on success; exit 1 with the sanitizer's message on
+violation. Pure CPU, seconds of wall time — wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# runnable from anywhere: the repo root (this script's parent's parent)
+# fronts sys.path so the package imports without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _serve_phase(sanitize, stall_ms: float) -> dict:
+    import jax
+
+    from pytorch_ddp_mnist_tpu import telemetry
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.serve import InferenceEngine, ServeService
+    from pytorch_ddp_mnist_tpu.serve.loadgen import request_rows, run_loadgen
+
+    engine = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=32)
+    engine.predict(request_rows(1, seed=7))   # warm the host path pre-arm
+    service = ServeService(engine, max_delay_ms=2.0, max_depth=256,
+                           registry=telemetry.MetricsRegistry())
+    with sanitize.no_host_sync() as sync, \
+            sanitize.event_loop_stall(threshold_ms=stall_ms) as loop_guard:
+        out = run_loadgen(service, offered_rps=1500.0, n_requests=200,
+                          seed=0)
+    flushes = service.batcher.flushes
+    if out["completed"] != 200:
+        raise sanitize.SanitizerError(
+            f"serve selftest completed {out['completed']}/200 requests")
+    if sync.fetches != 2 * flushes:
+        raise sanitize.HostSyncError(
+            f"serve path made {sync.fetches} device fetches across "
+            f"{flushes} flushes; the contract is exactly 2 (logits + "
+            f"preds) per flush")
+    return {"completed": out["completed"], "flushes": flushes,
+            "fetches": sync.fetches,
+            "block_until_ready": sync.block_until_ready_calls,
+            "stalls": len(loop_guard.stalls)}
+
+
+def _train_phase(sanitize) -> dict:
+    import numpy as np
+    import jax
+
+    from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
+                                            synthetic_mnist)
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    epochs = 2
+    train = synthetic_mnist(128, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(train.images), train.labels,
+                         sampler, batch_size=32)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    with sanitize.no_host_sync(max_fetches=epochs * 6) as sync:
+        fit(state, loader, normalize_images(test.images),
+            test.labels.astype(np.int32), epochs=epochs, batch_size=32,
+            lr=0.1, log=lambda _m: None)
+    return {"epochs": epochs, "fetches": sync.fetches,
+            "block_until_ready": sync.block_until_ready_calls}
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    stall_ms = float(os.environ.get("PDMT_STALL_MS", "250"))
+    from pytorch_ddp_mnist_tpu.statics import sanitize
+
+    out = {"stall_threshold_ms": stall_ms}
+    try:
+        with sanitize.lock_trace() as locks:
+            out["serve"] = _serve_phase(sanitize, stall_ms)
+            out["train"] = _train_phase(sanitize)
+        out["lock_edges"] = len(locks.edges())
+        out["lock_cycles"] = 0
+    except sanitize.SanitizerError as e:
+        print(f"sanitize_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+    out["ok"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,8 +132,9 @@ def test_ddp_mode_contract_8_fake_devices():
 
 def test_bench_statics_stamp_in_artifact():
     """With the stamp enabled (the real-artifact default), every device-
-    mode JSON line carries statics: {lint_findings, audit_ok} — the
-    MULTICHIP/BENCH regression visibility the statics/ subsystem adds."""
+    mode JSON line carries statics: {lint_findings, concurrency_findings,
+    audit_ok} — the MULTICHIP/BENCH regression visibility the statics/
+    subsystem adds."""
     env = dict(ENV, PDMT_STATICS_STAMP="1")
     out = subprocess.run(
         [sys.executable, "bench.py", "--mode", "eval", "--epochs", "2"],
@@ -141,7 +142,8 @@ def test_bench_statics_stamp_in_artifact():
     assert out.returncode == 0, out.stderr[-2000:]
     (line,) = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
     rec = json.loads(line)
-    assert rec["statics"] == {"lint_findings": 0, "audit_ok": True}
+    assert rec["statics"] == {"lint_findings": 0,
+                              "concurrency_findings": 0, "audit_ok": True}
 
 
 def test_ddp_comm_knob_rejected_outside_ddp_mode():

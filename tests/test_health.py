@@ -318,30 +318,34 @@ def _tiny_fit(watchdog=None):
                lr=0.1, log=lambda _m: None, watchdog=watchdog)
 
 
-def test_watchdog_healthy_path_never_forces_block_until_ready(monkeypatch):
+def test_watchdog_healthy_path_never_forces_block_until_ready():
     """Acceptance: an ENABLED watchdog on a healthy run — with the
     health-aux step fold active — adds zero block_until_ready-forcing
     calls, exactly like the NullTracer invariant (the detectors consume
-    only already-fetched values; the aux rides the loss fetch)."""
-    calls = []
-    real = jax.block_until_ready
-    monkeypatch.setattr(jax, "block_until_ready",
-                        lambda t: calls.append(1) or real(t))
+    only already-fetched values; the aux rides the loss fetch). Pinned
+    via the shared sanitizer (statics.sanitize.no_host_sync), which is
+    the monkeypatch idiom this test invented, promoted."""
+    from pytorch_ddp_mnist_tpu.statics import sanitize
+
     wd, _ = _wd()
-    _tiny_fit(watchdog=wd)
-    assert calls == []
+    with sanitize.no_host_sync() as sync:     # max_block_until_ready=0
+        _tiny_fit(watchdog=wd)
+    assert sync.armed and sync.block_until_ready_calls == 0
     assert wd.events == [] or all(e.severity != "fatal" for e in wd.events)
 
 
-def test_watchdog_fetches_stay_epoch_granular(monkeypatch):
+def test_watchdog_fetches_stay_epoch_granular():
     """The block_until_ready pin above cannot see np.asarray-style fetches
     — so additionally count device->host conversions of jax Arrays during
     a watchdog-enabled run: they must scale with EPOCHS (one loss + one
-    aux fetch per epoch, plus the eval fetch), never with STEPS."""
+    aux fetch per epoch, plus the eval fetch), never with STEPS. The
+    counter is the shared sanitizer's fetch budget; 2 epochs x 16 steps
+    would show >= 32 conversions on a per-step regression."""
     from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
                                             synthetic_mnist)
     from pytorch_ddp_mnist_tpu.models import init_mlp
     from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+    from pytorch_ddp_mnist_tpu.statics import sanitize
     from pytorch_ddp_mnist_tpu.train import TrainState, fit
 
     train = synthetic_mnist(128, seed=0)
@@ -352,22 +356,12 @@ def test_watchdog_fetches_stay_epoch_granular(monkeypatch):
     state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
     wd, _ = _wd()
 
-    real = np.asarray
-    fetches = []
-
-    def counting(a, *args, **kw):
-        if isinstance(a, jax.Array):
-            fetches.append(1)
-        return real(a, *args, **kw)
-
-    monkeypatch.setattr(np, "asarray", counting)
-    fit(state, loader, normalize_images(test.images),
-        test.labels.astype(np.int32), epochs=2, batch_size=8,
-        lr=0.1, log=lambda _m: None, watchdog=wd)
-    # 2 epochs x 16 steps: a per-step fetch regression would show >= 32
-    # conversions; the epoch-granular contract allows a handful per epoch
-    # (loss curve, aux, eval outputs)
-    assert len(fetches) <= 2 * 6, len(fetches)
+    with sanitize.no_host_sync(max_block_until_ready=None,
+                               max_fetches=2 * 6) as sync:
+        fit(state, loader, normalize_images(test.images),
+            test.labels.astype(np.int32), epochs=2, batch_size=8,
+            lr=0.1, log=lambda _m: None, watchdog=wd)
+    assert 0 < sync.fetches <= 2 * 6, sync.fetches
 
 
 def test_fit_detects_injected_nan_and_emits_trace_event(tmp_path):

@@ -1,0 +1,290 @@
+"""statics/sanitize.py — the runtime contract sanitizers.
+
+Each sanitizer is exercised in both directions: a violating block raises
+the named SanitizerError subclass with the evidence in the message, a
+clean block passes, and in EVERY case the patched process-wide entry
+points (jax.block_until_ready / np.asarray / asyncio Handle._run / the
+threading lock factories) are restored afterwards — a sanitizer that
+leaks its patch would corrupt every later test. The PR 9 bug shapes
+(event-loop stall, lock-order cycle) are reproduced as runtime fixtures,
+mirroring the lexical fixtures in test_statics.py.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from pytorch_ddp_mnist_tpu.statics import sanitize
+
+
+# ---------------------------------------------------------------------------
+# no_host_sync
+# ---------------------------------------------------------------------------
+
+def test_no_host_sync_counts_and_restores():
+    orig_bur = jax.block_until_ready
+    orig_asarray = np.asarray
+    x = jnp.arange(8.0)
+    with sanitize.no_host_sync(max_block_until_ready=None) as s:
+        jax.block_until_ready(x)
+        np.asarray(x)
+        np.asarray([1, 2, 3])            # host data: not a fetch
+        jax.device_get(x)
+    assert s.armed
+    assert s.block_until_ready_calls == 1
+    assert s.fetches == 2                # asarray-of-Array + device_get
+    assert jax.block_until_ready is orig_bur
+    assert np.asarray is orig_asarray
+
+
+def test_no_host_sync_zero_budget_raises():
+    x = jnp.arange(4.0)
+    with pytest.raises(sanitize.HostSyncError, match="zero-host-sync"):
+        with sanitize.no_host_sync():
+            jax.block_until_ready(x)
+
+
+def test_no_host_sync_fetch_budget_raises_and_names_cadence():
+    x = jnp.arange(4.0)
+    with pytest.raises(sanitize.HostSyncError, match="fetch cadence"):
+        with sanitize.no_host_sync(max_fetches=1):
+            np.asarray(x)
+            np.asarray(x)
+
+
+def test_no_host_sync_never_masks_the_primary_failure():
+    # a block that raises must propagate ITS error, not the budget's —
+    # and still restore the patches
+    orig = np.asarray
+    with pytest.raises(RuntimeError, match="primary"):
+        with sanitize.no_host_sync():
+            jax.block_until_ready(jnp.arange(2.0))   # over budget
+            raise RuntimeError("primary")
+    assert np.asarray is orig
+
+
+def test_no_host_sync_is_nestable():
+    x = jnp.arange(2.0)
+    with sanitize.no_host_sync(max_block_until_ready=None) as outer:
+        with sanitize.no_host_sync(max_block_until_ready=None) as inner:
+            np.asarray(x)
+        np.asarray(x)
+    assert inner.fetches == 1
+    assert outer.fetches == 2            # inner's count forwards upward
+
+
+# ---------------------------------------------------------------------------
+# event_loop_stall
+# ---------------------------------------------------------------------------
+
+def test_event_loop_stall_flags_a_blocking_callback():
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        loop.call_soon(time.sleep, 0.05)         # the PR 9 bug class
+        await asyncio.sleep(0.1)
+
+    orig = asyncio.events.Handle._run
+    with pytest.raises(sanitize.EventLoopStallError, match="sleep"):
+        with sanitize.event_loop_stall(threshold_ms=20.0):
+            asyncio.run(scenario())
+    assert asyncio.events.Handle._run is orig
+
+
+def test_event_loop_stall_clean_loop_passes():
+    async def scenario():
+        for _ in range(10):
+            await asyncio.sleep(0)
+
+    with sanitize.event_loop_stall(threshold_ms=200.0) as guard:
+        asyncio.run(scenario())
+    assert guard.stalls == []
+
+
+def test_event_loop_stall_records_duration_evidence():
+    async def scenario():
+        time.sleep(0.03)                 # the coroutine step itself stalls
+
+    with sanitize.event_loop_stall(threshold_ms=10.0, max_stalls=5) as g:
+        asyncio.run(scenario())
+    assert g.stalls and g.stalls[0]["dur_ms"] >= 10.0
+
+
+def test_event_loop_stall_rejects_bad_threshold():
+    with pytest.raises(ValueError):
+        sanitize.event_loop_stall(threshold_ms=0)
+
+
+# ---------------------------------------------------------------------------
+# lock_trace
+# ---------------------------------------------------------------------------
+
+def test_lock_trace_observes_order_and_detects_cycles():
+    with pytest.raises(sanitize.LockOrderError, match="cycle"):
+        with sanitize.lock_trace():
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:                  # the reverse order
+                    pass
+
+
+def test_lock_trace_consistent_order_passes_and_restores():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    with sanitize.lock_trace() as trace:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    assert threading.Lock is orig_lock and threading.RLock is orig_rlock
+    assert trace.cycles() == []
+    ((src, dst, n),) = trace.edges()
+    assert n == 3 and src != dst
+
+
+def test_lock_trace_rlock_reentry_adds_no_self_edge():
+    with sanitize.lock_trace() as trace:
+        r = threading.RLock()
+        with r:
+            with r:                      # re-entry, not an ordering edge
+                pass
+    assert trace.edges() == []
+
+
+def test_lock_trace_sees_cross_thread_inconsistency():
+    # thread 1 takes a->b, thread 2 takes b->a: the UNION graph has the
+    # cycle even though each thread's own order is locally consistent
+    with pytest.raises(sanitize.LockOrderError):
+        with sanitize.lock_trace():
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def fwd():
+                with a:
+                    with b:
+                        pass
+
+            def rev():
+                with b:
+                    with a:
+                        pass
+
+            t1 = threading.Thread(target=fwd)
+            t1.start()
+            t1.join()
+            rev()
+
+
+def test_lock_trace_sees_locks_created_under_an_earlier_trace():
+    # review-found bug: instrumented lock OBJECTS outlive their trace (a
+    # service built under trace 1 holds them forever), so they must
+    # report to whichever trace is armed at ACQUISITION time — a later
+    # trace still sees cycles on them, and an exited trace gains nothing
+    with sanitize.lock_trace() as t1:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+    n_t1 = len(t1.edges())
+    with pytest.raises(sanitize.LockOrderError):
+        with sanitize.lock_trace():
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+    assert len(t1.edges()) == n_t1       # the dead trace gained nothing
+
+
+def test_lock_trace_wrappers_are_passthrough_outside_any_trace():
+    with sanitize.lock_trace() as t:
+        lock = threading.Lock()
+    # after exit: still a working lock, and nothing records anywhere
+    with lock:
+        assert lock.locked()
+    assert t.edges() == []
+
+
+def test_lock_trace_refuses_to_nest():
+    with sanitize.lock_trace():
+        with pytest.raises(RuntimeError, match="already armed"):
+            with sanitize.lock_trace():
+                pass
+    # the failed arm must not have disarmed/unpatched the outer trace
+    orig = threading.Lock
+    with sanitize.lock_trace():
+        assert threading.Lock is not orig
+    assert threading.Lock is orig
+
+
+def test_lock_trace_inspection_mode_reports_without_raising():
+    with sanitize.lock_trace(fail_on_cycle=False) as trace:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    (cycle,) = trace.cycles()
+    assert len(cycle) == 2
+
+
+def test_traced_locks_keep_working_as_locks():
+    # the wrapper must remain a real lock: exclusion across threads holds
+    with sanitize.lock_trace() as trace:
+        lock = threading.Lock()
+        hits = []
+
+        def worker():
+            for _ in range(200):
+                with lock:
+                    n = len(hits)
+                    hits.append(n)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not lock.locked()
+    assert hits == list(range(800))      # no lost updates under the lock
+    assert trace.cycles() == []
+
+
+# ---------------------------------------------------------------------------
+# the smoke harness (in-process: the make target's own entry point)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sanitize_smoke_main_passes(capsys):
+    # by file path: scripts/ is not a package (the repo's script idiom)
+    import importlib.util
+    import pathlib
+    path = (pathlib.Path(__file__).resolve().parent.parent / "scripts"
+            / "sanitize_smoke.py")
+    spec = importlib.util.spec_from_file_location("_sanitize_smoke", path)
+    smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(smoke)
+    assert smoke.main([]) == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    import json
+    report = json.loads(out)
+    assert report["ok"] is True
+    assert report["serve"]["block_until_ready"] == 0
+    assert report["serve"]["fetches"] == 2 * report["serve"]["flushes"]
+    assert report["serve"]["stalls"] == 0
+    assert report["train"]["fetches"] <= report["train"]["epochs"] * 6
+    assert report["lock_cycles"] == 0

@@ -181,35 +181,27 @@ def test_checker_rejects_serve_contract_violations(tmp_path):
 # zero-overhead pin (tracing disabled) + bitwise pin (tracing enabled)
 # ---------------------------------------------------------------------------
 
-def test_tracing_disabled_no_spans_no_extra_syncs(engine, monkeypatch):
+def test_tracing_disabled_no_spans_no_extra_syncs(engine):
     """The NullTracer contract, pinned like PR 6's watchdog: with
     telemetry DISABLED a full loadgen run forces zero block_until_ready
     calls, and the only device->host fetches are the engine's own
     logits/preds pair per flush — stage stamping adds clock reads, never
     syncs. And no span records exist anywhere: the tracer stays the
-    NullTracer."""
+    NullTracer. The interception is the shared sanitizer
+    (statics.sanitize.no_host_sync — this test's original monkeypatch
+    idiom, promoted)."""
+    from pytorch_ddp_mnist_tpu.statics import sanitize
+
     assert not telemetry.get_tracer().enabled
-    bur_calls = []
-    real_bur = jax.block_until_ready
-    monkeypatch.setattr(jax, "block_until_ready",
-                        lambda t: bur_calls.append(1) or real_bur(t))
-    fetches = []
-    real_asarray = np.asarray
-
-    def counting(a, *args, **kw):
-        if isinstance(a, jax.Array):
-            fetches.append(1)
-        return real_asarray(a, *args, **kw)
-
-    monkeypatch.setattr(np, "asarray", counting)
     svc = ServeService(engine, max_delay_ms=2.0, max_depth=256,
                        registry=telemetry.MetricsRegistry())
-    out = run_loadgen(svc, offered_rps=3000.0, n_requests=40, seed=0)
+    with sanitize.no_host_sync() as sync:     # max_block_until_ready=0
+        out = run_loadgen(svc, offered_rps=3000.0, n_requests=40, seed=0)
     assert out["completed"] == 40
-    assert bur_calls == []
+    assert sync.armed and sync.block_until_ready_calls == 0
     # exactly 2 fetches (logits + preds) per flush — a tracing-induced
     # extra sync would break the equality
-    assert len(fetches) == 2 * svc.batcher.flushes
+    assert sync.fetches == 2 * svc.batcher.flushes
     # the stage clock still fed the ALWAYS-ON attribution histograms
     assert svc.metrics.attribution()["stages"]["compute"]["n"] == 40
 

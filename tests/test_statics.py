@@ -1,12 +1,19 @@
-"""statics/ — the JAX-aware lint + jaxpr program auditor.
+"""statics/ — the JAX-aware lint + concurrency auditor + jaxpr program
+auditor.
 
-Three layers, mirroring the subsystem:
+Four layers, mirroring the subsystem:
 
-  * rule-by-rule fixture matrix: every rule ID in the catalog is exercised
-    with BOTH a triggering and a non-triggering source fixture, so a rule
-    that stops firing (or starts over-firing) is caught by name;
+  * rule-by-rule fixture matrix: every rule ID in the catalog (the PR 8
+    source rules AND the ASYNC/LOCK concurrency rules) is exercised with
+    BOTH a triggering and a non-triggering source fixture, so a rule that
+    stops firing (or starts over-firing) is caught by name;
+  * concurrency machinery: the thread-entry map on real sources, the
+    one-hop residency propagation, the PR 9 event-loop-sort regression
+    fixture ASYNC001 must flag by ID, the lock-cycle fixture LOCK002 must
+    flag, and the cross-file union lock-order graph;
   * baseline semantics: new finding fails, baselined finding passes, stale
-    entry warns, --prune-baseline rewrites the file;
+    entry warns, --prune-baseline rewrites the file — plus `--check-docs`
+    rule-catalog/doc drift detection;
   * the program auditor: the full comm x overlap x {step, run} matrix
     passes on the real step builders, a deliberately mismatched program
     fails with the NAMED contract (the acceptance pin: an int8 audit fed
@@ -23,8 +30,8 @@ import textwrap
 
 import pytest
 
-from pytorch_ddp_mnist_tpu.statics import jaxpr_audit, lint
-from pytorch_ddp_mnist_tpu.statics.rules import RULES
+from pytorch_ddp_mnist_tpu.statics import concurrency, jaxpr_audit, lint
+from pytorch_ddp_mnist_tpu.statics.rules import CONCURRENCY_RULES, RULES
 
 
 def rules_of(src):
@@ -193,6 +200,106 @@ FIXTURES = [
                     _CACHE = build()
             return _CACHE
      """),
+    ("ASYNC001", """
+        import time
+
+        async def handler(q):
+            time.sleep(0.01)          # parks every in-flight request
+            return q
+     """, """
+        import asyncio
+        import time
+
+        async def handler(q):
+            await asyncio.sleep(0.01)
+            return q
+
+        def host_bench(fn):           # untraced host code may sleep
+            time.sleep(0.01)
+            return fn()
+     """),
+    ("ASYNC002", """
+        import threading
+
+        _STATE_LOCK = threading.Lock()
+
+        async def update(x):
+            with _STATE_LOCK:
+                return await compute(x)
+     """, """
+        import asyncio
+        import threading
+
+        _STATE_LOCK = threading.Lock()
+        _LOOP_LOCK = asyncio.Lock()
+
+        async def update(x):
+            with _STATE_LOCK:         # sync lock, no await inside: fine
+                stage(x)
+            async with _LOOP_LOCK:    # asyncio lock across await: fine
+                return await compute(x)
+     """),
+    ("LOCK001", """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0           # construction is exempt
+
+            def add(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                self._n = 0           # races every locked writer/reader
+     """, """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def add(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                with self._lock:
+                    self._n = 0
+     """),
+    ("LOCK002", """
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def forward():
+            with A_LOCK:
+                with B_LOCK:
+                    work()
+
+        def backward():
+            with B_LOCK:
+                with A_LOCK:          # the reverse order: deadlock bait
+                    work()
+     """, """
+        import threading
+
+        A_LOCK = threading.Lock()
+        B_LOCK = threading.Lock()
+
+        def forward():
+            with A_LOCK:
+                with B_LOCK:
+                    work()
+
+        def also_forward():
+            with A_LOCK:
+                with B_LOCK:          # same global order everywhere
+                    work()
+     """),
 ]
 
 
@@ -240,6 +347,190 @@ def test_decorated_jit_marks_traced():
     assert "SYNC001" in rules_of(src)
 
 
+# ---------------------------------------------------------------------------
+# the concurrency auditor's machinery
+# ---------------------------------------------------------------------------
+
+def test_async001_flags_the_pr9_event_loop_sort_bug():
+    """Regression fixture: the PR 9 bug — an O(W log W) sort over the
+    rolling SLO window executed on the serve event loop per offered
+    request — must be flagged by ASYNC001, by ID, in both spellings."""
+    src = """
+        async def admit(window, q):
+            lat = sorted(window)       # re-sorts the window per request
+            return lat[int(q * len(lat))]
+
+        async def admit_inplace(window):
+            window.sort()
+            return window[-1]
+    """
+    findings = [f for f in lint.lint_source(textwrap.dedent(src), "fix.py")
+                if f.rule == "ASYNC001"]
+    assert len(findings) == 2
+    assert any("sorted(window)" in f.message for f in findings)
+
+
+def test_async001_propagates_through_sync_helpers():
+    # the event-loop residency fixpoint: a sync helper CALLED from a
+    # coroutine is on the loop too — one hop or many
+    src = """
+        import time
+
+        def deep():
+            time.sleep(1)
+
+        def helper():
+            deep()
+
+        async def handler():
+            helper()
+    """
+    (f,) = [f for f in lint.lint_source(textwrap.dedent(src), "fix.py")
+            if f.rule == "ASYNC001"]
+    assert "time.sleep" in f.content and "deep" in f.message
+
+
+def test_async001_covers_loop_scheduled_callbacks():
+    # call_later/call_soon targets are loop-resident without being async
+    # (the MicroBatcher._on_timer shape)
+    src = """
+        import time
+
+        class Batcher:
+            def arm(self, loop):
+                loop.call_later(0.1, self._tick)
+
+            def _tick(self):
+                time.sleep(0.5)
+    """
+    (f,) = [f for f in lint.lint_source(textwrap.dedent(src), "fix.py")
+            if f.rule == "ASYNC001"]
+    assert "call_later" in f.message
+
+
+def test_async001_acquire_timeout_is_exempt():
+    src_bad = """
+        import threading
+        _LOCK = threading.Lock()
+        async def grab():
+            _LOCK.acquire()
+    """
+    src_good = """
+        import threading
+        _LOCK = threading.Lock()
+        async def grab():
+            _LOCK.acquire(timeout=0.1)
+        async def try_grab():
+            _LOCK.acquire(False)
+    """
+    assert "ASYNC001" in rules_of(src_bad)
+    assert "ASYNC001" not in rules_of(src_good)
+
+
+def test_lock002_unions_the_graph_across_files(tmp_path):
+    # file A nests B_LOCK inside A_LOCK; file B nests the reverse: the
+    # cycle only exists in the UNION graph lint_paths builds (lock ids
+    # are name-qualified, not path-qualified)
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        from locks import A_LOCK, B_LOCK
+        def forward():
+            with A_LOCK:
+                with B_LOCK:
+                    pass
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        from locks import A_LOCK, B_LOCK
+        def backward():
+            with B_LOCK:
+                with A_LOCK:
+                    pass
+    """))
+    findings, n = lint.lint_paths([str(tmp_path)], root=str(tmp_path))
+    cycles = [f for f in findings if f.rule == "LOCK002"]
+    assert n == 2 and len(cycles) == 2          # one edge flagged per file
+    assert {f.path for f in cycles} == {"a.py", "b.py"}
+    # each file alone is clean: the order is only inconsistent globally
+    for name in ("a.py", "b.py"):
+        alone = lint.lint_source((tmp_path / name).read_text(), name)
+        assert not [f for f in alone if f.rule == "LOCK002"]
+
+
+def test_thread_entry_map_on_the_real_tree():
+    """The auditor's thread-entry map sees the real producers: prom.py's
+    daemon scrape thread, flight.py's SIGTERM handler, batcher.py's
+    loop-scheduled flush timer."""
+    import pytorch_ddp_mnist_tpu.serve.batcher as batcher_mod
+    import pytorch_ddp_mnist_tpu.telemetry.flight as flight_mod
+    import pytorch_ddp_mnist_tpu.telemetry.prom as prom_mod
+
+    auditor = concurrency.ConcurrencyAuditor()
+    for mod in (prom_mod, flight_mod, batcher_mod):
+        with open(mod.__file__, encoding="utf-8") as f:
+            auditor.add_source(f.read(), mod.__file__)
+    assert "serve_forever" in auditor.entries["thread"]
+    assert "_flush_and_chain" in auditor.entries["signal"]
+    assert "_on_timer" in auditor.entries["loop"]
+    assert "flush" in auditor.entries["loop"]   # called from _on_timer
+
+
+def test_lock001_groups_attributes_per_class():
+    # two classes each writing self._n — one mixed (flagged), one
+    # consistently unlocked (not flagged: no lock claims to guard it)
+    src = """
+        import threading
+
+        class Mixed:
+            def locked_write(self):
+                with self._lock:
+                    self._n = 1
+            def bare_write(self):
+                self._n = 2
+
+        class Unlocked:
+            def a(self):
+                self._n = 1
+            def b(self):
+                self._n = 2
+    """
+    findings = [f for f in lint.lint_source(textwrap.dedent(src), "fix.py")
+                if f.rule == "LOCK001"]
+    assert len(findings) == 1
+    assert "bare_write" in findings[0].message
+
+
+def test_lock001_ignores_pure_annotations():
+    # `self._n: int` with no value is a type annotation — no store happens
+    # at runtime, so it must not read as an unlocked write
+    src = """
+        import threading
+
+        class C:
+            def locked(self):
+                with self._lock:
+                    self._n = 1
+
+            def declare(self):
+                self._n: int
+    """
+    assert "LOCK001" not in rules_of(src)
+
+
+def test_check_docs_in_sync_on_the_real_repo(capsys):
+    assert lint.check_docs() == []
+    assert lint.main(["--check-docs"]) == 0
+    assert "agree" in capsys.readouterr().out
+
+
+def test_check_docs_catches_drift_both_ways(tmp_path):
+    doc = tmp_path / "STATIC_ANALYSIS.md"
+    rows = "\n".join(f"| `{rid}` | x | x | x | x |"
+                     for rid in sorted(RULES) if rid != "ASYNC001")
+    doc.write_text(f"# rules\n\n{rows}\n| `ZZZ999` | x | x | x | x |\n")
+    drift = lint.check_docs(str(doc))
+    assert any("ASYNC001" in d for d in drift)      # catalog id missing a row
+    assert any("ZZZ999" in d for d in drift)        # doc row without a rule
+
+
 def test_findings_carry_location_and_hint():
     f = lint.lint_source("def f(xs=[]):\n    return xs\n", "somefile.py")[0]
     assert (f.rule, f.path, f.line) == ("MUT001", "somefile.py", 1)
@@ -277,6 +568,10 @@ sys.modules["sl"] = mod
 spec.loader.exec_module(mod)
 (f,) = mod.lint_source("def f(xs=[]):\\n    return xs\\n", "x.py")
 assert f.rule == "MUT001", f
+# the concurrency pass rides the same file-path chain (lint -> rules ->
+# concurrency, all loaded as siblings)
+src = "import time\\nasync def h(q):\\n    time.sleep(1)\\n"
+assert {{g.rule for g in mod.lint_source(src, "y.py")}} == {{"ASYNC001"}}
 assert "jax" not in sys.modules and "pytorch_ddp_mnist_tpu" not in sys.modules
 print("ok")
 """
@@ -498,11 +793,13 @@ def test_audit_cli_json_report(capsys):
 
 
 def test_bench_statics_stamp():
-    # the artifact-line stamp: lint count + audit verdict, process-cached
+    # the artifact-line stamp: lint + concurrency counts + audit verdict,
+    # process-cached
     import bench
     bench.statics_stamp.cache_clear()
     stamp = bench.statics_stamp()
-    assert stamp == {"lint_findings": 0, "audit_ok": True}
+    assert stamp == {"lint_findings": 0, "concurrency_findings": 0,
+                     "audit_ok": True}
     assert bench.statics_stamp() is stamp       # cached second read
 
 
@@ -521,6 +818,7 @@ def test_bench_statics_stamp_never_raises(monkeypatch):
     finally:
         bench.statics_stamp.cache_clear()   # don't cache the broken stamp
     assert stamp["lint_findings"] is None
+    assert stamp["concurrency_findings"] is None
     assert "malformed baseline" in stamp["error"]
     assert stamp["audit_ok"] is True        # the audit half still ran
 

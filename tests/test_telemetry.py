@@ -321,6 +321,35 @@ def test_checker_empty_and_missing_targets(tmp_path, capsys):
     assert check_main([]) == 2                      # usage
 
 
+def test_checker_names_skipped_serve_checks_when_degraded(
+        tmp_path, capsys, monkeypatch):
+    """A checker copied beside an OLDER analysis.py (no
+    serve_structure_errors) must not degrade silently: one stderr note
+    names the skipped serve span checks, once — a partial copy can't
+    masquerade as a full pass. Same for a missing analysis.py."""
+    class _OldAnalysis:                     # pre-serve-contract surface
+        @staticmethod
+        def span_structure_errors(segment):
+            return []
+
+    trace = [_rec(kind="meta", name="trace_start", t_mono=1.0),
+             _rec(kind="span", name="s", t_mono=2.0, span=1, parent=None,
+                  dur_s=0.1)]
+    path = _write(tmp_path, trace)
+    monkeypatch.setattr(_checker, "_analysis", _OldAnalysis)
+    monkeypatch.setattr(_checker, "_degrade_noted", set())
+    assert check_main([path]) == 0          # still a pass...
+    err = capsys.readouterr().err
+    assert err.count("skipping the serve span contract") == 1  # ...but said
+    assert "request_id" in err              # names WHAT was skipped
+
+    monkeypatch.setattr(_checker, "_analysis", None)
+    monkeypatch.setattr(_checker, "_degrade_noted", set())
+    assert check_main([path]) == 0
+    err = capsys.readouterr().err
+    assert "orphaned-parent" in err and "serve span contract" in err
+
+
 # ---------------------------------------------------------------------------
 # runtime collectors
 # ---------------------------------------------------------------------------
