@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -136,10 +136,20 @@ static-smoke: lint audit-program
 sanitize-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/sanitize_smoke.py
 
+# Input-pipeline smoke (docs/DATA.md): a synthetic-source training run
+# through the staged pipeline (decode workers + depth-K device prefetch)
+# under no_host_sync (zero block_until_ready; the PR 10 epoch-granular
+# fetch budget holds with workers live) + lock_trace (no acquisition-order
+# cycles on the new worker locks), then the emitted trace is gated with
+# check_telemetry --require data. and the data_wait attribution report.
+input-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/input_smoke.py
+
 # The committed pre-merge gate: static contracts first (seconds), then the
-# runtime sanitizers on the live paths, then the serve request-tracing
-# round trip (also seconds), then the fast test tier.
-check: static-smoke sanitize-smoke serve-trace-smoke test-fast
+# runtime sanitizers on the live paths (incl. the input pipeline), then
+# the serve request-tracing round trip (also seconds), then the fast
+# test tier.
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

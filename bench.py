@@ -86,6 +86,10 @@ ACCURACY_EPOCHS = 10
 # scan program is measured per STRATEGY plus a 1-device baseline, so the
 # whole mode stays a few windows even on CPU fake devices.
 DDP_EPOCHS = 10
+# --mode input trains this many REAL streaming epochs per variant (legacy
+# + piped): enough epochs for a p95 over per-epoch data_wait shares while
+# the synthetic read latency keeps each epoch sub-second.
+INPUT_EPOCHS = 4
 
 from pytorch_ddp_mnist_tpu.train.scan import resolve_kernel  # noqa: E402
 from pytorch_ddp_mnist_tpu.ops.pallas_step import (  # noqa: E402
@@ -405,6 +409,107 @@ def _serve_bench(a) -> None:
         # compiles are the ONLY compiles the engine can ever perform
         "compile_count": counters["serve.engine_compiles"],
         **registry_stamp(),  # global registry: xla.compiles + memory
+    }))
+
+
+def _input_bench(a) -> None:
+    """`--mode input`: the input-pipeline story's read side — the SAME
+    streaming `fit` over the SAME synthetic source, once through the
+    legacy synchronous path (workers=0, depth=1: bare reads + the one-slot
+    double buffer) and once through the staged pipeline
+    (--input_workers decode threads + --input_depth device prefetch), one
+    JSON artifact line reporting batches/sec and the data_wait share of
+    epoch time for both (telemetry/analysis.data_report over each run's
+    own trace — the numbers `trace report --data` would print).
+
+    The synthetic source (pipeline/synthetic.py) charges
+    --input_latency_ms of read latency PER BATCH, sized so the legacy
+    path is INPUT-BOUND (docs/PERF.md states the committed geometry) —
+    the regime the pipeline exists for; the measured claim is the
+    data_wait share collapsing, not a lucky compute overlap. Both
+    variants run under `statics.sanitize.no_host_sync` with the PR 10
+    epoch-granular fetch budget (<= 6 fetches/epoch): the pipeline may
+    add worker threads but ZERO consumer-side host syncs, and the
+    artifact stamps the observed counts as evidence."""
+    import shutil
+    import tempfile
+    import time
+
+    from pytorch_ddp_mnist_tpu import telemetry
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.pipeline import SyntheticSource
+    from pytorch_ddp_mnist_tpu.statics import sanitize
+    from pytorch_ddp_mnist_tpu.telemetry import analysis
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    test = synthetic_mnist(256, seed=1)
+    x_test = normalize_images(test.images)
+    y_test = test.labels.astype(np.int32)
+    latency_s = a.input_latency_ms / 1e3
+
+    # warm the jit caches (train step + eval) OUTSIDE both measured runs:
+    # whichever variant ran first would otherwise pay every compile and
+    # the comparison would measure compile order, not the pipeline
+    warm = SyntheticSource(2, a.batch_size, seed=0)
+    fit(TrainState(init_mlp(jax.random.key(0)), jax.random.key(1)),
+        warm, x_test, y_test, epochs=1, batch_size=a.batch_size, lr=0.01,
+        log=lambda _m: None)
+
+    def run(tag, workers, depth):
+        out_dir = tempfile.mkdtemp(prefix=f"pdmt_input_{tag}_")
+        try:
+            telemetry.enable(out_dir, process_index=0)
+            src = SyntheticSource(a.input_batches, a.batch_size,
+                                  latency_s=latency_s, seed=0)
+            state = TrainState(init_mlp(jax.random.key(0)),
+                               jax.random.key(1))
+            t0 = time.perf_counter()
+            with sanitize.no_host_sync(max_fetches=a.epochs * 6) as sync:
+                fit(state, src, x_test, y_test, epochs=a.epochs,
+                    batch_size=a.batch_size, lr=0.01, log=lambda _m: None,
+                    input_workers=workers, prefetch_depth=depth)
+            wall = time.perf_counter() - t0
+            rep = analysis.data_report(analysis.trace_files(out_dir))
+        finally:
+            # a failed variant (e.g. the fetch budget firing — the exact
+            # regression this mode exists to catch) must not leave the
+            # process-global tracer armed or the scratch dir behind
+            telemetry.disable()
+            shutil.rmtree(out_dir, ignore_errors=True)
+        return {
+            "workers": workers, "prefetch_depth": depth,
+            "batches_per_sec": round(a.epochs * a.input_batches / wall, 1),
+            "images_per_sec": round(
+                a.epochs * a.input_batches * a.batch_size / wall, 1),
+            "data_wait_share_p50": round(rep["share"]["p50"], 4),
+            "data_wait_share_p95": round(rep["share"]["p95"], 4),
+            "data_wait_p95_s": round(rep["data_wait"]["p95_s"], 6),
+            # the PR 10 fetch-budget sanitizer's observed counts: the
+            # artifact carries its own zero-new-host-sync evidence
+            "fetches": sync.fetches,
+            "fetch_budget": a.epochs * 6,
+            "block_until_ready": sync.block_until_ready_calls,
+        }
+
+    legacy = run("legacy", 0, 1)
+    piped = run("piped", a.input_workers, a.input_depth)
+    print(json.dumps({
+        "metric": "mnist_input_pipeline_batches_per_sec",
+        "value": piped["batches_per_sec"],
+        "unit": "batches/sec",
+        # the legacy synchronous loader IS this mode's baseline: >1 means
+        # the pipeline hid that much of the read latency
+        "vs_baseline": (round(piped["batches_per_sec"]
+                              / legacy["batches_per_sec"], 4)
+                        if legacy["batches_per_sec"] else None),
+        "epochs": a.epochs,
+        "batch_size": a.batch_size,
+        "batches_per_epoch": a.input_batches,
+        "read_latency_ms_per_batch": a.input_latency_ms,
+        "legacy": legacy,
+        "pipeline": piped,
+        **registry_stamp(),
     }))
 
 
@@ -788,7 +893,7 @@ def main(argv=None) -> None:
                         "SLOWER than 1 at 2/4/8 (docs/PERF.md) — kept for "
                         "reproducing that negative result")
     p.add_argument("--mode", choices=("train", "stream", "eval", "accuracy",
-                                      "serve", "ddp"),
+                                      "serve", "ddp", "input"),
                    default="train",
                    help="train: the flagship device-train metric (driver "
                         "default); stream: NetCDF disk-streaming loader "
@@ -808,7 +913,12 @@ def main(argv=None) -> None:
                         "the full-device mesh (images/sec, scaling "
                         "efficiency vs 1 device, wire bytes, parity drift "
                         "vs pmean; real chips or "
-                        "--xla_force_host_platform_device_count fakes)")
+                        "--xla_force_host_platform_device_count fakes); "
+                        "input: legacy loader vs the staged input pipeline "
+                        "(pipeline/) on an input-bound synthetic source — "
+                        "batches/sec + data_wait share of epoch time per "
+                        "variant, under the no_host_sync fetch budget "
+                        "(docs/DATA.md)")
     p.add_argument("--ddp_comm", choices=("all", "pmean", "sharded", "bf16",
                                           "int8"),
                    default="all",
@@ -831,6 +941,19 @@ def main(argv=None) -> None:
                         "table in docs/PERF.md)")
     p.add_argument("--num_workers", type=int, default=0,
                    help="stream mode: readahead threads")
+    p.add_argument("--input_latency_ms", type=float, default=5.0,
+                   help="input mode: synthetic per-batch read latency — "
+                        "sized so the LEGACY path is input-bound (the "
+                        "committed geometry in docs/PERF.md)")
+    p.add_argument("--input_batches", type=int, default=48,
+                   help="input mode: batches per epoch of the synthetic "
+                        "source")
+    p.add_argument("--input_workers", type=int, default=4,
+                   help="input mode: background decode workers for the "
+                        "piped variant (the legacy variant is always 0)")
+    p.add_argument("--input_depth", type=int, default=2,
+                   help="input mode: device-prefetch depth for the piped "
+                        "variant (the legacy variant is always 1)")
     p.add_argument("--offered_rps", type=float, default=500.0,
                    help="serve mode: open-loop Poisson arrival rate")
     p.add_argument("--requests", type=int, default=1000,
@@ -875,6 +998,24 @@ def main(argv=None) -> None:
             if getattr(a, dest) != p.get_default(dest):
                 p.error(f"--{dest} {getattr(a, dest)} is a serve-mode "
                         f"knob; --mode {a.mode} never reads it")
+    if a.mode != "input":
+        # input-mode knobs rejected by name elsewhere (the same
+        # mislabeled-measurement rule as the serve/ddp knobs)
+        for dest in ("input_latency_ms", "input_batches", "input_workers",
+                     "input_depth"):
+            if getattr(a, dest) != p.get_default(dest):
+                p.error(f"--{dest} {getattr(a, dest)} is an input-mode "
+                        f"knob; --mode {a.mode} never reads it")
+    else:
+        if a.input_latency_ms < 0:
+            p.error("--input_latency_ms must be >= 0")
+        if a.input_batches < 1:
+            p.error("--input_batches must be >= 1")
+        if a.input_workers < 1:
+            p.error("--input_workers must be >= 1 (the legacy variant "
+                    "already measures 0)")
+        if a.input_depth < 1:
+            p.error("--input_depth must be >= 1")
     if a.mode != "ddp":
         for dest in ("ddp_comm", "overlap", "model", "param_scale"):
             if getattr(a, dest) != p.get_default(dest):
@@ -890,7 +1031,9 @@ def main(argv=None) -> None:
         # value compare so an EXPLICIT --epochs 400 in accuracy mode is
         # honored instead of silently remapped
         a.epochs = (ACCURACY_EPOCHS if a.mode == "accuracy"
-                    else DDP_EPOCHS if a.mode == "ddp" else FUSED_EPOCHS)
+                    else DDP_EPOCHS if a.mode == "ddp"
+                    else INPUT_EPOCHS if a.mode == "input"
+                    else FUSED_EPOCHS)
     if a.epochs < 1:
         p.error("--epochs must be >= 1")
     if a.batch_size < 1:
@@ -904,10 +1047,12 @@ def main(argv=None) -> None:
         # accuracy mode READS the variant config (it trains the resolved
         # flagless variant); it still rejects the knobs it never consults.
         # ddp mode reads batch_size (per-chip) + epochs + ddp_comm and
-        # fixes the rest (xla kernel, f32 — the strategy is the variant).
+        # fixes the rest (xla kernel, f32 — the strategy is the variant);
+        # input mode likewise reads batch_size + epochs and fixes the
+        # step variant (the PIPELINE is the variant under measure).
         blocked = (("unroll", "ring", "batch_size") if a.mode == "accuracy"
                    else ("kernel", "dtype", "impl", "superstep", "unroll",
-                         "ring") if a.mode == "ddp"
+                         "ring") if a.mode in ("ddp", "input")
                    else ("kernel", "dtype", "impl", "superstep", "unroll",
                          "ring", "batch_size"))
         for dest in blocked:
@@ -994,6 +1139,8 @@ def main(argv=None) -> None:
         return _serve_bench(a)
     if a.mode == "ddp":
         return _ddp_bench(a)
+    if a.mode == "input":
+        return _input_bench(a)
 
     from pytorch_ddp_mnist_tpu.data import synthetic_mnist
     from pytorch_ddp_mnist_tpu.models import init_mlp

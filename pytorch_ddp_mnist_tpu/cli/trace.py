@@ -7,8 +7,18 @@ JSONL event traces training and serving emit.
         --baseline old_run/ --threshold 1.5      # exit 3 past threshold
     python -m pytorch_ddp_mnist_tpu trace report --serve /tmp/serve_obs
                                                  # serve-path attribution
+    python -m pytorch_ddp_mnist_tpu trace report --data /tmp/obs \
+        [--baseline OLD]            # input attribution + data-share gate
     python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
                                                  # load in Perfetto
+
+`report --data` reads the per-epoch `data_wait` spans a `--telemetry`
+streaming train run emits and prints the input-attribution story: what
+share of each epoch the host spent blocked on the input pipeline
+(p50/p95/max of data_wait/epoch). With `--baseline` it becomes the
+data_wait-share regression gate — exit 3 when the share regresses past
+`--threshold` (sub-millisecond waits exempt), mirroring the step-time and
+efficiency gates so a pipeline win cannot silently rot (docs/DATA.md).
 
 `report --serve` reads the request/batch spans a `--telemetry`-enabled
 serve run emits (serve/tracing.py) and prints the tail-latency
@@ -99,8 +109,74 @@ def _load_report(target: str):
     return report, None
 
 
+def _load_data_report(target: str):
+    """A data report from `target`: a saved `--data --json` file
+    (recognized by its "trace_data_stats" tag, plain or under the
+    combined --baseline shape) or a trace dir/file. Returns
+    (report, error_message) — mirrors `_load_report`."""
+    import os
+
+    from ..telemetry import analysis
+
+    if os.path.isfile(target) and not target.endswith(".jsonl"):
+        try:
+            with open(target) as f:
+                head = json.load(f)
+        except ValueError:
+            head = None
+        if isinstance(head, dict):
+            if head.get("report") == "trace_data_stats":
+                return head, None
+            nested = head.get("report")
+            if isinstance(nested, dict) \
+                    and nested.get("report") == "trace_data_stats":
+                return nested, None
+    paths = analysis.trace_files(target)
+    if not paths:
+        return None, f"{target}: no events*.jsonl found"
+    report = analysis.data_report(paths)
+    if report["epochs"] == 0:
+        return None, (f"{target}: no epoch spans with data_wait "
+                      f"attribution (train with --telemetry on the "
+                      f"STREAMING path to emit them)")
+    return report, None
+
+
 def _cmd_report(a) -> int:
     from ..telemetry import analysis
+
+    if a.data:
+        # the input-attribution report + the data_wait-share regression
+        # gate (docs/DATA.md): exit 3 when the share of epoch time spent
+        # blocked on input regresses past --threshold (sub-ms exempt)
+        report, err = _load_data_report(a.target)
+        if err:
+            print(f"trace report: {err}", file=sys.stderr)
+            return 1
+        if a.baseline:
+            baseline, err = _load_data_report(a.baseline)
+            if err:
+                print(f"trace report: baseline {err}", file=sys.stderr)
+                return 1
+            diff = analysis.compare_data(report, baseline,
+                                         threshold=a.threshold)
+            if a.json:
+                print(json.dumps({"report": report, "comparison": diff},
+                                 indent=2 if sys.stdout.isatty() else None))
+            else:
+                print(analysis.format_data_report(report))
+                print(analysis.format_compare_data(diff))
+            if not diff["rows"]:
+                print("trace report: no share stat overlaps the baseline "
+                      "— the gate checked nothing", file=sys.stderr)
+                return 1
+            return 3 if diff["regressions"] else 0
+        if a.json:
+            print(json.dumps(report,
+                             indent=2 if sys.stdout.isatty() else None))
+        else:
+            print(analysis.format_data_report(report))
+        return 0
 
     if a.serve:
         # the serve-path attribution report (docs/OBSERVABILITY.md
@@ -194,6 +270,14 @@ def main(argv=None) -> int:
                         "p50/p95/p99 + %% of e2e, batch occupancy and "
                         "padding waste, slowest-request exemplars "
                         "(docs/OBSERVABILITY.md §Request tracing)")
+    r.add_argument("--data", action="store_true",
+                   help="the input-attribution report instead of the train "
+                        "phase report: per-epoch data_wait share of epoch "
+                        "time (how much of training the host spent blocked "
+                        "on the input pipeline); with --baseline, the "
+                        "data_wait-share regression gate — exit 3 past "
+                        "--threshold, sub-ms data_wait exempt "
+                        "(docs/DATA.md)")
     r.add_argument("--baseline", metavar="OLD", default=None,
                    help="diff against another run (trace dir/file or saved "
                         "--json report); exit 3 when any phase p50/p95 "
@@ -221,6 +305,9 @@ def main(argv=None) -> int:
         if a.serve and a.baseline:
             p.error("--serve has no baseline gate (the step-time/"
                     "efficiency gates are the non-serve report's)")
+        if a.serve and a.data:
+            p.error("--serve and --data select different reports; "
+                    "pass one")
     return a.run(a)
 
 

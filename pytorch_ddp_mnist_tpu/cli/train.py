@@ -355,6 +355,26 @@ def main(argv=None) -> int:
             "--dropout_rng torch streams masks sized for the reference "
             "MLP's hidden layer; --model/--param_scale change that "
             "geometry — use the default jax dropout stream")
+    # Input-pipeline knob hygiene (pipeline/, docs/DATA.md): reject every
+    # combination some path would silently ignore, by name.
+    if tcfg["input_workers"] < 0:
+        raise SystemExit("--input_workers must be >= 0")
+    if tcfg["prefetch_depth"] < 1:
+        raise SystemExit("--prefetch_depth must be >= 1")
+    if tcfg["input_workers"] and tcfg["cached"]:
+        raise SystemExit(
+            "--input_workers feeds the streaming loader through the input "
+            "pipeline; --cached holds the dataset in HBM with no loader to "
+            "feed — drop --cached (the streaming loop) to use it")
+    if tcfg["input_workers"] and tcfg["num_workers"]:
+        raise SystemExit(
+            "--input_workers (the staged pipeline) supersedes the NetCDF "
+            "loader's --num_workers readahead; pass one of the two")
+    if tcfg["prefetch_depth"] != 1 and tcfg["fused"]:
+        raise SystemExit(
+            "--prefetch_depth pipelines per-chunk/per-batch device "
+            "transfers; --fused places ONE index array for the whole run — "
+            "there is nothing to prefetch")
     if not 0 <= tcfg["start_epoch"] <= tcfg["n_epochs"]:
         raise SystemExit(f"--start_epoch {tcfg['start_epoch']} outside "
                          f"[0, {tcfg['n_epochs']}] (n_epochs is the TOTAL "
@@ -968,7 +988,8 @@ def main(argv=None) -> int:
                               ckpt_every_steps=tcfg["ckpt_every_steps"],
                               step_hook=step_hook,
                               eval_perm=eval_perm,
-                              watchdog=watchdog)
+                              watchdog=watchdog,
+                              prefetch_depth=tcfg["prefetch_depth"])
     else:
         if tcfg["dropout_rng"] == "torch":
             # Masks stream from torch's bitwise CPU bernoulli stream
@@ -1001,7 +1022,9 @@ def main(argv=None) -> int:
                        ckpt_every_steps=tcfg["ckpt_every_steps"],
                        step_hook=step_hook,
                        eval_perm=eval_perm,
-                       watchdog=watchdog)
+                       watchdog=watchdog,
+                       input_workers=tcfg["input_workers"],
+                       prefetch_depth=tcfg["prefetch_depth"])
     from ..telemetry.health import TrainingHealthError
     try:
         state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,

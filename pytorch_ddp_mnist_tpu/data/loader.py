@@ -59,6 +59,12 @@ class BatchLoader:
     def __len__(self) -> int:
         return math.ceil(len(self.sampler) / self.batch_size)
 
+    def read_batch(self, b: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """One index batch -> (x, y) — the pipeline-capable load half
+        (pipeline/reader.py): stateless per batch, safe from worker
+        threads (numpy gathers share no cursor)."""
+        return self.images[b], self.labels[b].astype(np.int32)
+
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         return self.iter_from(0)
 
@@ -74,7 +80,7 @@ class BatchLoader:
             # this batch — the injected I/O hiccup the data_wait telemetry
             # phase exists to expose (no-op when no faults are installed)
             faultpoints.fire("loader_next", batch=i)
-            yield self.images[b], self.labels[b].astype(np.int32)
+            yield self.read_batch(b)
 
 
 class NetCDFShardLoader:
@@ -131,6 +137,14 @@ class NetCDFShardLoader:
     def _load(self, b: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         images = self._read("images", b)
         return normalize_images(images), self._labels[b].astype(np.int32)
+
+    def read_batch(self, b: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """One index batch -> (x, y) — the pipeline-capable load half
+        (pipeline/reader.py). Safe from worker threads: both the native
+        core and the pure-Python reader gather by POSITIONAL preads
+        (no shared file cursor), the same property the in-loader
+        readahead threads below already rely on."""
+        return self._load(b)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         yield from self.iter_from(0)
@@ -207,22 +221,12 @@ def device_prefetch(loader, sharding=None,
     before batch k's step is consumed lets XLA overlap PCIe/HBM copies with
     compute — the reference gets the same overlap from
     `non_blocking=True` + CUDA streams (ddp_tutorial_multi_gpu.py:87-88).
-    """
-    import jax
 
-    if put is None:
-        if sharding is not None:
-            def put(b):
-                return jax.device_put(b, sharding)
-        else:
-            def put(b):
-                return jax.tree_util.tree_map(jax.device_put, b)
-    it = iter(loader)
-    try:
-        pending = put(next(it))
-    except StopIteration:
-        return
-    for batch in it:
-        ready, pending = pending, put(batch)
-        yield ready
-    yield pending
+    Thin alias over `pipeline.prefetch(depth=1)` — the generalized depth-K
+    stage, which also fixed this function's old teardown: a producer
+    exception mid-iteration now drains the pending transfer (so its own
+    async failure can't be silently dropped with the abandoned array) and
+    re-raises the ORIGINAL error deterministically.
+    """
+    from ..pipeline.prefetch import prefetch
+    return prefetch(loader, depth=1, sharding=sharding, put=put)

@@ -1,0 +1,92 @@
+"""Staged, backpressured input pipeline — the repo's JAX-native answer to
+the source paper's parallel collective IO (PnetCDF sharded reads,
+`mnist_pnetcdf_cpu_mp.py`), done as threads + async device transfers
+instead of MPI ranks + CUDA streams.
+
+    source  ->  plan (lazy index batches, rank-sharded)       reader.py
+            ->  N decode workers, bounded reorder buffer      workers.py
+            ->  depth-K double-buffered jax.device_put        prefetch.py
+            ->  the train loop
+
+`feed()` is the ONE front door: `train.loop.fit` iterates it instead of a
+bare loader, `workers=0, depth=1` degenerates to exactly the legacy
+synchronous path, and any configuration is BITWISE identical to unpiped
+iteration over the same source (order-preserving by construction; pinned
+by tests/test_pipeline.py for both trainers). Mid-epoch resume threads
+through as `start` — batches are skipped at the INDEX level, never
+gathered, so PR 5's crash-resume parity holds with workers live. The
+consumer side adds ZERO host syncs: worker handoff and the `data.*`
+telemetry are host clock reads only (the `sanitize.no_host_sync` pin).
+
+See docs/DATA.md for the stage diagram, knob table, and the backpressure /
+shutdown / failure semantics.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .prefetch import prefetch
+from .reader import ShardReader, pipeline_capable, sequential_iter
+from .synthetic import SyntheticSource
+from .workers import WorkerPool
+
+__all__ = ["feed", "host_iter", "prefetch", "pipeline_capable",
+           "ShardReader", "SyntheticSource", "WorkerPool"]
+
+
+def _recorded(it, registry=None):
+    """Wrap a sequential host iterator with the same `data.*` metrics the
+    worker pool publishes (wait histogram + batch counter), so a piped and
+    an unpiped run expose one telemetry surface — the Prometheus endpoint
+    and `check_telemetry --require data.` see input health either way.
+    Clock reads only: no device traffic."""
+    if registry is None:
+        from ..telemetry import get_registry
+        registry = get_registry()
+    hist = registry.histogram("data.batch_wait_s")
+    batches = registry.counter("data.batches")
+
+    def recorded():
+        inner = iter(it)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(inner)
+            except StopIteration:
+                return
+            hist.record(time.perf_counter() - t0)
+            batches.inc()
+            yield item
+
+    return recorded()
+
+
+def host_iter(source, *, workers: int = 0, start: int = 0,
+              queue_depth: int = 2, registry=None):
+    """The host half of the pipeline: parallel loads behind a reorder
+    buffer when `workers > 0`, plain (recorded) iteration otherwise.
+    `start` is the mid-epoch resume offset — index-level skip in both
+    paths. A `workers > 0` request against a source that cannot split
+    plan from load is refused by name (a silently sequential "parallel"
+    pipeline would mislabel every measurement)."""
+    if workers < 0:
+        raise ValueError(f"workers must be >= 0; got {workers}")
+    if workers == 0:
+        return _recorded(sequential_iter(source, start), registry)
+    return iter(WorkerPool(ShardReader(source), workers, start=start,
+                           queue_depth=queue_depth, registry=registry))
+
+
+def feed(source, *, workers: int = 0, depth: int = 1, start: int = 0,
+         queue_depth: int = 2, sharding=None, put=None, registry=None):
+    """The pipeline front door: `source` -> device-ready batches.
+
+    Replaces `device_prefetch(loader)` iteration in the trainers:
+    `workers` background decode threads (0 = synchronous reads), `depth`
+    batches of H2D transfer lookahead, `start` the mid-epoch resume
+    offset. Returns an iterator of placed `(x, y)` batches in exact
+    source order."""
+    return prefetch(host_iter(source, workers=workers, start=start,
+                              queue_depth=queue_depth, registry=registry),
+                    depth=depth, sharding=sharding, put=put)

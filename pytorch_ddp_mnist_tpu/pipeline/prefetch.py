@@ -1,0 +1,92 @@
+"""Depth-K double-buffered device prefetch — the pipeline's H2D stage.
+
+Generalizes `data.loader.device_prefetch`'s one-slot lookahead: keep up to
+`depth` batches' host->device transfers IN FLIGHT while the consumer steps
+on the current batch. `jax.device_put` is async, so dispatching batch
+k+depth's transfer before batch k's step is consumed lets XLA overlap
+PCIe/HBM copies with compute — the bucket-pipelining playbook PR 7 applied
+to gradient collectives (arXiv:1711.00705), applied unchanged to the input
+side; the reference gets the same overlap from `non_blocking=True` + CUDA
+streams (ddp_tutorial_multi_gpu.py:87-88). `sharding` is shorthand for
+`jax.device_put` with that sharding (sharding-aware placement: a DP batch
+lands pre-sharded over the mesh); `put` overrides placement entirely (e.g.
+the DP global-batch assembler).
+
+Teardown is DETERMINISTIC: when the producer (or a `put` dispatch) raises
+mid-iteration, every already-dispatched transfer is drained
+(`jax.block_until_ready`, secondary errors swallowed) before the ORIGINAL
+exception re-raises — the legacy `device_prefetch` shape abandoned its
+pending transfer on a producer error, so an async transfer's own failure
+(surfacing only at consumption) was silently dropped with the array, and
+device work could outlive the error that killed the loop. The drain
+serializes: by the time the caller sees the exception, the device owes
+nothing. `device_prefetch` survives as a thin alias over `depth=1`.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+
+def _drain(pending: deque) -> None:
+    """Block on every dispatched transfer, swallowing secondary errors —
+    the primary exception (already propagating) must never be masked by a
+    transfer that failed for the same upstream reason."""
+    import jax
+    while pending:
+        item = pending.popleft()
+        try:
+            jax.block_until_ready(item)
+        except Exception:  # noqa: BLE001 — fault barrier: teardown only;
+            pass           # the original error is re-raised by the caller
+
+
+def prefetch(source, *, depth: int = 1, sharding=None,
+             put: Optional[Callable] = None):
+    """Iterate `source` with `depth` batches of device-transfer lookahead.
+
+    Order-preserving (batch k yields before k+1 dispatches nothing new —
+    the pipeline stays bitwise against unpiped iteration); `depth=1` is
+    exactly the legacy one-slot double buffer. StopIteration before the
+    window fills just shrinks the window. Validation is EAGER (this is a
+    plain function returning the generator): a bad depth raises at the
+    call site, not at the first batch."""
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1; got {depth}")
+    import jax
+
+    if put is None:
+        if sharding is not None:
+            def put(b):
+                return jax.device_put(b, sharding)
+        else:
+            def put(b):
+                return jax.tree_util.tree_map(jax.device_put, b)
+    return _prefetch_gen(source, depth, put)
+
+
+def _prefetch_gen(source, depth: int, put: Callable):
+    pending: deque = deque()
+    it = iter(source)
+    try:
+        exhausted = False
+        while len(pending) < depth and not exhausted:
+            try:
+                pending.append(put(next(it)))
+            except StopIteration:
+                exhausted = True
+        if not exhausted:
+            for batch in it:
+                # append BEFORE yielding: the consumer can close (or throw
+                # into) the generator at the yield point, and a transfer
+                # not yet in `pending` would escape the teardown drain
+                pending.append(put(batch))
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
+    except BaseException:
+        # deterministic teardown: the device must owe nothing by the time
+        # the caller sees the error (see module docstring)
+        _drain(pending)
+        raise

@@ -1,0 +1,97 @@
+"""Rank-sharded batch-read plans — the pipeline's source stage.
+
+The source paper's distinctive systems idea is parallel collective IO:
+every rank of `mnist_pnetcdf_cpu_mp.py` reads its own shard of ONE shared
+.nc file (rows 32,46), so no rank ever materializes the epoch. This module
+re-states that contract for the staged input pipeline: a *reader* separates
+the epoch's index PLAN (a lazy stream of `(batch_index, rows)` — the
+sampler shard sliced into wrap-padded static batches, exactly
+`data.loader._batched_indices`) from the row LOAD (`read_batch(rows)`: a
+memory gather, a sharded .nc pread, or a synthetic generator), so the
+background workers (`pipeline/workers.py`) can execute loads concurrently
+while batch ORDER stays a pure function of the plan — the property the
+legacy-loader bitwise-parity pin rests on.
+
+A source is *pipeline-capable* when it exposes the protocol the package
+loaders (`data.loader.BatchLoader` / `NetCDFShardLoader`) and
+`pipeline.synthetic.SyntheticSource` all implement:
+
+    source.sampler          ShardedSampler-shaped (set_epoch / indices)
+    source.batch_size       static batch row count
+    source.read_batch(rows) -> (x, y) for one index batch
+
+Duck-typed plain iterables stay supported through the sequential fallback
+(`sequential_iter`): no parallel reads — order-preserving parallelism over
+an opaque iterator would have to materialize it — but the same front door
+and the same `start` (mid-epoch resume) semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+def pipeline_capable(source) -> bool:
+    """True when `source` carries the plan/load split the worker stage
+    needs (see module docstring for the protocol)."""
+    return (hasattr(source, "read_batch") and hasattr(source, "sampler")
+            and hasattr(source, "batch_size"))
+
+
+class ShardReader:
+    """The plan/load split over one pipeline-capable source.
+
+    `plan(start)` yields `(batch_index, rows)` LAZILY from the sampler's
+    current epoch state — chunked at batch granularity, so neither this
+    rank's plan nor its loads ever hold the epoch (the PnetCDF
+    independent-read contract); `start` drops the first `start` batches at
+    the INDEX level, before any gather (the `iter_from` mid-epoch-resume
+    rule: skipped rows are never read). `load(rows)` is the source's
+    `read_batch` — stateless per batch, safe to run from worker threads
+    concurrently (numpy gathers and positional preads share no cursor).
+    """
+
+    def __init__(self, source):
+        if not pipeline_capable(source):
+            raise ValueError(
+                f"{type(source).__name__} is not pipeline-capable: the "
+                f"worker stage needs sampler/batch_size/read_batch(rows) "
+                f"(see pipeline/reader.py) — use workers=0 for plain "
+                f"sequential iteration")
+        self.source = source
+
+    def __len__(self) -> int:
+        return len(self.source)
+
+    def plan(self, start: int = 0) -> Iterator[Tuple[int, np.ndarray]]:
+        from ..data.loader import _batched_indices
+        for i, rows in enumerate(_batched_indices(self.source.sampler,
+                                                  self.source.batch_size)):
+            if i >= start:
+                yield i, rows
+
+    def load(self, rows: np.ndarray):
+        return self.source.read_batch(rows)
+
+
+def sequential_iter(source, start: int = 0):
+    """The workers=0 path: plain in-thread iteration with the same `start`
+    semantics as the worker stage — index-level skip through `iter_from`
+    when the source supports it (skipped batches' CONTENT is irrelevant:
+    the restored RNG key already encodes every step through them, and the
+    sampler permutation is position-addressed), a discard fallback for
+    duck-typed iterables that only support iteration."""
+    if start == 0:
+        return iter(source)
+    if hasattr(source, "iter_from"):
+        return source.iter_from(start)
+
+    def dropped():
+        it = iter(source)
+        for _ in range(start):
+            next(it, None)
+        yield from it
+
+    return dropped()

@@ -1,0 +1,85 @@
+"""Synthetic pipeline source — size/latency knobs that stress the input
+stack without touching disk.
+
+`bench.py --mode input` and `scripts/input_smoke.py` need a source whose
+cost profile is a KNOB, not an accident of the host's page cache: this one
+generates batches deterministically from (seed, row index) and charges a
+configurable per-batch `latency_s` at read time — crank it until the
+legacy synchronous loader is input-bound, then measure how much of that
+wait the worker/prefetch stages hide. Rows come from a small base table
+indexed modulo a prime, so memory stays O(features), independent of
+`n_batches * batch_size` (a million-batch epoch costs nothing to hold).
+
+Implements the full pipeline-capable protocol (pipeline/reader.py):
+`sampler` (a real single-shard `parallel.sampler.ShardedSampler` — the
+SAME epoch-reseed/permutation semantics as the package loaders, so the
+"reshuffles like the real loaders" claim is shared code, not a parallel
+implementation), `batch_size`, `read_batch(rows)`, plus the sequential
+loader surface (`__len__` / `__iter__` / `iter_from`) with the same
+`loader_next` chaos hook as `data.loader.BatchLoader`, so it drops into
+`fit` wherever a loader goes — piped or not, bitwise either way.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Iterator, Tuple
+
+import numpy as np
+
+_TABLE_ROWS = 251   # prime: rows % 251 decorrelates from batch_size
+
+
+class SyntheticSource:
+    """A loader-shaped batch source with synthetic rows and a read-latency
+    knob. `latency_s` sleeps per `read_batch` — charged in the WORKER when
+    piped (hidden behind compute) and in the consumer when not (the
+    input-bound legacy geometry the bench measures)."""
+
+    def __init__(self, n_batches: int = 64, batch_size: int = 128, *,
+                 features: int = 784, classes: int = 10,
+                 latency_s: float = 0.0, seed: int = 0):
+        if n_batches < 1 or batch_size < 1:
+            raise ValueError(f"n_batches/batch_size must be >= 1; got "
+                             f"{n_batches}/{batch_size}")
+        # lazy: keeps `import pytorch_ddp_mnist_tpu.pipeline` clear of the
+        # parallel package's jax-importing __init__
+        from ..parallel.sampler import ShardedSampler
+        self.batch_size = int(batch_size)
+        self.features = int(features)
+        self.classes = int(classes)
+        self.latency_s = float(latency_s)
+        n_rows = int(n_batches) * self.batch_size
+        self.sampler = ShardedSampler(n_rows, num_replicas=1, rank=0,
+                                      shuffle=True, seed=seed)
+        rng = np.random.default_rng(seed)
+        # O(features) memory whatever the epoch size: batches gather from
+        # this table by row index, values in the normalized-MNIST range
+        self._table = rng.standard_normal(
+            (_TABLE_ROWS, self.features)).astype(np.float32)
+
+    def __len__(self) -> int:
+        return math.ceil(len(self.sampler) / self.batch_size)
+
+    def read_batch(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        if self.latency_s > 0:
+            time.sleep(self.latency_s)
+        x = self._table[rows % _TABLE_ROWS]
+        y = (rows % self.classes).astype(np.int32)
+        return x, y
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        return self.iter_from(0)
+
+    def iter_from(self, start: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Sequential iteration from batch `start` — the same chaos hook
+        and index-level skip contract as the package loaders."""
+        from ..data.loader import _batched_indices
+        from ..utils import faultpoints
+        for i, b in enumerate(_batched_indices(self.sampler,
+                                               self.batch_size)):
+            if i < start:
+                continue
+            faultpoints.fire("loader_next", batch=i)
+            yield self.read_batch(b)

@@ -681,6 +681,163 @@ def format_serve_report(report: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# the data-wait attribution report (`trace report --data`)
+# ---------------------------------------------------------------------------
+
+# Below this, the data_wait p95 measures scheduler noise, not the input
+# stack: the share gate never fires on a sub-millisecond wait (the same
+# rule the step-time gate applies to its absolute values).
+DATA_SUBMS_EXEMPT_S = 1e-3
+
+
+def data_report(paths: List[str]) -> dict:
+    """One or many train trace files -> the input-attribution report
+    (`trace report --data`): per-epoch data_wait SHARE — the fraction of
+    each `epoch` span its `data_wait` child occupies, i.e. how much of
+    training the host spent blocked on the input pipeline. Shares pair a
+    data_wait span with ITS OWN parent epoch span (per segment, per
+    process), so appended resume runs and stragglers never cross-
+    contaminate. The p95 share is what the regression gate rides
+    (`compare_data`): the pipeline's whole job is driving it toward 0,
+    and a silent regression here is invisible to the step-time gate when
+    compute shrinks in proportion."""
+    records, parse_errors = load_traces(paths)
+    span_errors = list(parse_errors)
+    shares: List[float] = []
+    waits: List[float] = []
+    epoch_durs: List[float] = []
+    batch_counts: List[int] = []
+    procs = set()
+
+    by_file: Dict[str, List[dict]] = {}
+    for rec in records:
+        by_file.setdefault(rec["_file"], []).append(rec)
+
+    for path, recs in by_file.items():
+        for seg in split_segments(recs):
+            span_errors.extend(
+                f"{path}:{line}: {msg}"
+                for line, msg in span_structure_errors(seg))
+            spans = {rec["span"]: rec for rec in seg
+                     if rec.get("kind") == "span" and "span" in rec}
+            for rec in spans.values():
+                procs.add(rec.get("proc", 0))
+                if rec.get("name") != "data_wait":
+                    continue
+                dur = rec.get("dur_s")
+                parent = spans.get(rec.get("parent"))
+                if (not isinstance(dur, (int, float)) or parent is None
+                        or parent.get("name") != "epoch"):
+                    continue
+                pdur = parent.get("dur_s")
+                if not isinstance(pdur, (int, float)) or pdur <= 0:
+                    continue
+                waits.append(float(dur))
+                epoch_durs.append(float(pdur))
+                shares.append(float(dur) / float(pdur))
+                nb = (rec.get("attrs") or {}).get("batches")
+                if isinstance(nb, int) and not isinstance(nb, bool):
+                    batch_counts.append(nb)
+
+    s = sorted(shares)
+    return {
+        "report": "trace_data_stats",
+        "v": 1,
+        "files": sorted(by_file),
+        "processes": sorted(procs),
+        "records": len(records),
+        "epochs": len(shares),
+        "span_errors": span_errors,
+        "data_wait": _stats(waits, with_p99=True),
+        "epoch": _stats(epoch_durs),
+        "batches": sum(batch_counts),
+        # fractions of the epoch the host spent blocked on input
+        "share": {
+            "p50": _percentile(s, 0.50),
+            "p95": _percentile(s, 0.95),
+            "max": s[-1] if s else 0.0,
+            "mean": (sum(s) / len(s)) if s else 0.0,
+        },
+    }
+
+
+def compare_data(new: dict, baseline: dict, threshold: float = 1.5) -> dict:
+    """The data_wait-share regression gate: one row per share stat
+    (p50/p95) present in both reports; a regression is a share ratio
+    (new/old) past `threshold` — mirroring the step-time gate's
+    convention — UNLESS the new run's absolute data_wait p95 is
+    sub-millisecond (`DATA_SUBMS_EXEMPT_S`: at that scale the share's
+    numerator is scheduler noise). `cli/trace.py report --data
+    --baseline` turns regressions into exit 3, the same contract as the
+    step-time and efficiency gates."""
+    rows, regressions = [], []
+    new_wait_p95 = (new.get("data_wait") or {}).get("p95_s", 0.0)
+    exempt = (isinstance(new_wait_p95, (int, float))
+              and new_wait_p95 < DATA_SUBMS_EXEMPT_S)
+    for stat in ("p50", "p95"):
+        old_v = (baseline.get("share") or {}).get(stat)
+        new_v = (new.get("share") or {}).get(stat)
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            continue
+        ratio = new_v / old_v
+        row = {"phase": "data_wait_share", "stat": stat,
+               "baseline": old_v, "new": new_v, "ratio": ratio,
+               "sub_ms_exempt": exempt,
+               "regressed": ratio > threshold and not exempt}
+        rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    return {"threshold": threshold, "rows": rows,
+            "regressions": regressions}
+
+
+def format_data_report(report: dict) -> str:
+    """Human rendering of `data_report` (the --json flag prints the dict
+    itself)."""
+    lines = [f"data report: {report['epochs']} epoch(s) with data_wait "
+             f"attribution across {len(report['files'])} file(s), "
+             f"{report['batches']} batch wait(s)"]
+    if report["epochs"]:
+        sh, dw = report["share"], report["data_wait"]
+        lines.append(f"data_wait share of epoch: p50 {100 * sh['p50']:.1f}% "
+                     f"p95 {100 * sh['p95']:.1f}% max {100 * sh['max']:.1f}% "
+                     f"(mean {100 * sh['mean']:.1f}%)")
+        lines.append(f"data_wait absolute: p50 {dw['p50_s']:.4f}s "
+                     f"p95 {dw['p95_s']:.4f}s max {dw['max_s']:.4f}s "
+                     f"total {dw['total_s']:.4f}s")
+        lines.append(f"epoch absolute: p50 {report['epoch']['p50_s']:.4f}s "
+                     f"p95 {report['epoch']['p95_s']:.4f}s")
+    else:
+        lines.append("no epoch spans with a data_wait child found (a "
+                     "--telemetry STREAMING train run emits them; the "
+                     "cached path has no host data wait)")
+    if report["span_errors"]:
+        lines.append(f"span structure: {len(report['span_errors'])} "
+                     f"violation(s) — run scripts/check_telemetry.py")
+    return "\n".join(lines)
+
+
+def format_compare_data(diff: dict) -> str:
+    lines = [f"data-wait share gate (ratio > {diff['threshold']:g}x "
+             f"regresses; sub-ms data_wait exempt):"]
+    for row in diff["rows"]:
+        verdict = ("REGRESSION" if row["regressed"]
+                   else "exempt (sub-ms)" if row["sub_ms_exempt"]
+                   and row["ratio"] > diff["threshold"] else "ok")
+        lines.append(f"  share {row['stat']:<4} "
+                     f"{100 * row['baseline']:.1f}% -> "
+                     f"{100 * row['new']:.1f}%  ({row['ratio']:.2f}x)  "
+                     f"{verdict}")
+    if not diff["rows"]:
+        lines.append("  (no share stats overlap baseline — nothing gated)")
+    n = len(diff["regressions"])
+    lines.append(f"regression gate: "
+                 f"{f'FAIL — {n} share stat(s) past threshold' if n else 'PASS'}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # the regression gate
 # ---------------------------------------------------------------------------
 

@@ -125,7 +125,26 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                    help="readahead threads for the --netcdf streaming loader "
                         "(the reference's DataLoader worker count, "
                         "mnist_pnetcdf_cpu.py:58-60); the in-memory path is "
-                        "async via device prefetch regardless")
+                        "async via device prefetch regardless. Superseded "
+                        "by --input_workers (the staged pipeline) — passing "
+                        "both is rejected by name")
+    t.add_argument("--input_workers", type=int, default=0,
+                   help="staged input pipeline (pipeline/, docs/DATA.md): N "
+                        "background decode/normalize threads feeding the "
+                        "streaming train loop through a bounded reorder "
+                        "buffer — batch order (and the trained params) stay "
+                        "BITWISE identical to the synchronous default (0). "
+                        "Works for the in-memory and --netcdf loaders "
+                        "alike; rejected by name with --cached (the dataset "
+                        "lives in HBM there — no loader to feed)")
+    t.add_argument("--prefetch_depth", type=int, default=1,
+                   help="input pipeline H2D lookahead: keep K batches' "
+                        "host->device transfers in flight while the "
+                        "current step computes (pipeline/prefetch.py; 1 = "
+                        "the legacy one-slot double buffer). With --cached "
+                        "it prefetches the chunk index placements instead; "
+                        "--fused has one placement total and rejects a "
+                        "non-default depth by name")
     t.add_argument("--device", type=int, default=0,
                    help="reference-CLI parity (per-rank device ordinal); "
                         "device placement is mesh-driven on TPU")
@@ -322,6 +341,8 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "error_feedback": a.error_feedback == "on",
             "model": a.model, "param_scale": a.param_scale,
             "wireup_method": a.wireup_method, "num_workers": a.num_workers,
+            "input_workers": a.input_workers,
+            "prefetch_depth": a.prefetch_depth,
             "device": a.device, "checkpoint": a.checkpoint, "resume": a.resume,
             "start_epoch": a.start_epoch, "outage_retries": a.outage_retries,
             "ckpt_every_steps": a.ckpt_every_steps, "ckpt_keep": a.ckpt_keep,

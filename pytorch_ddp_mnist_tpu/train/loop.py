@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from ..models.mlp import mlp_apply
 from ..ops.loss import cross_entropy, accuracy
 from ..ops.sgd import sgd_step
-from ..data.loader import BatchLoader, device_prefetch
+from ..data.loader import BatchLoader
+from ..pipeline import feed as pipeline_feed
 from ..utils.logging import progress
 from ..utils.profiling import CumulativeTimer
 from ..telemetry.events import get_tracer
@@ -388,26 +389,6 @@ def _fire_step_hook(step_hook, every: int, nsteps: int, epoch: int, i: int,
                   TrainState(params, key, resid))
 
 
-def _skip_batches(loader, n: int):
-    """`loader`'s batches with the first `n` skipped — the mid-epoch
-    resume path (the skipped batches' CONTENT is irrelevant: the restored
-    RNG key already encodes every step through them, and the sampler
-    permutation is position-addressed). The package loaders skip at the
-    INDEX level (`iter_from` — skipped rows are never gathered from
-    memory or disk); the fallback discards materialized batches, for
-    duck-typed loaders that only support iteration."""
-    if hasattr(loader, "iter_from"):
-        return loader.iter_from(n)
-
-    def dropped():
-        it = iter(loader)
-        for _ in range(n):
-            next(it, None)
-        yield from it
-
-    return dropped()
-
-
 def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         epochs: int, batch_size: int, lr: float | None = None,
         log: Callable[[str], None] = print,
@@ -416,7 +397,8 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         start_offset: int = 0, ckpt_every_steps: int = 0,
         step_hook: Callable | None = None,
         eval_perm: Callable | None = None,
-        watchdog=None, model_apply: Callable | None = None) -> TrainState:
+        watchdog=None, model_apply: Callable | None = None,
+        input_workers: int = 0, prefetch_depth: int = 1) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -452,6 +434,17 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     which this loop does itself on the lr path) the per-step health aux
     vectors, stacked and fetched WITH the losses. A healthy or absent
     watchdog adds zero extra host syncs (pinned by tests/test_health.py).
+
+    Batches flow through the staged input pipeline (`pipeline.feed` — the
+    one front door): `input_workers` background decode threads feeding a
+    bounded reorder buffer (0, the default, = synchronous reads) and
+    `prefetch_depth` batches of H2D transfer lookahead (1 = the legacy
+    one-slot double buffer). Every configuration is BITWISE identical to
+    bare loader iteration (order-preserving pipeline, pinned by
+    tests/test_pipeline.py), mid-epoch resume skips at the index level
+    with workers live, and the consumer side adds zero host syncs —
+    the data_wait span and the epoch-granular fetch budget
+    (statics/sanitize.no_host_sync) hold unchanged. See docs/DATA.md.
     """
     from ..utils import faultpoints
 
@@ -514,10 +507,14 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             losses = []
             aux_list = []
             offset = start_offset if epoch == start_epoch else 0
-            src = (train_loader if offset == 0
-                   else _skip_batches(train_loader, offset))
+            # the staged input pipeline (pipeline/): decode workers +
+            # depth-K device prefetch behind one front door; the default
+            # (workers=0, depth=1) is exactly the legacy synchronous
+            # loader + one-slot double buffer, bitwise
             batches = progress(
-                device_prefetch(src, sharding=sharding, put=put),
+                pipeline_feed(train_loader, workers=input_workers,
+                              depth=prefetch_depth, start=offset,
+                              sharding=sharding, put=put),
                 desc=f"epoch {epoch}")
             live = _LiveLoss(batches)
             it = iter(batches)
@@ -558,8 +555,12 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             t_fetch = time.perf_counter()
             losses = np.asarray(jnp.stack(losses))  # single fetch per epoch
             fetch_s = time.perf_counter() - t_fetch
+            # batches = STEPS this epoch (step_timer.count): io_timer also
+            # wraps the end-of-epoch sentinel next() that returns None, so
+            # its count is one high — the report must agree with the
+            # pipeline's data.batches counter
             tracer.complete_span("data_wait", io_timer.total,
-                                 batches=io_timer.count)
+                                 batches=step_timer.count)
             tracer.complete_span("step_compute", step_timer.total + fetch_s,
                                  steps=step_timer.count, fetch_s=fetch_s)
             t_eval = time.perf_counter()

@@ -43,6 +43,7 @@ from ..ops.loss import cross_entropy
 from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
+from ..pipeline.prefetch import prefetch as pipeline_prefetch
 from ..telemetry.events import get_tracer
 from .loop import (TrainState, _fire_step_hook, epoch_summary, evaluate,
                    make_ddp_comm_recorder, make_eval_step,
@@ -738,7 +739,7 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                ckpt_every_steps: int = 0,
                step_hook: Callable | None = None,
                eval_perm: Callable | None = None,
-               watchdog=None) -> TrainState:
+               watchdog=None, prefetch_depth: int = 1) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
 
     `batch_size` is the GLOBAL batch (sampler shards rows per process; with a
@@ -779,6 +780,14 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     timing-based. Each fetched chunk is also the `nan` value-fault point
     (`faultpoints.poison_array`). `fused=True` rejects a watchdog by name:
     one whole-run device program has no live host to watch from.
+
+    `prefetch_depth` (the input pipeline's H2D stage, pipeline/prefetch.py)
+    keeps that many chunk INDEX arrays' device placements in flight: chunk
+    k+1's sharded `device_put` dispatches while chunk k's program computes,
+    so the host-synchronous placement cost leaves the critical path. The
+    placed values are identical at any depth — chunking math, per-step RNG
+    chain, and the epoch-granular fetch budget are all untouched (bitwise,
+    pinned by tests/test_pipeline.py).
     """
     import time
 
@@ -937,16 +946,29 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             # the unbroken run's past the resume point; the chunks are
             # consecutive slices of the same sequential scan either way, so
             # the math is chunking-invariant.
-            loss_parts = []
+            bounds = []
             c0 = offset
             while c0 < nb:
-                t_chunk = time.perf_counter()
                 c1 = (min(nb, (c0 // ckpt_every_steps + 1) * ckpt_every_steps)
                       if ckpt_every_steps else nb)
-                part = idx[c0:c1]
+                bounds.append((c0, c1))
+                c0 = c1
+
+            def _place(part):
+                # sharding-aware device placement of one chunk's index
+                # rows; prefetched below so chunk k+1's H2D dispatches
+                # while chunk k's program computes (pipeline/prefetch.py)
                 if idx_sharding is not None:
-                    part = jax.make_array_from_callback(
+                    return jax.make_array_from_callback(
                         part.shape, idx_sharding, lambda s, _i=part: _i[s])
+                return jax.device_put(part)
+
+            placed = pipeline_prefetch(
+                (idx[b0:b1] for b0, b1 in bounds),
+                depth=prefetch_depth, put=_place)
+            loss_parts = []
+            for (c0, c1), part in zip(bounds, placed):
+                t_chunk = time.perf_counter()
                 if stateful:
                     params, key, part_losses, resid = epoch_fn(
                         params, key, x_all, y_all, part, resid)
@@ -980,7 +1002,6 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                         ckpt_epoch=ck_ep, ckpt_offset=ck_off,
                         dt_s=time.perf_counter() - t_chunk,
                         imgs=part_np.size * batch_size)
-                c0 = c1
             losses = np.concatenate(loss_parts)
             # the per-chunk loss fetches block until each chunk's program
             # finished (ONE fetch per epoch when unchunked), so this is

@@ -21,6 +21,13 @@ runs the whole chaos matrix on fake CPU devices:
   4. VERDICT  — the resumed final checkpoint must be BYTE-IDENTICAL to the
      baseline's, and the resumed run's telemetry must schema-validate and
      carry the checkpoint.* metrics (`check_telemetry --require checkpoint.`).
+  5. PIPELINE LEG (serial) — the same kill/resume matrix THROUGH the
+     staged input pipeline (docs/DATA.md): a streaming run with
+     `--input_workers 2 --prefetch_depth 2` is SIGKILLed at a seeded
+     mid-epoch step with decode workers live, resumed from the step-ckpt
+     directory with the pipeline still on, and its final checkpoint must
+     be BYTE-IDENTICAL to an UNPIPED golden run — mid-epoch resume and
+     the piped-vs-unpiped parity pin, in one leg.
 
 Exit codes: 0 = parity held; 1 = any phase failed (with the failing rank's
 output on stderr); 75 = skipped, this jax has no CPU multiprocess
@@ -117,6 +124,70 @@ def _run_chaos_world(argv, world: int, kill_rank: int, timeout: float,
 def _final_params(path: str):
     with open(path, "rb") as f:
         return f.read()
+
+
+def _run_serial(argv, timeout: float, extra_env=None):
+    """One serial (no-rendezvous) trainer process — the pipeline leg's
+    runner. Returns (rc, out, err)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train", *argv],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout)
+        return r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        return None, e.stdout or "", e.stderr or ""
+
+
+def _pipeline_leg(work: str, chaos_seed: int, timeout: float):
+    """Kill/resume THROUGH the input pipeline (step 5 of the module
+    docstring). Returns (ok, detail)."""
+    limit, batch, epochs, every = 256, 32, 2, 2
+    steps_per_epoch = -(-limit // batch)
+    total = steps_per_epoch * epochs
+    kill_step = random.Random(chaos_seed + 1).randrange(
+        max(1, every), total - 1)
+    golden = os.path.join(work, "pipe_golden.msgpack")
+    flaky = os.path.join(work, "pipe_flaky.msgpack")
+    base = ["--n_epochs", str(epochs), "--limit", str(limit),
+            "--batch_size", str(batch), "--lr", "0.1",
+            "--path", os.path.join(work, "data"),
+            "--ckpt_every_steps", str(every)]
+    pipe = ["--input_workers", "2", "--prefetch_depth", "2"]
+
+    # golden: UNPIPED — the parity target is the legacy synchronous path
+    rc, out, err = _run_serial(base + ["--checkpoint", golden], timeout)
+    if rc != 0:
+        return False, f"pipeline golden rc={rc}\n{out}\n{err}"
+    # chaos: piped run SIGKILLed mid-epoch with decode workers live
+    rc, out, err = _run_serial(
+        base + pipe + ["--checkpoint", flaky], timeout,
+        extra_env={"PDMT_FAULT": f"kill:step={kill_step}"})
+    if rc != -9:
+        return False, (f"pipeline chaos rc={rc}, expected SIGKILL (-9)"
+                       f"\n{out}\n{err}")
+    steps_dir = flaky + ".steps"
+    if not os.path.isdir(steps_dir) or not os.listdir(steps_dir):
+        return False, f"no step checkpoints under {steps_dir}"
+    # resume: pipeline still on, restores mid-epoch and finishes
+    rc, out, err = _run_serial(
+        base + pipe + ["--checkpoint", flaky, "--resume", steps_dir],
+        timeout)
+    if rc != 0:
+        return False, f"pipeline resume rc={rc}\n{out}\n{err}"
+    if "[ckpt] resuming from" not in err:
+        return False, f"pipeline resume printed no restore line\n{err}"
+    if _final_params(golden) != _final_params(flaky):
+        return False, ("piped kill/resume final checkpoint differs from "
+                       "the UNPIPED golden run")
+    return True, {"kill_step": kill_step, "steps_per_epoch": steps_per_epoch}
 
 
 def main(argv=None) -> int:
@@ -237,11 +308,21 @@ def main(argv=None) -> int:
               f"\n{check.stderr}", file=sys.stderr)
         return 1
 
+    # 5. the serial pipeline leg: kill/resume with decode workers live,
+    # parity against an UNPIPED golden (mid-epoch resume THROUGH the
+    # staged input pipeline — docs/DATA.md)
+    ok, detail = _pipeline_leg(work, a.chaos_seed, a.timeout)
+    if not ok:
+        print(f"chaos_smoke: FAIL in pipeline leg — {detail}",
+              file=sys.stderr)
+        return 1
+
     print(json.dumps({
         "chaos_smoke": "ok", "world": a.world, "chaos_seed": a.chaos_seed,
         "kill_rank": kill_rank, "kill_step": kill_step,
         "steps_per_epoch": steps_per_epoch,
         "parity": "bitwise", "telemetry": "validated",
+        "pipeline_leg": {"parity": "bitwise", **detail},
     }))
     if not a.keep_workdir and a.workdir is None:
         shutil.rmtree(work, ignore_errors=True)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Input-pipeline smoke (docs/DATA.md): drive a REAL telemetry-instrumented
+training run through the staged input pipeline — background decode workers
++ depth-K device prefetch over a synthetic source — with the runtime
+sanitizers armed, then gate the emitted trace.
+
+    JAX_PLATFORMS=cpu python scripts/input_smoke.py       # = make input-smoke
+
+What it pins:
+
+  * `sanitize.no_host_sync`: the pipeline may add worker threads but ZERO
+    consumer-side host syncs — zero block_until_ready calls and the PR 10
+    EPOCH-granular fetch budget (<= 6 fetches/epoch) hold with workers
+    live (the ISSUE 12 contract);
+  * `sanitize.lock_trace`: every lock the worker pool creates (plan lock,
+    reorder-buffer condition, slot semaphore) records its acquisition
+    order — any observed order cycle fails the smoke (LOCK002's runtime
+    confirmation over the new threads);
+  * the trace round trip: `scripts/check_telemetry.py --require data.`
+    must pass on the run's JSONL — schema + span structure valid AND the
+    `data.*` pipeline metrics (queue depth gauge, batch-wait histogram)
+    present in the registry snapshot;
+  * `trace report --data` renders (the data_wait-share attribution view
+    exists for the run), via the same in-process analysis module.
+
+Prints one JSON line on success; exit 1 with the failing contract on
+violation. Pure CPU, seconds of wall time — wired into `make check`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+# runnable from anywhere: the repo root (this script's parent's parent)
+# fronts sys.path so the package imports without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import numpy as np
+
+    from pytorch_ddp_mnist_tpu import telemetry
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.pipeline import SyntheticSource
+    from pytorch_ddp_mnist_tpu.statics import sanitize
+    from pytorch_ddp_mnist_tpu.telemetry import analysis
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    epochs, workers, depth = 2, 2, 2
+    out_dir = tempfile.mkdtemp(prefix="pdmt_input_smoke_")
+    out = {"telemetry": out_dir, "epochs": epochs, "workers": workers,
+           "prefetch_depth": depth}
+    test = synthetic_mnist(64, seed=1)
+    src = SyntheticSource(12, 32, latency_s=0.002, seed=0)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    telemetry.enable(out_dir, process_index=0)
+    try:
+        with sanitize.lock_trace() as locks:
+            with sanitize.no_host_sync(max_fetches=epochs * 6) as sync:
+                fit(state, src, normalize_images(test.images),
+                    test.labels.astype(np.int32), epochs=epochs,
+                    batch_size=32, lr=0.1, log=lambda _m: None,
+                    input_workers=workers, prefetch_depth=depth)
+        out["lock_edges"] = len(locks.edges())
+        out["lock_cycles"] = 0
+        out["fetches"] = sync.fetches
+        out["block_until_ready"] = sync.block_until_ready_calls
+    except sanitize.SanitizerError as e:
+        print(f"input_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+    finally:
+        # the data.* registry metrics must land in the trace's final
+        # snapshot record for the --require gate below
+        telemetry.get_tracer().snapshot(telemetry.get_registry())
+        telemetry.disable()
+
+    check = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "check_telemetry.py"),
+         "--require", "data.", out_dir],
+        capture_output=True, text=True)
+    if check.returncode != 0:
+        print(f"input_smoke: FAIL — telemetry gate:\n{check.stdout}"
+              f"\n{check.stderr}", file=sys.stderr)
+        return 1
+    out["telemetry_gate"] = "validated"
+
+    rep = analysis.data_report(analysis.trace_files(out_dir))
+    if rep["epochs"] != epochs:
+        print(f"input_smoke: FAIL — data report attributed "
+              f"{rep['epochs']}/{epochs} epochs", file=sys.stderr)
+        return 1
+    out["data_wait_share_p95"] = round(rep["share"]["p95"], 4)
+    out["ok"] = True
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,44 @@ def test_stream_mode_contract():
     assert rec["value"] > 0
 
 
+def test_input_mode_contract():
+    """--mode input: ONE artifact line with legacy AND pipeline variant
+    rows (batches/sec + data_wait share each), the sanitizer's observed
+    fetch counts within budget, and vs_baseline = pipeline/legacy."""
+    rec = _run(["--mode", "input", "--epochs", "2", "--input_batches", "12",
+                "--batch_size", "32", "--input_latency_ms", "2",
+                "--input_workers", "2"])
+    assert rec["metric"] == "mnist_input_pipeline_batches_per_sec"
+    assert rec["unit"] == "batches/sec"
+    for row in (rec["legacy"], rec["pipeline"]):
+        assert row["batches_per_sec"] > 0
+        assert 0.0 <= row["data_wait_share_p95"] <= 1.0
+        # the PR 10 fetch-budget sanitizer held (its evidence is stamped)
+        assert row["block_until_ready"] == 0
+        assert row["fetches"] <= row["fetch_budget"]
+    assert rec["legacy"]["workers"] == 0
+    assert rec["pipeline"]["workers"] == 2
+    assert rec["vs_baseline"] == round(
+        rec["pipeline"]["batches_per_sec"]
+        / rec["legacy"]["batches_per_sec"], 4)
+
+
+def test_input_mode_knob_hygiene():
+    # input knobs rejected by name outside input mode...
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "stream",
+         "--input_latency_ms", "9"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0
+    assert "input-mode knob" in out.stderr
+    # ...and train variant knobs rejected inside it
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "input", "--kernel", "xla"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert out.returncode != 0
+    assert "never reads it" in out.stderr
+
+
 def test_eval_mode_contract():
     """--mode eval: inference throughput of the reference eval pass, fused
     repetitions in one program. JSON contract only; the anti-hoisting

@@ -86,6 +86,43 @@ def test_serial_kill_at_seeded_step_resumes_bitwise(tmp_path):
     assert _ckpt_bytes(golden) == _ckpt_bytes(torn)
 
 
+def test_pipeline_kill_at_seeded_step_resumes_bitwise(tmp_path):
+    """Mid-epoch resume THROUGH the staged input pipeline (ISSUE 12): a
+    STREAMING run with decode workers + depth-2 device prefetch live
+    (`--input_workers 2 --prefetch_depth 2`) is SIGKILLed at a seeded
+    mid-epoch step, resumed from the step-checkpoint directory with the
+    pipeline still on, and the finished checkpoint must be byte-identical
+    to an UNPIPED golden run — one test pins both the pipeline's
+    legacy-loader bitwise parity AND that `iter_from`-level resume holds
+    with workers running (skipped batches never gathered, worker threads
+    re-seated past the offset)."""
+    base = ["--limit", "256", "--batch_size", "32", "--lr", "0.1",
+            "--n_epochs", "2", "--path", str(tmp_path / "data"),
+            "--ckpt_every_steps", "2"]
+    pipe = ["--input_workers", "2", "--prefetch_depth", "2"]
+    steps_per_epoch = 8                      # 256 / 32
+    kill_step = random.Random(23).randrange(2, 2 * steps_per_epoch - 1)
+
+    golden = tmp_path / "golden.msgpack"     # UNPIPED parity target
+    r = _run_cli(base + ["--checkpoint", str(golden)])
+    assert r.returncode == 0, r.stderr[-3000:]
+
+    flaky = tmp_path / "flaky.msgpack"
+    r = _run_cli(base + pipe + ["--checkpoint", str(flaky)],
+                 extra_env={"PDMT_FAULT": f"kill:step={kill_step}"})
+    assert r.returncode == -9, (r.returncode, r.stderr[-2000:])
+    steps_dir = tmp_path / "flaky.msgpack.steps"
+    assert sorted(p for p in os.listdir(steps_dir)
+                  if p.endswith(".json")), \
+        "the killed piped run left no committed step checkpoints"
+
+    r = _run_cli(base + pipe + ["--checkpoint", str(flaky),
+                                "--resume", str(steps_dir)])
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "[ckpt] resuming from" in r.stderr
+    assert _ckpt_bytes(golden) == _ckpt_bytes(flaky)
+
+
 def test_int8_kill_resume_drift_bounded(tmp_path):
     """comm=int8 crash/resume coverage (ISSUE 7 satellite): SIGKILL an
     8-fake-device --parallel --ddp_comm int8 run at a seeded mid-run step,

@@ -184,6 +184,35 @@ def test_pallas_epoch_cli_guards(capsys):
         main(["--kernel", "pallas_epoch", "--cached", "--batch_size", "2048"])
 
 
+def test_input_pipeline_cli_guards():
+    """Input-pipeline knob hygiene (pipeline/, ISSUE 12): every
+    combination some path would silently ignore is rejected by name at
+    parse/validate time."""
+    with pytest.raises(SystemExit, match="--input_workers must be"):
+        main(["--input_workers", "-1", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="--prefetch_depth must be"):
+        main(["--prefetch_depth", "0", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="no loader to feed"):
+        main(["--input_workers", "2", "--cached", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="supersedes"):
+        main(["--input_workers", "2", "--num_workers", "2",
+              "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="nothing to prefetch"):
+        main(["--prefetch_depth", "2", "--cached", "--fused",
+              "--n_epochs", "1"])
+
+
+def test_input_pipeline_cli_end_to_end(tmp_path, capsys):
+    """A piped CLI run trains and prints the reference epoch line — the
+    front-door flags reach pipeline.feed."""
+    rc = main(["--n_epochs", "1", "--limit", "128", "--batch_size", "32",
+               "--checkpoint", "", "--path", str(tmp_path / "data"),
+               "--input_workers", "2", "--prefetch_depth", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Epoch=0" in out
+
+
 def test_health_cli_guards(tmp_path):
     """--health guard rails fail by name at parse/validate time: a fused
     run has no live host to watch from, and checkpoint-and-warn needs a

@@ -1,0 +1,446 @@
+"""The staged input pipeline (pytorch_ddp_mnist_tpu/pipeline/): reader
+plan/load split, background decode workers (order, backpressure, failure
+propagation, shutdown), depth-K device prefetch (incl. the deterministic-
+teardown fix device_prefetch inherited), the synthetic source, the data.*
+telemetry, and THE acceptance pins — pipeline-fed `fit`/`fit_cached`
+BITWISE identical to the unpiped paths, with zero new host syncs."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
+                                        synthetic_mnist)
+from pytorch_ddp_mnist_tpu.data.loader import device_prefetch
+from pytorch_ddp_mnist_tpu.models import init_mlp
+from pytorch_ddp_mnist_tpu.parallel import ShardedSampler
+from pytorch_ddp_mnist_tpu.pipeline import (ShardReader, SyntheticSource,
+                                            WorkerPool, feed, host_iter,
+                                            pipeline_capable, prefetch)
+from pytorch_ddp_mnist_tpu.statics import sanitize
+from pytorch_ddp_mnist_tpu.telemetry import MetricsRegistry
+from pytorch_ddp_mnist_tpu.train import TrainState, fit
+from pytorch_ddp_mnist_tpu.train.scan import fit_cached
+from pytorch_ddp_mnist_tpu.utils import faultpoints
+
+
+def _batch_loader(n=256, batch=32, seed=42):
+    split = synthetic_mnist(n, seed=0)
+    sampler = ShardedSampler(n, num_replicas=1, rank=0, seed=seed)
+    return BatchLoader(normalize_images(split.images), split.labels,
+                       sampler, batch_size=batch)
+
+
+def _materialize(it):
+    return [(np.asarray(x), np.asarray(y)) for x, y in it]
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for (xa, ya), (xb, yb) in zip(a, b):
+        assert np.array_equal(xa, xb)
+        assert np.array_equal(ya, yb)
+
+
+# ---------------------------------------------------------------------------
+# synthetic source
+# ---------------------------------------------------------------------------
+
+def test_synthetic_source_deterministic_and_reshuffled():
+    a = SyntheticSource(6, 16, seed=3)
+    b = SyntheticSource(6, 16, seed=3)
+    a.sampler.set_epoch(1)
+    b.sampler.set_epoch(1)
+    _assert_batches_equal(_materialize(a), _materialize(b))
+    # a different epoch reshuffles (like the real loaders)
+    b.sampler.set_epoch(2)
+    xa = np.asarray(next(iter(a))[0])
+    xb = np.asarray(next(iter(b))[0])
+    assert not np.array_equal(xa, xb)
+
+
+def test_synthetic_source_iter_from_drops_head():
+    src = SyntheticSource(6, 16, seed=3)
+    src.sampler.set_epoch(0)
+    _assert_batches_equal(list(_materialize(src))[2:],
+                          _materialize(src.iter_from(2)))
+
+
+def test_synthetic_source_is_pipeline_capable():
+    assert pipeline_capable(SyntheticSource(2, 8))
+    assert pipeline_capable(_batch_loader())
+    assert not pipeline_capable(iter([(1, 2)]))
+
+
+# ---------------------------------------------------------------------------
+# worker pool
+# ---------------------------------------------------------------------------
+
+def test_worker_pool_order_bitwise_vs_sequential():
+    loader = _batch_loader()
+    loader.sampler.set_epoch(0)
+    want = _materialize(loader)
+    for workers in (1, 3):
+        got = _materialize(WorkerPool(ShardReader(_reshuffled(loader)),
+                                      workers, registry=MetricsRegistry()))
+        _assert_batches_equal(want, got)
+
+
+def _reshuffled(loader):
+    # same sampler state object — the pool reads the CURRENT epoch like
+    # sequential iteration does
+    return loader
+
+
+def test_worker_pool_start_offset_skips_at_index_level():
+    loader = _batch_loader()
+    loader.sampler.set_epoch(1)
+    want = _materialize(loader)[3:]
+    got = _materialize(WorkerPool(ShardReader(loader), 2, start=3,
+                                  registry=MetricsRegistry()))
+    _assert_batches_equal(want, got)
+
+
+def test_worker_pool_propagates_error_in_order_and_joins():
+    class Boom(SyntheticSource):
+        def read_batch(self, rows):
+            x, y = super().read_batch(rows)
+            if int(y[0]) == int(self._boom_row % self.classes) \
+                    and np.array_equal(rows, self._boom_rows):
+                raise RuntimeError("decode failed at batch 3")
+            return x, y
+
+    src = Boom(8, 4, seed=5)
+    src.sampler.set_epoch(0)
+    order = src.sampler.indices()
+    src._boom_rows = order[3 * 4:4 * 4]
+    src._boom_row = src._boom_rows[0]
+    got = 0
+    with pytest.raises(RuntimeError, match="decode failed at batch 3"):
+        for _ in WorkerPool(ShardReader(src), 3,
+                            registry=MetricsRegistry()):
+            got += 1
+    assert got == 3          # every batch BEFORE the failure arrived first
+    deadline = time.time() + 5
+    while time.time() < deadline and any(
+            t.is_alive() for t in threading.enumerate()
+            if t.name.startswith("pdmt-input-worker")):
+        time.sleep(0.05)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("pdmt-input-worker") and t.is_alive()]
+
+
+def test_worker_pool_early_consumer_exit_joins_workers():
+    src = SyntheticSource(16, 8, latency_s=0.005, seed=0)
+    src.sampler.set_epoch(0)
+    it = iter(WorkerPool(ShardReader(src), 2, registry=MetricsRegistry()))
+    next(it)
+    it.close()               # mid-epoch abandon: shutdown must be clean
+    deadline = time.time() + 5
+    while time.time() < deadline and any(
+            t.is_alive() for t in threading.enumerate()
+            if t.name.startswith("pdmt-input-worker")):
+        time.sleep(0.05)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("pdmt-input-worker") and t.is_alive()]
+
+
+def test_worker_pool_is_one_shot():
+    src = SyntheticSource(2, 8, seed=0)
+    src.sampler.set_epoch(0)
+    pool = WorkerPool(ShardReader(src), 1, registry=MetricsRegistry())
+    _materialize(pool)
+    with pytest.raises(RuntimeError, match="one-shot"):
+        iter(pool)
+
+
+def test_worker_pool_rejects_bad_knobs():
+    reader = ShardReader(SyntheticSource(2, 8))
+    with pytest.raises(ValueError, match="num_workers"):
+        WorkerPool(reader, 0, registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="queue_depth"):
+        WorkerPool(reader, 1, queue_depth=0, registry=MetricsRegistry())
+
+
+def test_host_iter_rejects_uncapable_source_with_workers():
+    with pytest.raises(ValueError, match="not pipeline-capable"):
+        host_iter(iter([(1, 2)]), workers=2)
+
+
+def test_loader_stall_fires_inside_worker():
+    """The chaos contract: a loader_stall spec stalls PRODUCTION (the
+    worker thread), the batch still arrives, and the spec records as
+    fired — the watchdog-visible degradation path."""
+    inj = faultpoints.install("loader_stall:batch=1:delay_s=0.25")
+    try:
+        src = SyntheticSource(4, 8, seed=0)
+        src.sampler.set_epoch(0)
+        t0 = time.perf_counter()
+        got = _materialize(WorkerPool(ShardReader(src), 1,
+                                      registry=MetricsRegistry()))
+        dt = time.perf_counter() - t0
+        assert len(got) == 4
+        assert inj.specs[0].fired == 1
+        assert dt >= 0.25    # the stall really happened, in the worker
+    finally:
+        faultpoints.install("")
+
+
+def test_worker_pool_publishes_data_metrics():
+    reg = MetricsRegistry()
+    src = SyntheticSource(5, 8, seed=0)
+    src.sampler.set_epoch(0)
+    _materialize(WorkerPool(ShardReader(src), 2, registry=reg))
+    snap = reg.snapshot()
+    assert snap["histograms"]["data.batch_wait_s"]["n"] == 5
+    assert snap["counters"]["data.batches"] == 5
+    assert "data.queue_depth" in snap["gauges"]
+    assert snap["gauges"]["data.workers"] == 2
+
+
+def test_sequential_host_iter_publishes_data_metrics():
+    reg = MetricsRegistry()
+    src = SyntheticSource(5, 8, seed=0)
+    src.sampler.set_epoch(0)
+    _materialize(host_iter(src, workers=0, registry=reg))
+    snap = reg.snapshot()
+    assert snap["histograms"]["data.batch_wait_s"]["n"] == 5
+    assert snap["counters"]["data.batches"] == 5
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+def test_prefetch_preserves_order_at_any_depth():
+    items = [np.full((4,), i, np.float32) for i in range(7)]
+    for depth in (1, 2, 3, 10):   # depth > len shrinks the window
+        out = list(prefetch(iter(items), depth=depth,
+                            put=lambda b: b + 0.0))
+        assert len(out) == 7
+        for i, o in enumerate(out):
+            assert np.array_equal(np.asarray(o), items[i])
+
+
+def test_prefetch_rejects_bad_depth_eagerly():
+    with pytest.raises(ValueError, match="depth"):
+        prefetch([], depth=0)    # no next() needed: validation is eager
+
+
+def test_prefetch_teardown_drains_and_reraises_original():
+    """The device_prefetch fix (ISSUE 12 satellite): a producer exception
+    mid-iteration drains every pending transfer (block_until_ready) and
+    re-raises the ORIGINAL error — never a secondary one, never
+    silently."""
+    def gen():
+        yield np.ones(4, np.float32)
+        yield np.ones(4, np.float32)
+        raise ValueError("producer died mid-epoch")
+
+    with sanitize.no_host_sync(max_block_until_ready=None) as s:
+        with pytest.raises(ValueError, match="producer died mid-epoch"):
+            list(prefetch(gen(), depth=2))
+    # the two dispatched transfers were drained during teardown
+    assert s.block_until_ready_calls >= 2
+
+
+def test_prefetch_consumer_close_drains_every_dispatched_transfer():
+    """The consumer-abandon half of the teardown contract: closing the
+    generator at the yield point (what a raising train step does to the
+    feed) must drain EVERY dispatched transfer — including the one
+    dispatched for the yield in progress."""
+    dispatched = []
+
+    def put(b):
+        dispatched.append(b)
+        return b
+
+    drained = []
+
+    class _Probe:
+        def __init__(self, i):
+            self.i = i
+
+    items = [_Probe(i) for i in range(6)]
+    import importlib
+    # the package re-exports the FUNCTION under the submodule's name, so
+    # plain `import ...pipeline.prefetch` resolves to the function
+    pf = importlib.import_module("pytorch_ddp_mnist_tpu.pipeline.prefetch")
+
+    orig = pf._drain
+
+    def spying_drain(pending):
+        drained.extend(pending)
+        pending.clear()
+
+    pf._drain = spying_drain
+    try:
+        it = prefetch(iter(items), depth=2, put=put)
+        got = [next(it), next(it)]
+        it.close()
+    finally:
+        pf._drain = orig
+    # every dispatched-but-unyielded transfer was handed to the drain
+    assert {p.i for p in dispatched} - {p.i for p in got} \
+        == {p.i for p in drained}
+    assert drained, "nothing drained — the in-flight window leaked"
+
+
+def test_device_prefetch_alias_delegates_to_pipeline():
+    loader = _batch_loader()
+    loader.sampler.set_epoch(0)
+    want = _materialize(loader)
+    got = _materialize(device_prefetch(loader))
+    _assert_batches_equal(want, got)
+
+
+def test_feed_parity_all_configurations():
+    src0 = SyntheticSource(8, 16, seed=7)
+    src0.sampler.set_epoch(0)
+    want = _materialize(src0)
+    for workers, depth, start in ((0, 1, 0), (0, 3, 2), (2, 1, 0),
+                                  (3, 2, 3)):
+        src = SyntheticSource(8, 16, seed=7)
+        src.sampler.set_epoch(0)
+        got = _materialize(feed(src, workers=workers, depth=depth,
+                                start=start, registry=MetricsRegistry()))
+        _assert_batches_equal(want[start:], got)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pins: pipeline-fed trainers stay BITWISE
+# ---------------------------------------------------------------------------
+
+def _fit_params(workers, depth):
+    split = synthetic_mnist(256, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(256, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(split.images), split.labels,
+                         sampler, batch_size=32)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    out = fit(state, loader, normalize_images(test.images),
+              test.labels.astype(np.int32), epochs=2, batch_size=32,
+              lr=0.1, log=lambda _m: None,
+              input_workers=workers, prefetch_depth=depth)
+    return jax.tree_util.tree_map(np.asarray, out.params)
+
+
+def test_fit_pipeline_bitwise_parity():
+    """Legacy-loader parity pin (ISSUE 12 acceptance): same seed + same
+    source -> pipeline-fed fit is BITWISE identical to the unpiped path."""
+    want = jax.tree_util.tree_leaves(_fit_params(0, 1))
+    got = jax.tree_util.tree_leaves(_fit_params(3, 2))
+    assert all(np.array_equal(a, b) for a, b in zip(got, want))
+
+
+def _fit_cached_params(depth, every=0):
+    split = synthetic_mnist(256, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(256, num_replicas=1, rank=0, seed=42)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    out = fit_cached(state, split.images, split.labels.astype(np.int32),
+                     sampler, normalize_images(test.images),
+                     test.labels.astype(np.int32), epochs=2, batch_size=32,
+                     lr=0.1, log=lambda _m: None, ckpt_every_steps=every,
+                     prefetch_depth=depth)
+    return jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, out.params))
+
+
+def test_fit_cached_prefetch_bitwise_parity():
+    """The fit_cached half of the parity pin: depth-K chunk-placement
+    prefetch is bitwise, chunked or not."""
+    want = _fit_cached_params(1)
+    assert all(np.array_equal(a, b)
+               for a, b in zip(_fit_cached_params(3), want))
+    chunk_want = _fit_cached_params(1, every=3)
+    assert all(np.array_equal(a, b)
+               for a, b in zip(_fit_cached_params(3, every=3), chunk_want))
+    # chunking itself stays invariant under prefetch too
+    assert all(np.array_equal(a, b) for a, b in zip(chunk_want, want))
+
+
+def test_fit_pipeline_zero_new_host_syncs():
+    """The ISSUE 12 sync contract: worker threads, yes — consumer-side
+    host syncs, ZERO. The PR 10 epoch-granular fetch budget holds with
+    the pipeline on."""
+    split = synthetic_mnist(128, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(split.images), split.labels,
+                         sampler, batch_size=32)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    epochs = 2
+    with sanitize.no_host_sync(max_fetches=epochs * 6) as s:
+        fit(state, loader, normalize_images(test.images),
+            test.labels.astype(np.int32), epochs=epochs, batch_size=32,
+            lr=0.1, log=lambda _m: None, input_workers=2,
+            prefetch_depth=2)
+    assert s.block_until_ready_calls == 0
+
+
+def test_fit_mid_epoch_resume_through_pipeline_in_process():
+    """In-process mid-epoch resume parity with workers live: capture the
+    state a step checkpoint would commit mid-epoch, resume a piped fit
+    from it, finish bitwise on the unbroken PIPED (== unpiped) run."""
+    def build():
+        split = synthetic_mnist(256, seed=0)
+        test = synthetic_mnist(64, seed=1)
+        sampler = ShardedSampler(256, num_replicas=1, rank=0, seed=42)
+        loader = BatchLoader(normalize_images(split.images), split.labels,
+                             sampler, batch_size=32)
+        return (loader, normalize_images(test.images),
+                test.labels.astype(np.int32))
+
+    saved = {}
+
+    def hook(ep, off, gs, st):
+        if gs == 3:          # a mid-epoch position (8 steps/epoch)
+            saved["state"] = TrainState(
+                jax.tree_util.tree_map(np.asarray, st.params),
+                np.asarray(jax.random.key_data(st.key)))
+            saved["pos"] = (ep, off)
+
+    loader, x_test, y_test = build()
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    unbroken = fit(state, loader, x_test, y_test, epochs=2, batch_size=32,
+                   lr=0.1, log=lambda _m: None, ckpt_every_steps=3,
+                   step_hook=hook, input_workers=2, prefetch_depth=2)
+    assert saved["pos"][1] != 0      # genuinely mid-epoch
+
+    loader2, x_test2, y_test2 = build()
+    resumed_state = TrainState(
+        jax.tree_util.tree_map(jax.numpy.asarray, saved["state"].params),
+        jax.random.wrap_key_data(jax.numpy.asarray(saved["state"].key)))
+    resumed = fit(resumed_state, loader2, x_test2, y_test2, epochs=2,
+                  batch_size=32, lr=0.1, log=lambda _m: None,
+                  start_epoch=saved["pos"][0], start_offset=saved["pos"][1],
+                  input_workers=2, prefetch_depth=2)
+    a = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, unbroken.params))
+    b = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, resumed.params))
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# NetCDF source through the pipeline
+# ---------------------------------------------------------------------------
+
+def test_netcdf_loader_through_worker_pool(tmp_path):
+    from pytorch_ddp_mnist_tpu.data.convert import main as convert_main
+    from pytorch_ddp_mnist_tpu.data.loader import NetCDFShardLoader
+
+    convert_main(["--synthetic", "128:16", "--out_dir", str(tmp_path)])
+    ldr = NetCDFShardLoader(str(tmp_path / "mnist_train_images.nc"),
+                            batch_size=32)
+    ldr.sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    ldr.sampler.set_epoch(0)
+    want = _materialize(ldr)
+    got = _materialize(WorkerPool(ShardReader(ldr), 2,
+                                  registry=MetricsRegistry()))
+    _assert_batches_equal(want, got)

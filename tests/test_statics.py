@@ -458,19 +458,24 @@ def test_lock002_unions_the_graph_across_files(tmp_path):
 def test_thread_entry_map_on_the_real_tree():
     """The auditor's thread-entry map sees the real producers: prom.py's
     daemon scrape thread, flight.py's SIGTERM handler, batcher.py's
-    loop-scheduled flush timer."""
+    loop-scheduled flush timer, and the input pipeline's decode workers
+    (pipeline/workers.py — the ISSUE 12 contract: every worker thread is
+    registered in the statics thread-entry map)."""
+    import pytorch_ddp_mnist_tpu.pipeline.workers as workers_mod
     import pytorch_ddp_mnist_tpu.serve.batcher as batcher_mod
     import pytorch_ddp_mnist_tpu.telemetry.flight as flight_mod
     import pytorch_ddp_mnist_tpu.telemetry.prom as prom_mod
 
     auditor = concurrency.ConcurrencyAuditor()
-    for mod in (prom_mod, flight_mod, batcher_mod):
+    for mod in (prom_mod, flight_mod, batcher_mod, workers_mod):
         with open(mod.__file__, encoding="utf-8") as f:
             auditor.add_source(f.read(), mod.__file__)
     assert "serve_forever" in auditor.entries["thread"]
     assert "_flush_and_chain" in auditor.entries["signal"]
     assert "_on_timer" in auditor.entries["loop"]
     assert "flush" in auditor.entries["loop"]   # called from _on_timer
+    # the input pipeline's decode workers land in the thread map
+    assert "_work" in auditor.entries["thread"]
 
 
 def test_lock001_groups_attributes_per_class():

@@ -707,3 +707,157 @@ def test_main_dispatch_knows_trace():
     from pytorch_ddp_mnist_tpu.__main__ import _COMMANDS
     assert "trace" in _COMMANDS
     assert _COMMANDS["trace"][0] == "pytorch_ddp_mnist_tpu.cli.trace"
+
+
+# ---------------------------------------------------------------------------
+# the data-wait attribution report + share regression gate (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def _emit_data_trace(path, shares, *, epoch_s=0.1, proc=0):
+    """One process's trace with FABRICATED epoch/data_wait intervals:
+    epoch e lasts `epoch_s`, its data_wait child `shares[e] * epoch_s` —
+    explicit-interval spans (emit_span) so the structure validator's
+    exit-before-emission and containment rules hold."""
+    import time as _time
+
+    tr = telemetry.EventTrace(str(path), process_index=proc)
+    for e, share in enumerate(shares):
+        t0 = _time.perf_counter() - epoch_s - 0.01
+        w0 = _time.time() - epoch_s - 0.01
+        pid = tr.emit_span("epoch", t0_mono=t0, t0_wall=w0, dur_s=epoch_s,
+                           attrs={"epoch": e})
+        tr.emit_span("data_wait", t0_mono=t0, t0_wall=w0,
+                     dur_s=share * epoch_s, parent=pid,
+                     attrs={"batches": 4})
+    tr.close()
+    return str(path)
+
+
+def test_data_report_shares_and_stats(tmp_path):
+    f = _emit_data_trace(tmp_path / "events.jsonl", [0.2, 0.4, 0.8])
+    rep = analysis.data_report([f])
+    assert rep["report"] == "trace_data_stats"
+    assert rep["epochs"] == 3
+    assert rep["batches"] == 12
+    assert rep["share"]["p50"] == pytest.approx(0.4, rel=1e-6)
+    assert rep["share"]["p95"] == pytest.approx(0.8, rel=1e-6)
+    assert rep["share"]["max"] == pytest.approx(0.8, rel=1e-6)
+    assert rep["data_wait"]["p95_s"] == pytest.approx(0.08, rel=1e-6)
+    assert not rep["span_errors"]
+
+
+def test_data_report_ignores_unparented_data_wait(tmp_path):
+    # a data_wait with no epoch parent (e.g. a hand-rolled trace) cannot
+    # produce a share
+    tr = telemetry.EventTrace(str(tmp_path / "events.jsonl"),
+                              process_index=0)
+    tr.complete_span("data_wait", 0.5)
+    tr.close()
+    rep = analysis.data_report([str(tmp_path / "events.jsonl")])
+    assert rep["epochs"] == 0
+
+
+def test_compare_data_gates_share_regression():
+    new = {"share": {"p50": 0.5, "p95": 0.8},
+           "data_wait": {"p95_s": 0.08}}
+    old = {"share": {"p50": 0.1, "p95": 0.2},
+           "data_wait": {"p95_s": 0.02}}
+    diff = analysis.compare_data(new, old, threshold=1.5)
+    assert len(diff["rows"]) == 2
+    assert len(diff["regressions"]) == 2
+    # improvement never regresses
+    ok = analysis.compare_data(old, new, threshold=1.5)
+    assert not ok["regressions"]
+
+
+def test_compare_data_sub_ms_exempt():
+    # 4x share regression, but the new data_wait p95 is sub-ms: exempt
+    new = {"share": {"p50": 0.4, "p95": 0.4},
+           "data_wait": {"p95_s": 0.0004}}
+    old = {"share": {"p50": 0.1, "p95": 0.1},
+           "data_wait": {"p95_s": 0.0001}}
+    diff = analysis.compare_data(new, old, threshold=1.5)
+    assert diff["rows"] and all(r["sub_ms_exempt"] for r in diff["rows"])
+    assert not diff["regressions"]
+
+
+def test_trace_cli_data_view_and_gate(tmp_path, capsys):
+    good = tmp_path / "good"
+    bad = tmp_path / "bad"
+    good.mkdir()
+    bad.mkdir()
+    _emit_data_trace(good / "events.jsonl", [0.1, 0.1, 0.12])
+    _emit_data_trace(bad / "events.jsonl", [0.6, 0.7, 0.8])
+
+    # plain view renders
+    assert trace_cli.main(["report", "--data", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "data_wait share of epoch" in out
+
+    # self-baseline passes; regression exits 3
+    assert trace_cli.main(["report", "--data", str(good),
+                           "--baseline", str(good)]) == 0
+    capsys.readouterr()
+    assert trace_cli.main(["report", "--data", str(bad),
+                           "--baseline", str(good)]) == 3
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+
+    # a saved --json report feeds back as baseline (the step-time gate's
+    # round-trip contract, mirrored)
+    assert trace_cli.main(["report", "--data", str(good), "--json"]) == 0
+    saved = tmp_path / "saved.json"
+    saved.write_text(capsys.readouterr().out)
+    assert trace_cli.main(["report", "--data", str(bad),
+                           "--baseline", str(saved)]) == 3
+    capsys.readouterr()
+
+
+def test_trace_cli_data_errors(tmp_path, capsys):
+    # no trace at all -> 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert trace_cli.main(["report", "--data", str(empty)]) == 1
+    # a trace with no data_wait attribution -> 1, named
+    nodata = tmp_path / "nodata"
+    nodata.mkdir()
+    tr = telemetry.EventTrace(str(nodata / "events.jsonl"),
+                              process_index=0)
+    tr.point("hello")
+    tr.close()
+    assert trace_cli.main(["report", "--data", str(nodata)]) == 1
+    err = capsys.readouterr().err
+    assert "data_wait" in err
+    # --serve and --data conflict at the parser
+    with pytest.raises(SystemExit):
+        trace_cli.main(["report", "--data", "--serve", str(empty)])
+
+
+def test_real_streaming_run_feeds_data_report(tmp_path):
+    """End to end on a REAL piped training run: the emitted trace yields
+    a data report whose epoch count matches, and the checker's --require
+    data. gate passes on the same directory."""
+    import numpy as np
+    import jax
+
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.pipeline import SyntheticSource
+    from pytorch_ddp_mnist_tpu.data import normalize_images, synthetic_mnist
+    from pytorch_ddp_mnist_tpu.train import TrainState, fit
+
+    out_dir = tmp_path / "obs"
+    telemetry.enable(str(out_dir), process_index=0)
+    try:
+        test = synthetic_mnist(64, seed=1)
+        src = SyntheticSource(6, 32, latency_s=0.001, seed=0)
+        fit(TrainState(init_mlp(jax.random.key(0)), jax.random.key(1)),
+            src, normalize_images(test.images),
+            test.labels.astype(np.int32), epochs=2, batch_size=32, lr=0.1,
+            log=lambda _m: None, input_workers=2, prefetch_depth=2)
+        telemetry.get_tracer().snapshot(telemetry.get_registry())
+    finally:
+        telemetry.disable()
+    rep = analysis.data_report(analysis.trace_files(str(out_dir)))
+    assert rep["epochs"] == 2
+    assert not rep["span_errors"]
+    assert check_main(["--require", "data.", str(out_dir)]) == 0
