@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -145,11 +145,33 @@ sanitize-smoke:
 input-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/input_smoke.py
 
+# Program-forensics smoke (docs/OBSERVABILITY.md §Program forensics): the
+# full cost harvest on 8 fake CPU devices — every comm x overlap step
+# program (statics builders) + the serve bucket ladder compiled, their
+# XLA cost/memory records emitted as a JSONL trace AND a COST artifact —
+# then the trace is gated on the xla.* compile metrics and mem.* HBM
+# watermark gauges being present plus the program_cost record contract,
+# the forensics report renders, and the compile/HBM regression gate
+# round-trips against itself (a harvest never regresses vs itself).
+cost-smoke:
+	rm -rf /tmp/pdmt_cost_smoke
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m pytorch_ddp_mnist_tpu trace cost \
+		--telemetry /tmp/pdmt_cost_smoke \
+		-o /tmp/pdmt_cost_smoke/COST.json
+	$(PY) scripts/check_telemetry.py --require xla. --require mem. \
+		/tmp/pdmt_cost_smoke
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --cost \
+		/tmp/pdmt_cost_smoke/COST.json
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --cost \
+		/tmp/pdmt_cost_smoke/COST.json \
+		--baseline /tmp/pdmt_cost_smoke/COST.json
+
 # The committed pre-merge gate: static contracts first (seconds), then the
 # runtime sanitizers on the live paths (incl. the input pipeline), then
-# the serve request-tracing round trip (also seconds), then the fast
-# test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke test-fast
+# the serve request-tracing round trip (also seconds), then the program
+# cost/memory harvest round trip, then the fast test tier.
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke cost-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -167,6 +167,16 @@ def registry_stamp(registry=None) -> dict:
     dev = snap["gauges"].get("device.peak_bytes_in_use")
     if dev is not None:  # absent off-accelerator (CPU has no memory_stats)
         out["device_peak_bytes"] = dev
+    # the program-forensics pair (docs/OBSERVABILITY.md §Program
+    # forensics): the HBM watermark gauge (None off-accelerator, same
+    # degrade as device_peak_bytes) and the process's total compile-time
+    # bill from the xla.compile_s histogram the monitoring listener feeds
+    out["peak_hbm_bytes"] = snap["gauges"].get("mem.device_peak_bytes")
+    ch = snap["histograms"].get("xla.compile_s")
+    out["compile_s_total"] = (round(ch["total"], 3)
+                              if isinstance(ch, dict)
+                              and isinstance(ch.get("total"), (int, float))
+                              else None)
     # What degraded, not just that something did: detector fire counts +
     # worst severity from any watchdog that observed this process (the
     # device-mode bench runs one over its measured loss curves). A round
@@ -524,10 +534,10 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
     1-device baseline, and return one row dict per combination:
 
         {strategy, overlap, model, param_scale, n_params, n_devices,
-         images_per_sec, per_chip_images_per_sec,
-         scaling_efficiency_vs_1dev, bytes_on_wire_per_step_per_device,
-         collective_s_p50, parity_max_rel_diff_vs_pmean,
-         parity_max_abs_diff_vs_pmean}
+         per_chip_batch, images_per_sec, per_chip_images_per_sec,
+         scaling_efficiency_vs_1dev, analytic_efficiency,
+         bytes_on_wire_per_step_per_device, collective_s_p50,
+         parity_max_rel_diff_vs_pmean, parity_max_abs_diff_vs_pmean}
 
     `scaling_efficiency_vs_1dev` = (N-device per-chip rate) / (1-device
     rate of the same per-chip batch) — 1.0 is perfect linear scaling.
@@ -650,6 +660,9 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
     ref_leaves = jax.tree_util.tree_leaves(p_ref)
 
     rows = []
+    # analytic compute time per step (the roofline's C): strategy-
+    # independent — the 1-device rate of the same per-chip batch
+    compute_s = per_chip_batch / one_dev_rate
     for comm in strategies:
         # The isolated comm probe is overlap-AGNOSTIC (overlap is step-
         # program scheduling, not a different collective program), so it
@@ -671,7 +684,15 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                 base = next((r for r in rows if r["strategy"] == comm
                              and not r["overlap"]), None)
                 if base is not None:
-                    rows.append({**base, "overlap": True})
+                    # measurements copy (byte-identical program), but the
+                    # analytic bound follows the row's overlap flag —
+                    # max(C, M), the attribution convention (telemetry/
+                    # costs.py) — so the stamp and `trace report --cost`
+                    # can never disagree on the same row
+                    rows.append({**base, "overlap": True,
+                                 "analytic_efficiency": round(
+                                     compute_s / max(compute_s, coll_p50),
+                                     4)})
                     continue
             rate = measure(mesh, comm, overlap)
             leaves = jax.tree_util.tree_leaves(parity_params(comm, overlap))
@@ -681,6 +702,13 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                       for a, b in zip(leaves, ref_leaves))
             absd = max(float(np.max(np.abs(a - b)))
                        for a, b in zip(leaves, ref_leaves))
+            # the roofline decomposition's analytic efficiency (telemetry/
+            # costs.py): 1-device compute time C vs the isolated wire
+            # probe M — the efficiency this strategy WOULD reach were the
+            # step only compute + wire (measured efficiency below it is
+            # overhead, the trace report --cost story)
+            bound_s = (max(compute_s, coll_p50) if overlap
+                       else compute_s + coll_p50)
             rows.append({
                 "strategy": comm,
                 "overlap": bool(overlap),
@@ -688,10 +716,12 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                 "param_scale": param_scale,
                 "n_params": n_params,
                 "n_devices": n,
+                "per_chip_batch": per_chip_batch,
                 "images_per_sec": round(rate, 1),
                 "per_chip_images_per_sec": round(rate / n, 1),
                 "scaling_efficiency_vs_1dev": round((rate / n)
                                                     / one_dev_rate, 4),
+                "analytic_efficiency": round(compute_s / bound_s, 4),
                 "bytes_on_wire_per_step_per_device":
                     collectives.bytes_on_wire(params_host, n, comm),
                 "collective_s_p50": coll_p50,

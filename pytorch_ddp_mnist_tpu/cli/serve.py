@@ -229,6 +229,9 @@ def main(argv=None) -> int:
     # be visible evidence of a cold compile).
     telemetry.install_compile_listener()
     reg = telemetry.get_registry()
+    # live HBM watermark gauges (mem.*): the stats snapshot and any
+    # Prometheus scrape read the instant (guarded probes, None on CPU)
+    telemetry.install_memory_watermarks(reg)
     if a.telemetry:
         # request/batch spans into DIR (the tracer swap happens BEFORE the
         # first request, so every request_id is on the record), and the

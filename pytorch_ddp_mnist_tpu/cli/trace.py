@@ -11,6 +11,12 @@ JSONL event traces training and serving emit.
         [--baseline OLD]            # input attribution + data-share gate
     python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
                                                  # load in Perfetto
+    python -m pytorch_ddp_mnist_tpu trace cost -o COST.json \
+        [--telemetry DIR] [--model mlp --param_scale 16]
+                                    # HARVEST per-program cost records
+    python -m pytorch_ddp_mnist_tpu trace report --cost COST.json \
+        [--baseline OLD]     # program forensics + compile/HBM/efficiency
+                             # gate (also takes MULTICHIP_r0X.json)
 
 `report --data` reads the per-epoch `data_wait` spans a `--telemetry`
 streaming train run emits and prints the input-attribution story: what
@@ -145,6 +151,45 @@ def _load_data_report(target: str):
 def _cmd_report(a) -> int:
     from ..telemetry import analysis
 
+    if a.cost:
+        # the program-forensics report + the compile/HBM/efficiency gate
+        # (docs/OBSERVABILITY.md §Program forensics): TARGET is a saved
+        # `trace cost` report (COST_r0X.json) or a DDP bench artifact
+        # (MULTICHIP_r0X.json), whose measured rows decompose into
+        # analytic compute/comm/overhead shares — framework-free, like
+        # the other report paths
+        from ..telemetry import costs
+        report, err = costs.load_cost_report(a.target,
+                                             per_chip_batch=a.batch)
+        if err:
+            print(f"trace report: {err}", file=sys.stderr)
+            return 1
+        if a.baseline:
+            baseline, err = costs.load_cost_report(a.baseline,
+                                                   per_chip_batch=a.batch)
+            if err:
+                print(f"trace report: baseline {err}", file=sys.stderr)
+                return 1
+            diff = costs.compare_cost(report, baseline,
+                                      threshold=a.threshold)
+            if a.json:
+                print(json.dumps({"report": report, "comparison": diff},
+                                 indent=2 if sys.stdout.isatty() else None))
+            else:
+                print(costs.format_cost_report(report))
+                print(costs.format_compare_cost(diff))
+            if not diff["rows"]:
+                print("trace report: no cost metric overlaps the baseline "
+                      "— the gate checked nothing", file=sys.stderr)
+                return 1
+            return 3 if diff["regressions"] else 0
+        if a.json:
+            print(json.dumps(report,
+                             indent=2 if sys.stdout.isatty() else None))
+        else:
+            print(costs.format_cost_report(report))
+        return 0
+
     if a.data:
         # the input-attribution report + the data_wait-share regression
         # gate (docs/DATA.md): exit 3 when the share of epoch time spent
@@ -234,6 +279,15 @@ def _cmd_report(a) -> int:
     return 0
 
 
+def _cmd_cost(a) -> int:
+    from ..telemetry import costs
+    if a.param_scale < 1 or a.batch < 1 or a.n_devices < 1:
+        print("trace cost: --param_scale/--batch/--n_devices must be >= 1",
+              file=sys.stderr)
+        return 2
+    return costs.harvest_cli(a)
+
+
 def _cmd_export(a) -> int:
     from ..telemetry import analysis, export
 
@@ -256,7 +310,8 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="analyze / gate / export telemetry JSONL traces "
                     "(see docs/OBSERVABILITY.md)")
-    sub = p.add_subparsers(dest="cmd", required=True, metavar="report|export")
+    sub = p.add_subparsers(dest="cmd", required=True,
+                           metavar="report|export|cost")
 
     r = sub.add_parser(
         "report", help="per-phase p50/p95/max, epoch trend, straggler "
@@ -278,6 +333,19 @@ def main(argv=None) -> int:
                         "data_wait-share regression gate — exit 3 past "
                         "--threshold, sub-ms data_wait exempt "
                         "(docs/DATA.md)")
+    r.add_argument("--cost", action="store_true",
+                   help="the program-forensics report: TARGET is a saved "
+                        "`trace cost` report (COST_r0X.json) or a DDP "
+                        "bench artifact whose measured rows decompose "
+                        "into analytic compute/comm/overhead shares; "
+                        "with --baseline, the compile-count / peak-HBM / "
+                        "analytic-efficiency regression gate — exit 3 "
+                        "(docs/OBSERVABILITY.md §Program forensics)")
+    r.add_argument("--batch", type=int, default=None,
+                   help="with --cost: per-chip batch of a LEGACY artifact "
+                        "whose rows predate the per_chip_batch stamp "
+                        "(MULTICHIP_r07 measured at 4; default 128, the "
+                        "bench default)")
     r.add_argument("--baseline", metavar="OLD", default=None,
                    help="diff against another run (trace dir/file or saved "
                         "--json report); exit 3 when any phase p50/p95 "
@@ -298,6 +366,52 @@ def main(argv=None) -> int:
                    help="output path (default ./trace.chrome.json)")
     e.set_defaults(run=_cmd_export)
 
+    c = sub.add_parser(
+        "cost", help="HARVEST program cost/memory records: compile the "
+                     "comm x overlap DDP matrix (statics program "
+                     "builders) + the serve bucket ladder, extract "
+                     "cost_analysis/memory_analysis per program, write a "
+                     "COST_r0X.json artifact (read it back with "
+                     "`trace report --cost`)")
+    c.add_argument("-o", "--out", default=None,
+                   help="write the cost report JSON here (stdout table "
+                        "always prints)")
+    c.add_argument("--telemetry", metavar="DIR", default=None,
+                   help="also emit the JSONL trace: one program_cost "
+                        "point per record + a final registry snapshot "
+                        "(xla.* compile metrics, mem.* watermarks) — the "
+                        "check_telemetry --require xla./mem. surface")
+    c.add_argument("--model", default="mlp",
+                   help="workload family (models/zoo.py; default mlp)")
+    c.add_argument("--param_scale", type=int, default=1,
+                   help="hidden-width multiplier (16 = the MULTICHIP_r07 "
+                        "5.8M-param geometry)")
+    c.add_argument("--batch", type=int, default=16,
+                   help="PER-DEVICE batch rows of the harvested step "
+                        "programs (default 16, the audit geometry)")
+    c.add_argument("--n_devices", type=int, default=8,
+                   help="mesh size (default 8, the audit geometry); "
+                        "without that many real devices the harvest "
+                        "degrades to deviceless cost-only records")
+    c.add_argument("--form", choices=("step", "run", "both"),
+                   default="step",
+                   help="which DDP program forms to harvest (default "
+                        "step — the measured strategy programs)")
+    c.add_argument("--no-serve-ladder", dest="serve_ladder",
+                   action="store_false",
+                   help="skip the serve engine bucket-ladder records")
+    c.add_argument("--serve_max_batch", type=int, default=128,
+                   help="serve ladder cap (default 128, the engine "
+                        "default: buckets 1..128)")
+    c.add_argument("--artifact", default=None,
+                   help="a DDP bench artifact (MULTICHIP_r0X.json) whose "
+                        "measured rows become the roofline attribution "
+                        "section of the report")
+    c.add_argument("--per_chip_batch", type=int, default=None,
+                   help="the artifact's measured per-chip batch when its "
+                        "rows predate the stamp (r07: 4)")
+    c.set_defaults(run=_cmd_cost)
+
     a = p.parse_args(argv)
     if a.cmd == "report":
         if a.threshold <= 0:
@@ -305,9 +419,19 @@ def main(argv=None) -> int:
         if a.serve and a.baseline:
             p.error("--serve has no baseline gate (the step-time/"
                     "efficiency gates are the non-serve report's)")
-        if a.serve and a.data:
-            p.error("--serve and --data select different reports; "
-                    "pass one")
+        picked = [f for f in ("serve", "data", "cost")
+                  if getattr(a, f)]
+        if len(picked) > 1:
+            p.error(f"--{picked[0]} and --{picked[1]} select different "
+                    f"reports; pass one")
+        if a.batch is not None and not a.cost:
+            p.error("--batch only applies to the --cost report")
+        if a.batch is not None and a.batch < 1:
+            p.error("--batch must be >= 1 (the artifact's measured "
+                    "per-chip batch)")
+    if a.cmd == "cost" and a.per_chip_batch is not None \
+            and a.per_chip_batch < 1:
+        p.error("--per_chip_batch must be >= 1")
     return a.run(a)
 
 

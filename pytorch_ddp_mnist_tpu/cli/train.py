@@ -126,12 +126,33 @@ def _train_with_outage_retry(run_fit, state, tcfg, stash, trace, argv,
 
     retries = tcfg["outage_retries"]
     start = tcfg["start_epoch"]
+    # The program name an allocation failure will be attributed to
+    # (telemetry/costs.py OOM forensics): the DDP label matches the cost
+    # harvest's step records, the serial label names the kernel.
+    if tcfg["parallel"]:
+        from ..parallel.collectives import step_cost_label
+        # --cached runs the scan program (the harvest's ddp.run.* records),
+        # streaming the step program — the label must join the cost table
+        program_label = step_cost_label(
+            tcfg["ddp_comm"], tcfg["overlap"],
+            form="run" if tcfg["cached"] else "step")
+    else:
+        program_label = f"train.{tcfg['kernel']}"
+    from ..telemetry.runtime import label_compiles
     attempt = 0
     while True:
         try:
-            with trace(tcfg["profile"]):
+            # compiles inside the fit attribute to this run's program
+            # label (telemetry/costs.py compile_attribution)
+            with trace(tcfg["profile"]), label_compiles(program_label):
                 return run_fit(state, start)
         except RuntimeError as e:
+            # OOM forensics FIRST, unconditionally: an allocation failure
+            # is not an outage (no backend-loss signature), so it will
+            # re-raise below — but it must die naming the program and the
+            # memory budget it blew (no-op for non-OOM errors).
+            from ..telemetry.costs import record_oom_forensics
+            record_oom_forensics(e, program=program_label)
             if attempt >= retries:
                 raise
             # Outage vs program error (ADVICE r4). SERIAL runs retry when
@@ -230,6 +251,10 @@ def main(argv=None) -> int:
     from .. import telemetry
     if tcfg["telemetry"]:
         telemetry.install_compile_listener()
+        # live HBM/RSS watermark gauges (mem.*): Prometheus scrapes and
+        # registry snapshots read the instant; guarded probes — None off-
+        # accelerator, same degrade contract as the memory_stats stamp
+        telemetry.install_memory_watermarks()
         # Post-mortems land beside the JSONL trace: the flight recorder
         # (wireup probe/retry + serve reject ring) dumps into the telemetry
         # dir on a fatal backend outage or a caller's SIGTERM, so a killed
@@ -828,6 +853,8 @@ def main(argv=None) -> int:
     metrics_server = None
     if tcfg["metrics_port"] is not None and process_index == 0:
         from ..telemetry.prom import start_metrics_server
+        # scrapes should see the HBM watermarks even without --telemetry
+        telemetry.install_memory_watermarks()
         metrics_server = start_metrics_server(tcfg["metrics_port"])
         mhost, mport = metrics_server.server_address[:2]
         print(f"metrics on http://{mhost}:{mport}/metrics",

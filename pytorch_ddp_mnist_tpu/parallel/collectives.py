@@ -104,6 +104,18 @@ def validate_comm(comm: str) -> None:
                          f"choose one of {STRATEGIES}")
 
 
+def step_cost_label(comm: str, overlap: bool = False,
+                    form: str = "step") -> str:
+    """The ONE naming convention for a DDP program in the forensics layer:
+    `ddp.<form>.<comm>[+overlap]`. Shared by `parallel/ddp.py` (every
+    built step carries it as `.cost_label`), `telemetry/costs.py` (cost
+    records and compile attribution key on it), and the OOM forensics
+    dump — one function so the label a crash names is the label the cost
+    table holds."""
+    validate_comm(comm)
+    return f"ddp.{form}.{comm}" + ("+overlap" if overlap else "")
+
+
 def validate_bf16_rounding(bf16_rounding: str, comm: str) -> None:
     """The bf16 strategy's rounding mode knob: 'nearest' (default — the
     plain round-to-nearest-even cast) or 'stochastic'

@@ -303,6 +303,9 @@ def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
     step.ddp_quant_block = qb
     step.ddp_bucket_elems = be
     step.ddp_overlap = overlap
+    # the program-forensics name (telemetry/costs.py): compile attribution
+    # and OOM dumps key cost records on exactly this label
+    step.cost_label = collectives.step_cost_label(comm, overlap)
     return step
 
 

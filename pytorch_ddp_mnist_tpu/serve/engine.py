@@ -110,11 +110,27 @@ class InferenceEngine:
         # bucket -> AOT executable; populated ONLY here at warmup. Serving
         # looks executables up and never falls back to jit, so a missing
         # shape is a loud KeyError, not a silent multi-second compile.
+        # Each rung compiles under its forensics label, so the
+        # jax.monitoring listener attributes the warmup's compile time to
+        # `serve.bucket<N>` (telemetry/costs.py compile_attribution).
+        from ..telemetry.runtime import label_compiles
         self._compiled = {}
         self.compile_count = 0
         for b in self.buckets:
-            self._compiled[b] = self._compile(b)
+            with label_compiles(f"serve.bucket{b}"):
+                self._compiled[b] = self._compile(b)
             self.compile_count += 1
+        # Register the ladder's memory story in the program table the OOM
+        # forensics dump names (peak/arg/temp bytes per bucket). Reading
+        # the analyses off already-compiled executables is warmup-cheap;
+        # any failure (older jaxlib without memory_analysis, a backend
+        # that refuses the query) must never break serving.
+        try:
+            from ..telemetry.costs import harvest_engine
+            harvest_engine(self)
+        except (AttributeError, RuntimeError, ValueError, TypeError,
+                NotImplementedError, OSError):
+            pass  # forensics are advisory; the engine serves without them
 
     @classmethod
     def from_checkpoint(cls, path: str, **kw) -> "InferenceEngine":
@@ -165,11 +181,25 @@ class InferenceEngine:
               if self._x_sharding is not None else jnp.asarray(x))
         if bctx is not None:
             bctx.mark_h2d(bucket)
-        logits, preds = self._compiled[bucket](self._params, xd)
-        out = np.asarray(logits)[:n], np.asarray(preds)[:n], bucket
+        try:
+            logits, preds = self._compiled[bucket](self._params, xd)
+            out = np.asarray(logits)[:n], np.asarray(preds)[:n], bucket
+        except RuntimeError as e:
+            # an allocation failure dies naming the program and the HBM
+            # budget it blew (telemetry/costs.py; no-op for non-OOM
+            # errors) — the exception itself propagates unchanged
+            from ..telemetry.costs import record_oom_forensics
+            record_oom_forensics(e, program=f"serve.bucket{bucket}")
+            raise
         if bctx is not None:
             bctx.mark_computed()
         return out
+
+    def compiled_programs(self) -> dict:
+        """bucket -> the AOT-compiled executable: the forensics surface
+        (`telemetry.costs.harvest_engine` reads cost/memory analyses off
+        these; a copy, so callers cannot un-warm the ladder)."""
+        return dict(self._compiled)
 
     def _as_rows(self, x) -> np.ndarray:
         x = np.asarray(x, self._np_dtype)

@@ -207,29 +207,38 @@ def _mesh(n_dev: int):
     return abstract_mesh((n_dev,), ("dp",))
 
 
-def _example_params():
+def _example_params(model: str = "mlp", param_scale: int = 1):
     import jax
-    from ..models.mlp import init_mlp
-    return init_mlp(jax.random.PRNGKey(0))
+    from ..models.zoo import resolve_model
+    return resolve_model(model, param_scale).init(jax.random.PRNGKey(0))
 
 
 def build_step_program(comm: str, overlap: bool = False, *,
                        n_dev: int = N_DEVICES,
                        batch: int = BATCH_PER_DEVICE,
                        bucket_elems: Optional[int] = None,
-                       quant_block: Optional[int] = None):
+                       quant_block: Optional[int] = None,
+                       mesh=None, model: str = "mlp",
+                       param_scale: int = 1):
     """(program, example_args) for the streaming DP step
     (parallel.ddp.dp_step_program) over an AbstractMesh — shared by the
-    auditor and tests/test_export_lowering.py, so the program the tests
-    lower and the program the auditor walks can never drift."""
+    auditor, tests/test_export_lowering.py AND telemetry/costs.py's
+    cost/memory harvest, so the program the tests lower, the program the
+    auditor walks, and the program forensics measure can never drift.
+    `mesh` overrides the deviceless AbstractMesh with a real one (the
+    cost harvest compiles, which an AbstractMesh cannot); `model`/
+    `param_scale` select the workload (models/zoo.py) so the harvest can
+    measure the MULTICHIP artifact geometries."""
     import jax
     import jax.numpy as jnp
     from ..parallel import collectives
     from ..parallel.ddp import dp_step_program
-    params = _example_params()
-    prog = dp_step_program(_mesh(n_dev), 0.01, comm=comm, overlap=overlap,
+    params = _example_params(model, param_scale)
+    prog = dp_step_program(mesh if mesh is not None else _mesh(n_dev),
+                           0.01, comm=comm, overlap=overlap,
                            bucket_elems=bucket_elems,
-                           quant_block=quant_block)
+                           quant_block=quant_block,
+                           model=model, param_scale=param_scale)
     key = jax.random.PRNGKey(1)
     x = jnp.zeros((n_dev * batch, 784), jnp.float32)
     y = jnp.zeros((n_dev * batch,), jnp.int32)
@@ -250,17 +259,22 @@ def build_run_program(comm: str, overlap: bool = False, *,
                       batch: int = BATCH_PER_DEVICE,
                       epochs: int = 1, steps: int = 2,
                       bucket_elems: Optional[int] = None,
-                      quant_block: Optional[int] = None):
+                      quant_block: Optional[int] = None,
+                      mesh=None, model: str = "mlp",
+                      param_scale: int = 1):
     """(program, example_args) for the fit_cached scan body
-    (train.scan.make_dp_run_fn) over an AbstractMesh."""
+    (train.scan.make_dp_run_fn) over an AbstractMesh (or a real `mesh` —
+    see build_step_program)."""
     import jax
     import jax.numpy as jnp
     from ..parallel import collectives
     from ..train.scan import make_dp_run_fn
-    params = _example_params()
-    run = make_dp_run_fn(_mesh(n_dev), lr=0.01, comm=comm, overlap=overlap,
+    params = _example_params(model, param_scale)
+    run = make_dp_run_fn(mesh if mesh is not None else _mesh(n_dev),
+                         lr=0.01, comm=comm, overlap=overlap,
                          quant_block=quant_block,
-                         bucket_elems=bucket_elems)
+                         bucket_elems=bucket_elems,
+                         model=model, param_scale=param_scale)
     key = jax.random.PRNGKey(1)
     rows = n_dev * steps * batch
     x_all = jnp.zeros((rows, 784), jnp.uint8)

@@ -42,6 +42,13 @@ shared by train, serve, and bench alike:
   * `prom.py`      — pull-based live metrics: Prometheus text-format
     exposition of the registry (plus the `health_*` gauges), served from
     a stdlib HTTP thread (`/metrics`, `/healthz`) on `--metrics_port`.
+  * `costs.py`     — program forensics: per-program XLA cost/memory
+    records (`lowered.compile().cost_analysis()`/`.memory_analysis()`
+    over the statics program builders + the serve bucket ladder), the
+    measured-vs-analytic roofline attribution from DDP bench artifacts,
+    the compile/HBM regression gate (`trace report --cost --baseline`),
+    and OOM forensics (`looks_like_oom` + the flight-recorder program
+    memory table).
 
 Front doors: `cli/train.py --telemetry DIR` (JSONL + rank-0 end-of-run
 summary) / `--health POLICY` / `--metrics_port N`, `python -m
@@ -58,12 +65,20 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                        get_registry)
 from .events import (SCHEMA_VERSION, EventTrace, NullTracer,  # noqa: F401
                      disable, enable, get_tracer)
-from .runtime import (collect_memory, device_memory_stats,  # noqa: F401
+from .runtime import (collect_memory, compile_attribution,  # noqa: F401
+                      current_compile_label, device_memory_stats,
                       host_rss_bytes, install_compile_listener,
-                      process_index_cached, record_engine_compiles)
-from .analysis import (analyze, compare, load_trace,  # noqa: F401
-                       serve_report, serve_structure_errors,
+                      install_memory_watermarks, label_compiles,
+                      process_index_cached, record_engine_compiles,
+                      record_memory_point)
+from .analysis import (analyze, compare, cost_record_errors,  # noqa: F401
+                       load_trace, serve_report, serve_structure_errors,
                        span_structure_errors, trace_files)
+from .costs import (CostRecord, attribution_from_artifact,  # noqa: F401
+                    build_cost_report, compare_cost, harvest_engine,
+                    harvest_program, harvest_step_matrix, looks_like_oom,
+                    record_oom_forensics, register_program)
+from . import costs  # noqa: F401
 from .export import chrome_trace, profiler_trace, write_chrome_trace  # noqa: F401
 from .flight import (FlightRecorder, get_flight_recorder)  # noqa: F401
 from . import flight  # noqa: F401

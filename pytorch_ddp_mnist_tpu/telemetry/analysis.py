@@ -59,6 +59,17 @@ SERVE_BATCH_STAGE_ORDER = ("serve.batch_form", "serve.pad_h2d",
 # How many slowest-request exemplar trees a serve report carries.
 SERVE_EXEMPLAR_K = 8
 
+# -- the program-cost record contract (telemetry/costs.py emits these as
+# `point` events; literals here so the file-loading checker stays
+# framework-free — tests pin them against costs.py's catalog) --
+COST_POINT = "program_cost"
+# numeric cost fields: when present they must be non-negative numbers (a
+# negative flop/byte count is a harvester bug masquerading as data)
+COST_NUMERIC_FIELDS = (
+    "flops", "transcendentals", "bytes_accessed", "argument_bytes",
+    "output_bytes", "temp_bytes", "generated_code_bytes", "alias_bytes",
+    "peak_bytes", "analytic_flops", "wire_bytes", "compile_s")
+
 
 def skew(values) -> Tuple[float, float]:
     """(spread, spread as % of mean) of a set of durations — THE straggler
@@ -304,6 +315,37 @@ def serve_structure_errors(segment: List[dict]) -> List[Tuple[int, str]]:
                                      f"{SERVE_BATCH_STAGE_ORDER})"))
             last = max(last, order[name])
     errors.sort(key=lambda e: e[0])
+    return errors
+
+
+def cost_record_errors(segment: List[dict]) -> List[Tuple[int, str]]:
+    """Violations of the `program_cost` point-record contract
+    (telemetry/costs.py emits these at harvest) within ONE segment, as
+    (line_no, message) pairs — shared with the file-loading checker like
+    `serve_structure_errors`. A cost record must carry a NON-EMPTY string
+    `program` (the attribution key compile times, OOM dumps, and the gate
+    all join on) and only non-negative numbers in its cost fields (a
+    negative flop/byte count is harvester garbage, not data)."""
+    errors: List[Tuple[int, str]] = []
+    for rec in segment:
+        if rec.get("kind") != "point" or rec.get("name") != COST_POINT:
+            continue
+        line = rec.get("_line", 0)
+        attrs = rec.get("attrs") or {}
+        program = attrs.get("program")
+        if not (isinstance(program, str) and program):
+            errors.append((line, f"program_cost record missing a "
+                                 f"non-empty program label (got "
+                                 f"{program!r})"))
+        for fld in COST_NUMERIC_FIELDS:
+            v = attrs.get(fld)
+            if v is None:
+                continue
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                errors.append((line, f"program_cost field {fld!r} must be "
+                                     f"a non-negative number when "
+                                     f"present; got {v!r}"))
     return errors
 
 

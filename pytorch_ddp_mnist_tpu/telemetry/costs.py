@@ -1,0 +1,799 @@
+"""Program forensics: XLA cost/memory attribution, roofline reports, and
+the compile/HBM regression gate.
+
+The observability stack attributes everything HOST-side (spans, request
+stages, data-wait); this module is the first layer that can answer where
+time and memory go BELOW the step boundary. It harvests
+`lowered.compile().cost_analysis()` / `.memory_analysis()` for every
+jitted program the repo builds — the comm x overlap DDP step/run programs
+(built through `statics.jaxpr_audit.build_step_program` /
+`build_run_program`, so forensics and the contract audits can never walk
+different programs) and the serve engine's AOT bucket ladder — into one
+per-program `CostRecord`:
+
+    {program, flops, transcendentals, bytes_accessed,
+     argument/output/temp/generated_code/alias bytes, peak_bytes,
+     analytic_flops (the exact MLP roofline model), wire_bytes
+     (parallel.collectives.bytes_on_wire), compile_s}
+
+All byte/flop figures are PER-DEVICE: XLA reports the partitioned SPMD
+module each device runs, and `bytes_on_wire` is per-device by contract, so
+the two sides of a record always talk about the same program.
+
+The read side (`trace report --cost`, cli/trace.py) combines the records
+with MEASURED step time from a DDP bench artifact
+(`attribution_from_artifact`, the arXiv:1810.11112 decomposition): per
+strategy, measured step time T splits into analytic compute C (from the
+artifact's own 1-device rate via `scaling_efficiency_vs_1dev`), wire time
+M (the artifact's isolated `collective_s_p50` probe), and overhead
+O = T - bound where bound = C + M (serial) or max(C, M) (overlapped) —
+the roofline story that explains the MULTICHIP_r07 0.09-0.17 efficiency
+numbers (docs/PERF.md). `analytic_efficiency` = C / bound is the
+efficiency the cost model predicts if only compute and wire existed;
+measured efficiency below it is overhead, not physics.
+
+`compare_cost` is the regression gate: `trace report --cost --baseline
+OLD` exits 3 when the compiled-program count GREW (a recompile storm or a
+silently widened ladder — any growth gates, refresh the baseline to
+acknowledge a deliberate one), when summary or per-program peak HBM
+regressed past the threshold, or when a strategy's analytic efficiency
+fell past it (better-is-bigger, old/new ratio — the `compare` efficiency
+convention).
+
+OOM forensics: `looks_like_oom` classifies allocation failures (the
+RESOURCE_EXHAUSTED / out-of-memory shapes, deliberately disjoint from
+`parallel.wireup.looks_like_backend_loss`'s retryable signatures), and
+`record_oom_forensics` dumps the loaded program memory table
+(`register_program` feeds it at harvest/engine warmup) plus the live
+watermarks to the flight recorder — an OOM names the program and the
+budget it blew instead of dying as an opaque XlaRuntimeError.
+
+Module import is pure stdlib (jax only inside harvest functions), by the
+analysis.py contract: the report/gate side must run wherever the JSON
+lands, including hosts without the framework installed.
+
+Front doors: `python -m pytorch_ddp_mnist_tpu trace cost` (harvest ->
+COST_r0X.json artifact + optional --telemetry trace), `trace report
+--cost [--baseline OLD]`, `make cost-smoke`. See docs/OBSERVABILITY.md
+§Program forensics.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional, Sequence
+
+# Trace point-event name for one emitted cost record; the checker
+# (scripts/check_telemetry.py via analysis.cost_record_errors) validates
+# these: non-empty string `program`, non-negative numeric cost fields.
+COST_POINT = "program_cost"
+COST_REPORT_TAG = "program_cost_report"
+
+# Default geometry: the audit matrix's (statics/jaxpr_audit.py).
+N_DEVICES = 8
+BATCH_PER_DEVICE = 16
+# Run-form (fit_cached scan body) harvest geometry, passed EXPLICITLY to
+# build_run_program so the analytic totals below always price the same
+# step count the program executes.
+RUN_EPOCHS = 1
+RUN_STEPS = 2
+# The bench default per-chip batch — the legacy-artifact fallback when a
+# strategies row predates the `per_chip_batch` stamp (bench.py rows carry
+# it since this PR).
+DEFAULT_PER_CHIP_BATCH = 128
+
+COMMS = ("pmean", "sharded", "bf16", "int8")
+
+# Substrings (lowercased match) of allocation-failure errors. Narrow by
+# the looks_like_backend_loss design rule: a retryable backend outage
+# ("unavailable", "deadline exceeded") must NOT read as an OOM, and a
+# shape/compile error must match neither.
+OOM_SIGNATURES = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "out-of-memory", "failed to allocate", "allocation failure",
+    "cannot allocate", "exceeds available memory", "hbm limit",
+)
+
+
+def _label(comm: str, overlap: bool = False, form: str = "step") -> str:
+    """`ddp.<form>.<comm>[+overlap]` — kept as a LITERAL twin of
+    `parallel.collectives.step_cost_label` so this module imports no
+    framework at load time (tests pin the two against each other)."""
+    return f"ddp.{form}.{comm}" + ("+overlap" if overlap else "")
+
+
+def looks_like_oom(e: BaseException) -> bool:
+    """Does this error look like a device allocation failure (vs a backend
+    loss or a deterministic program error)? The forensics trigger: only a
+    True here dumps the program memory table."""
+    msg = str(e).lower()
+    return any(sig in msg for sig in OOM_SIGNATURES)
+
+
+@dataclass
+class CostRecord:
+    """One jitted program's cost/memory story (see module docstring; all
+    figures per device). `compiled=False` means the deviceless fallback:
+    flops/bytes_accessed come from `lowered.cost_analysis()` (available
+    without a backend) and the memory fields are None — compile-dependent
+    analysis needs real devices."""
+    program: str
+    kind: str                    # "ddp" | "serve"
+    n_devices: int
+    compiled: bool
+    flops: Optional[float] = None
+    transcendentals: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    peak_bytes: Optional[int] = None
+    analytic_flops: Optional[int] = None
+    wire_bytes: Optional[int] = None
+    compile_s: Optional[float] = None
+    comm: Optional[str] = None
+    overlap: Optional[bool] = None
+    form: Optional[str] = None
+    model: Optional[str] = None
+    param_scale: Optional[int] = None
+    n_params: Optional[int] = None
+    batch_per_device: Optional[int] = None
+    error: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {k: v for k, v in asdict(self).items()
+                if v is not None or k in ("program", "kind", "compiled")}
+
+
+# -- the loaded-program table (what an OOM dump names) -----------------------
+
+_TABLE_LOCK = threading.Lock()
+_PROGRAM_TABLE: Dict[str, dict] = {}
+
+
+def register_program(record: "CostRecord | dict") -> None:
+    """Remember a program's memory story in the process-wide table the OOM
+    forensics dump names. Harvest registers every record; the serve engine
+    registers its bucket ladder at warmup."""
+    rec = record.to_json() if isinstance(record, CostRecord) else dict(record)
+    label = rec.get("program")
+    if not label:
+        return
+    with _TABLE_LOCK:
+        _PROGRAM_TABLE[str(label)] = rec
+
+
+def loaded_program_table() -> Dict[str, dict]:
+    with _TABLE_LOCK:
+        return {k: dict(v) for k, v in _PROGRAM_TABLE.items()}
+
+
+def record_oom_forensics(e: BaseException, program: Optional[str] = None,
+                         dump: bool = True) -> Optional[str]:
+    """If `e` classifies as an OOM, record an `oom_forensics` entry (the
+    failing program's name, the loaded program memory table, and the live
+    watermarks) in the flight recorder, dump the ring, and return the dump
+    path. Non-OOM errors return None untouched — callers re-raise either
+    way, this only annotates the post-mortem."""
+    if not looks_like_oom(e):
+        return None
+    from . import flight
+    from .runtime import MEM_GAUGES, current_compile_label
+    label = program or current_compile_label() or "<unlabeled>"
+    watermarks = {}
+    for name, fn in MEM_GAUGES:
+        try:
+            v = fn()
+        except (OSError, ValueError, RuntimeError):
+            v = None  # a dying backend's probe must not mask the OOM
+        if v is not None:
+            watermarks[name] = v
+    table = loaded_program_table()
+    programs = {
+        lbl: {k: rec.get(k) for k in ("peak_bytes", "temp_bytes",
+                                      "argument_bytes", "output_bytes")
+              if rec.get(k) is not None}
+        for lbl, rec in table.items()}
+    flight.record("oom_forensics", program=label, error=str(e)[:500],
+                  watermarks=watermarks, programs=programs)
+    if not dump:
+        return None
+    return flight.dump(reason=f"oom: {label}")
+
+
+# -- the analytic roofline model ---------------------------------------------
+
+def model_macs(dims: Sequence[int]) -> int:
+    """Forward MACs per image of an MLP with the given layer dims —
+    784*128 + 128*128 + 128*10 = 118,016 for the reference model (the
+    bench.py MACS_FWD_PER_IMG constant, generalized to the zoo)."""
+    return sum(int(a) * int(b) for a, b in zip(dims[:-1], dims[1:]))
+
+
+def analytic_step_flops(dims: Sequence[int], batch_per_device: int) -> int:
+    """Exact matmul-FLOPs lower bound of one per-device TRAIN step:
+    2 FLOPs/MAC forward, backward ~2x forward (the standard 6x rule the
+    bench roofline uses). Element-wise ops (relu, dropout, softmax) are
+    excluded — this is the roofline floor, not the XLA bill."""
+    return 6 * model_macs(dims) * int(batch_per_device)
+
+
+def analytic_forward_flops(dims: Sequence[int], batch_per_device: int) -> int:
+    """Exact matmul-FLOPs lower bound of one per-device INFERENCE pass
+    (2 FLOPs/MAC, no backward) — the serve bucket ladder's model."""
+    return 2 * model_macs(dims) * int(batch_per_device)
+
+
+# -- harvest (jax imported lazily from here on) ------------------------------
+
+def _cost_dict(ca) -> dict:
+    """Normalize `cost_analysis()`'s shape (a list of per-module dicts on
+    some jax versions, one dict on others) to the main module's dict."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def _nonneg(v) -> Optional[float]:
+    """XLA reports some fields as -1/garbage where unknown (CPU
+    `optimal_seconds` is famously negative); records carry only honest
+    non-negative values."""
+    if isinstance(v, (int, float)) and v >= 0:
+        return float(v)
+    return None
+
+
+# Failure modes a harvest must degrade through, never die of: XLA refusing
+# to compile the sharded program (XlaRuntimeError is a RuntimeError), an
+# AbstractMesh with no devices (RuntimeError/ValueError), an older jaxlib
+# without memory_analysis (AttributeError/NotImplementedError).
+_HARVEST_ERRORS = (RuntimeError, ValueError, TypeError, AttributeError,
+                   NotImplementedError, OSError)
+
+
+def _fill_memory(rec: "CostRecord", ma) -> None:
+    """Copy a `memory_analysis()` result's fields into `rec` and derive
+    `peak_bytes` — XLA's standard peak estimate: everything resident at
+    once (args + outputs + temps + code), minus donated aliases counted
+    on both sides. The ONE place the formula lives, so DDP and
+    serve-ladder records can never compute different peaks."""
+    for attr, fld in (("argument_size_in_bytes", "argument_bytes"),
+                      ("output_size_in_bytes", "output_bytes"),
+                      ("temp_size_in_bytes", "temp_bytes"),
+                      ("generated_code_size_in_bytes",
+                       "generated_code_bytes"),
+                      ("alias_size_in_bytes", "alias_bytes")):
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)) and v >= 0:
+            setattr(rec, fld, int(v))
+    parts = [rec.argument_bytes, rec.output_bytes, rec.temp_bytes,
+             rec.generated_code_bytes]
+    if any(p is not None for p in parts):
+        rec.peak_bytes = (sum(p or 0 for p in parts)
+                          - (rec.alias_bytes or 0))
+
+
+def harvest_program(program, args, *, label: str, kind: str, n_devices: int,
+                    registry=None, **meta) -> CostRecord:
+    """Lower (and where a backend exists, compile) `program(*args)` and
+    extract its cost/memory record. Compiles run under
+    `runtime.label_compiles(label)` so the jax.monitoring listener
+    attributes their durations to this program; a failed compile degrades
+    to the deviceless `lowered.cost_analysis()` with `compiled=False` and
+    the failure in `record.error`."""
+    import jax
+
+    from .runtime import label_compiles
+
+    rec = CostRecord(program=label, kind=kind, n_devices=int(n_devices),
+                     compiled=False, **meta)
+    try:
+        lowered = jax.jit(program).lower(*args)
+    except _HARVEST_ERRORS as e:
+        rec.error = f"lower: {e}"[:300]
+        register_program(rec)
+        return rec
+    try:
+        t0 = time.perf_counter()
+        with label_compiles(label):
+            compiled = lowered.compile()
+        rec.compile_s = round(time.perf_counter() - t0, 6)
+        rec.compiled = True
+        ca = _cost_dict(compiled.cost_analysis())
+        ma = compiled.memory_analysis()
+    except _HARVEST_ERRORS as e:
+        # deviceless (AbstractMesh) or refused compile: the pre-compile
+        # analysis still prices the program's math
+        rec.error = f"compile: {e}"[:300]
+        try:
+            ca = _cost_dict(lowered.cost_analysis())
+        except _HARVEST_ERRORS as e2:
+            rec.error += f"; cost_analysis: {e2}"[:200]
+            ca = {}
+        ma = None
+    rec.flops = _nonneg(ca.get("flops"))
+    rec.transcendentals = _nonneg(ca.get("transcendentals"))
+    rec.bytes_accessed = _nonneg(ca.get("bytes accessed"))
+    if ma is not None:
+        _fill_memory(rec, ma)
+    register_program(rec)
+    return rec
+
+
+def _resolve_mesh(n_dev: int):
+    """A real n_dev mesh when the backend has the devices (compile +
+    memory_analysis work), else None (the builders fall back to their
+    deviceless AbstractMesh — cost-only records)."""
+    import jax
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return None  # no backend at all: deviceless harvest
+    if len(devices) < n_dev:
+        return None
+    from ..parallel.mesh import DATA_AXIS, make_mesh
+    return make_mesh([n_dev], [DATA_AXIS], devices[:n_dev])
+
+
+def harvest_step_matrix(*, comms: Sequence[str] = COMMS,
+                        overlaps: Sequence[bool] = (False, True),
+                        forms: Sequence[str] = ("step",),
+                        n_dev: int = N_DEVICES,
+                        batch: int = BATCH_PER_DEVICE,
+                        model: str = "mlp", param_scale: int = 1,
+                        mesh="auto") -> List[CostRecord]:
+    """Cost records for the comm x overlap DDP program matrix, built
+    through the statics program builders (the audit's exact programs).
+    `batch` is PER-DEVICE rows, matching the builders."""
+    import jax
+
+    from ..models.zoo import resolve_model
+    from ..models import param_count
+    from ..parallel import collectives
+    from ..statics import jaxpr_audit
+
+    spec = resolve_model(model, param_scale)
+    params = spec.init(jax.random.PRNGKey(0))
+    n_params = param_count(params)
+    if mesh == "auto":
+        mesh = _resolve_mesh(n_dev)
+    out: List[CostRecord] = []
+    for comm in comms:
+        wire = collectives.bytes_on_wire(params, n_dev, comm)
+        for overlap in overlaps:
+            for form in forms:
+                if form == "step":
+                    prog, args = jaxpr_audit.build_step_program(
+                        comm, overlap, n_dev=n_dev, batch=batch,
+                        mesh=mesh, model=model, param_scale=param_scale)
+                    n_steps = 1
+                else:
+                    # the scan body executes RUN_EPOCHS x RUN_STEPS train
+                    # steps: the record's analytic/wire totals must price
+                    # the whole program, not one step of it
+                    prog, args = jaxpr_audit.build_run_program(
+                        comm, overlap, n_dev=n_dev, batch=batch,
+                        epochs=RUN_EPOCHS, steps=RUN_STEPS,
+                        mesh=mesh, model=model, param_scale=param_scale)
+                    n_steps = RUN_EPOCHS * RUN_STEPS
+                out.append(harvest_program(
+                    prog, args, label=_label(comm, overlap, form),
+                    kind="ddp", n_devices=n_dev, comm=comm,
+                    overlap=overlap, form=form, model=model,
+                    param_scale=param_scale, n_params=n_params,
+                    batch_per_device=batch, wire_bytes=wire * n_steps,
+                    analytic_flops=(analytic_step_flops(spec.dims, batch)
+                                    * n_steps)))
+    return out
+
+
+def register_compiled(label: str, compiled, *, kind: str, n_devices: int,
+                      **meta) -> CostRecord:
+    """A record from an ALREADY-compiled executable (the serve engine's
+    warm bucket ladder: its compiles already happened under their own
+    labels, so only the analyses run here)."""
+    rec = CostRecord(program=label, kind=kind, n_devices=int(n_devices),
+                     compiled=True, **meta)
+    try:
+        ca = _cost_dict(compiled.cost_analysis())
+        rec.flops = _nonneg(ca.get("flops"))
+        rec.transcendentals = _nonneg(ca.get("transcendentals"))
+        rec.bytes_accessed = _nonneg(ca.get("bytes accessed"))
+    except _HARVEST_ERRORS as e:
+        rec.error = f"cost_analysis: {e}"[:300]
+    try:
+        _fill_memory(rec, compiled.memory_analysis())
+    except _HARVEST_ERRORS as e:
+        rec.error = ((rec.error or "")
+                     + f" memory_analysis: {e}"[:200]).strip()
+    register_program(rec)
+    return rec
+
+
+def harvest_engine(engine) -> List[CostRecord]:
+    """Cost records for a serve `InferenceEngine`'s AOT bucket ladder —
+    one per compiled bucket, `serve.bucket<N>` labels, forward-pass
+    analytic floor."""
+    from ..models.mlp import MLP_DIMS
+    n_dev = 1 if engine.mesh is None else int(engine.mesh.devices.size)
+    out = []
+    for bucket, compiled in sorted(engine.compiled_programs().items()):
+        out.append(register_compiled(
+            f"serve.bucket{bucket}", compiled, kind="serve",
+            n_devices=n_dev, batch_per_device=bucket // n_dev,
+            wire_bytes=0,
+            analytic_flops=analytic_forward_flops(MLP_DIMS,
+                                                  bucket // n_dev)))
+    return out
+
+
+def emit_records(tracer, records: Sequence[CostRecord]) -> None:
+    """One `program_cost` point event per record into the JSONL trace —
+    the shape `analysis.cost_record_errors` / check_telemetry validate."""
+    for rec in records:
+        tracer.point(COST_POINT, **rec.to_json())
+
+
+# -- the attribution / roofline decomposition (pure stdlib) ------------------
+
+def attribution_from_artifact(artifact: dict,
+                              per_chip_batch: Optional[int] = None) -> List[dict]:
+    """The measured-vs-analytic decomposition, one row per strategies
+    entry of a DDP bench artifact (MULTICHIP_r0X.json / `bench.py --mode
+    ddp` lines): measured per-device step time T splits into
+
+      compute_s  C = scaling_efficiency_vs_1dev * T  (the 1-device step
+                 time of the same per-chip batch, by the efficiency
+                 definition — no extra measurement needed),
+      comm_s     M = collective_s_p50 (the isolated wire probe), and
+      overhead_s O = T - bound,  bound = C + M serial, max(C, M)
+                 overlapped (comm analytically hidden behind compute).
+
+    Shares divide by T and sum to 1. `analytic_efficiency` = C / bound:
+    what efficiency WOULD be if the step were only compute + wire;
+    measured efficiency under it is dispatch/launch overhead, the
+    arXiv:1810.11112 residual. `per_chip_batch` overrides rows that
+    predate the stamp (legacy artifacts default to 128, the bench
+    default; MULTICHIP_r07 was measured at 4 — pass it)."""
+    rows = []
+    for r in artifact.get("strategies") or []:
+        if not isinstance(r, dict):
+            continue
+        n = r.get("n_devices", artifact.get("n_devices"))
+        rate = r.get("images_per_sec")
+        eff = r.get("scaling_efficiency_vs_1dev")
+        m = r.get("collective_s_p50")
+        b = per_chip_batch or r.get("per_chip_batch") \
+            or DEFAULT_PER_CHIP_BATCH
+        if not all(isinstance(v, (int, float)) and v > 0
+                   for v in (n, rate, eff, b)) or n <= 1 \
+                or not isinstance(m, (int, float)) or m < 0:
+            continue
+        t = float(b) * float(n) / float(rate)      # measured step seconds
+        c = float(eff) * t                          # analytic compute
+        overlap = bool(r.get("overlap"))
+        bound = max(c, float(m)) if overlap else c + float(m)
+        o = t - bound
+        rows.append({
+            "program": _label(str(r.get("strategy", "?")), overlap),
+            "strategy": r.get("strategy"),
+            "overlap": overlap,
+            "n_devices": int(n),
+            "per_chip_batch": int(b),
+            "measured_step_s": round(t, 6),
+            "compute_s": round(c, 6),
+            "comm_s": round(float(m), 6),
+            "bound_s": round(bound, 6),
+            "overhead_s": round(o, 6),
+            "shares": {
+                "compute": round(c / t, 4),
+                # the wire time the step actually EXPOSES: all of M when
+                # serial, only the part compute can't hide when overlapped
+                "comm_exposed": round(max(0.0, bound - c) / t, 4),
+                "overhead": round(o / t, 4),
+            },
+            "measured_efficiency": round(float(eff), 4),
+            "analytic_efficiency": round(c / bound, 4),
+        })
+    return rows
+
+
+def build_cost_report(records: Sequence[CostRecord], *,
+                      artifact: Optional[dict] = None,
+                      per_chip_batch: Optional[int] = None,
+                      meta: Optional[dict] = None) -> dict:
+    """The COST_r0X.json shape: per-program records, compile attribution,
+    the roofline attribution rows (when a bench artifact is supplied),
+    and the summary the gate and the bench stamp read:
+    {peak_hbm_bytes, analytic_efficiency, compile_s_total,
+    compile_count}."""
+    recs = [r.to_json() if isinstance(r, CostRecord) else dict(r)
+            for r in records]
+    peaks = [r["peak_bytes"] for r in recs
+             if isinstance(r.get("peak_bytes"), (int, float))]
+    compile_times = [r["compile_s"] for r in recs
+                     if isinstance(r.get("compile_s"), (int, float))]
+    attribution = (attribution_from_artifact(artifact, per_chip_batch)
+                   if artifact else [])
+    try:
+        from .runtime import compile_attribution
+        compile_attr = compile_attribution()
+    except ImportError:
+        compile_attr = {}
+    report = {
+        "report": COST_REPORT_TAG,
+        "v": 1,
+        "generated_unix": round(time.time(), 3),
+        "records": recs,
+        "attribution": attribution,
+        "compile_attribution": compile_attr,
+        "summary": {
+            "programs": len(recs),
+            "compile_count": sum(1 for r in recs if r.get("compiled")),
+            "compile_s_total": round(sum(compile_times), 6),
+            "peak_hbm_bytes": max(peaks) if peaks else None,
+            "analytic_efficiency": {
+                row["program"]: row["analytic_efficiency"]
+                for row in attribution},
+        },
+    }
+    if meta:
+        report.update(meta)
+    return report
+
+
+# -- the gate ----------------------------------------------------------------
+
+def compare_cost(new: dict, baseline: dict, threshold: float = 1.5) -> dict:
+    """Diff two cost reports -> {"rows": [...], "regressions": [...]},
+    the `compare`/`compare_data` shape. Three gated axes:
+
+      * compile_count — ANY growth regresses (program counts are
+        structural, not noisy: more compiles means a recompile storm or a
+        silently widened ladder; a deliberate growth is acknowledged by
+        refreshing the baseline);
+      * peak HBM — summary peak and per-program peak_bytes for labels in
+        both reports, new/old ratio past `threshold`;
+      * analytic_efficiency — per program label in both, old/new ratio
+        past `threshold` (better-is-bigger, the efficiency-gate
+        convention).
+    """
+    rows, regressions = [], []
+
+    def add(metric, program, old_v, new_v, ratio, regressed):
+        row = {"metric": metric, "program": program, "baseline": old_v,
+               "new": new_v, "ratio": ratio, "regressed": bool(regressed)}
+        rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+
+    ns, bs = new.get("summary") or {}, baseline.get("summary") or {}
+    oc, nc = bs.get("compile_count"), ns.get("compile_count")
+    if isinstance(oc, int) and isinstance(nc, int):
+        add("compile_count", "<total>", oc, nc,
+            (nc / oc) if oc else float("inf") if nc else 1.0, nc > oc)
+    op, np_ = bs.get("peak_hbm_bytes"), ns.get("peak_hbm_bytes")
+    if isinstance(op, (int, float)) and isinstance(np_, (int, float)) \
+            and op > 0:
+        add("peak_hbm_bytes", "<max>", op, np_, np_ / op,
+            np_ / op > threshold)
+    old_recs = {r.get("program"): r for r in baseline.get("records") or []
+                if isinstance(r, dict)}
+    for r in new.get("records") or []:
+        if not isinstance(r, dict):
+            continue
+        o = old_recs.get(r.get("program"))
+        if not o:
+            continue
+        ob, nb = o.get("peak_bytes"), r.get("peak_bytes")
+        if isinstance(ob, (int, float)) and isinstance(nb, (int, float)) \
+                and ob > 0:
+            add("peak_bytes", r["program"], ob, nb, nb / ob,
+                nb / ob > threshold)
+    oe = (bs.get("analytic_efficiency") or {})
+    ne = (ns.get("analytic_efficiency") or {})
+    for label in sorted(set(oe) & set(ne)):
+        ov, nv = oe[label], ne[label]
+        if not (isinstance(ov, (int, float)) and isinstance(nv, (int, float))
+                and ov > 0):
+            continue
+        ratio = (ov / nv) if nv > 0 else float("inf")
+        add("analytic_efficiency", label, ov, nv, ratio, ratio > threshold)
+    return {"threshold": threshold, "rows": rows,
+            "regressions": regressions}
+
+
+# -- rendering ---------------------------------------------------------------
+
+def format_cost_report(report: dict) -> str:
+    lines = []
+    s = report.get("summary") or {}
+    lines.append(f"program cost report: {s.get('programs', 0)} program(s), "
+                 f"{s.get('compile_count', 0)} compiled, "
+                 f"compile_s_total {s.get('compile_s_total', 0.0):.3f}s, "
+                 f"peak HBM "
+                 f"{s.get('peak_hbm_bytes') if s.get('peak_hbm_bytes') is not None else 'n/a'}")
+    recs = report.get("records") or []
+    if recs:
+        lines.append(f"{'program':<24} {'flops':>14} {'bytes_acc':>12} "
+                     f"{'peak_bytes':>12} {'wire_bytes':>12} {'compile_s':>10}")
+        for r in recs:
+            def fmt(v, nd=0):
+                return (f"{v:,.{nd}f}" if isinstance(v, (int, float))
+                        else "-")
+            lines.append(f"{str(r.get('program', '?')):<24} "
+                         f"{fmt(r.get('flops')):>14} "
+                         f"{fmt(r.get('bytes_accessed')):>12} "
+                         f"{fmt(r.get('peak_bytes')):>12} "
+                         f"{fmt(r.get('wire_bytes')):>12} "
+                         f"{r.get('compile_s') if r.get('compile_s') is not None else '-':>10}")
+    att = report.get("attribution") or []
+    if att:
+        lines.append("")
+        lines.append(f"measured-step attribution "
+                     f"(T = compute + exposed comm + overhead):")
+        lines.append(f"{'program':<24} {'step_s':>9} {'compute':>8} "
+                     f"{'comm_exp':>9} {'overhead':>9} {'eff meas':>9} "
+                     f"{'eff bound':>9}")
+        for row in att:
+            sh = row["shares"]
+            lines.append(f"{row['program']:<24} "
+                         f"{row['measured_step_s']:>9.4f} "
+                         f"{100 * sh['compute']:>7.1f}% "
+                         f"{100 * sh['comm_exposed']:>8.1f}% "
+                         f"{100 * sh['overhead']:>8.1f}% "
+                         f"{row['measured_efficiency']:>9.4f} "
+                         f"{row['analytic_efficiency']:>9.4f}")
+    elif not recs:
+        lines.append("no cost records and no attribution rows (harvest "
+                     "with `trace cost`, or pass a DDP bench artifact)")
+    return "\n".join(lines)
+
+
+def format_compare_cost(diff: dict) -> str:
+    lines = [f"cost gate (compile-count growth; peak-HBM / "
+             f"analytic-efficiency ratio > {diff['threshold']:g}x):"]
+    for row in diff["rows"]:
+        verdict = "REGRESSION" if row["regressed"] else "ok"
+        lines.append(f"  {row['metric']:<20} {row['program']:<24} "
+                     f"{row['baseline']} -> {row['new']}  "
+                     f"({row['ratio']:.2f}x)  {verdict}")
+    if not diff["rows"]:
+        lines.append("  (no cost metric overlaps baseline — nothing gated)")
+    n = len(diff["regressions"])
+    lines.append(f"regression gate: "
+                 f"{f'FAIL — {n} metric(s) regressed' if n else 'PASS'}")
+    return "\n".join(lines)
+
+
+# -- report loading (shared with cli/trace.py) -------------------------------
+
+def load_cost_report(target: str, per_chip_batch: Optional[int] = None):
+    """(report, error) from `target`: a saved cost report (its
+    COST_REPORT_TAG, plain or under the combined --baseline shape
+    {"report": {...}}), or a DDP bench artifact with strategies rows
+    (attribution-only report, framework-free). Anything else errors."""
+    try:
+        with open(target) as f:
+            head = json.load(f)
+    except OSError as e:
+        return None, f"{target}: {e}"
+    except ValueError as e:
+        return None, f"{target}: not a JSON document ({e})"
+    if not isinstance(head, dict):
+        return None, f"{target}: not a JSON object"
+    if head.get("report") == COST_REPORT_TAG:
+        return head, None
+    nested = head.get("report")
+    if isinstance(nested, dict) and nested.get("report") == COST_REPORT_TAG:
+        return nested, None
+    if isinstance(head.get("strategies"), list):
+        att = attribution_from_artifact(head, per_chip_batch)
+        if not att:
+            return None, (f"{target}: artifact carries no strategy rows "
+                          f"the attribution can decompose (needs "
+                          f"images_per_sec, scaling_efficiency_vs_1dev, "
+                          f"collective_s_p50, n_devices > 1)")
+        return build_cost_report(
+            [], artifact=head, per_chip_batch=per_chip_batch,
+            meta={"source": target}), None
+    return None, (f"{target}: neither a {COST_REPORT_TAG} document nor a "
+                  f"DDP bench artifact with strategies rows")
+
+
+# -- the harvest front door (`trace cost`, cli/trace.py) ---------------------
+
+def harvest_cli(a) -> int:
+    """The `trace cost` subcommand body (argparse namespace from
+    cli/trace.py): harvest the DDP matrix (+ the serve ladder), emit the
+    records (JSONL trace when --telemetry, JSON artifact via -o), print
+    the human report."""
+    import os
+    import sys
+
+    from . import enable, disable, get_registry, get_tracer
+    from . import flight
+    from .runtime import (collect_memory, install_compile_listener,
+                          install_memory_watermarks, record_memory_point)
+
+    # the measured artifact is read FIRST: a mistyped --artifact path must
+    # fail in milliseconds, not after minutes of compile harvest
+    artifact = None
+    if a.artifact:
+        try:
+            with open(a.artifact) as f:
+                artifact = json.load(f)
+        except OSError as e:
+            print(f"trace cost: --artifact {e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            print(f"trace cost: --artifact {a.artifact}: not a JSON "
+                  f"document ({e})", file=sys.stderr)
+            return 1
+        if not isinstance(artifact, dict) \
+                or not isinstance(artifact.get("strategies"), list):
+            print(f"trace cost: --artifact {a.artifact}: not a DDP bench "
+                  f"artifact (no strategies rows)", file=sys.stderr)
+            return 1
+
+    reg = get_registry()
+    install_compile_listener()
+    install_memory_watermarks(reg)
+    if a.telemetry:
+        os.makedirs(a.telemetry, exist_ok=True)
+        flight.set_dump_dir(a.telemetry)
+        enable(a.telemetry, process_index=0)
+    tracer = get_tracer()
+    try:
+        with tracer.span("cost_harvest", model=a.model,
+                         param_scale=a.param_scale):
+            forms = (("step", "run") if a.form == "both" else (a.form,))
+            records = harvest_step_matrix(
+                forms=forms, n_dev=a.n_devices, batch=a.batch,
+                model=a.model, param_scale=a.param_scale)
+            if a.serve_ladder:
+                import jax
+                from ..models.mlp import init_mlp
+                from ..serve.engine import InferenceEngine
+                engine = InferenceEngine(init_mlp(jax.random.key(0)),
+                                         max_batch=a.serve_max_batch)
+                records.extend(harvest_engine(engine))
+            emit_records(tracer, records)
+            record_memory_point(tracer)
+        import jax
+        meta = {
+            "backend": jax.default_backend(),
+            "jax_version": jax.__version__,
+            "n_devices": a.n_devices,
+            "model": a.model,
+            "param_scale": a.param_scale,
+            "batch_per_device": a.batch,
+        }
+        if a.artifact:
+            meta["measured_artifact"] = a.artifact
+        report = build_cost_report(records, artifact=artifact,
+                                   per_chip_batch=a.per_chip_batch,
+                                   meta=meta)
+        collect_memory(reg)
+        tracer.snapshot(reg)
+    finally:
+        if a.telemetry:
+            disable()
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"trace cost: wrote {len(report['records'])} record(s) to "
+              f"{a.out}")
+    print(format_cost_report(report))
+    failed = [r for r in report["records"] if r.get("error")]
+    if failed:
+        print(f"trace cost: note: {len(failed)} record(s) degraded "
+              f"(uncompiled/partial) — deviceless fallback, see their "
+              f"'error' fields", file=sys.stderr)
+    return 0

@@ -164,7 +164,24 @@ def chrome_trace(paths: List[str]) -> dict:
                 events.append({"ph": "f", "bp": "e", "ts": bts,
                                "pid": bpid, "tid": _TID_BATCHES, **flow})
         elif kind == "point":
-            events.append({"ph": "i", "name": rec.get("name", "point"),
+            name = rec.get("name", "point")
+            if name == "mem_watermark":
+                # HBM/RSS watermark samples (telemetry/runtime.py
+                # record_memory_point, emitted per epoch by the train
+                # loop): render each numeric field as its own counter
+                # track beside the registry counters, so Perfetto shows
+                # the memory envelope under the epoch spans instead of an
+                # instant blip
+                for metric, value in sorted(
+                        (rec.get("attrs") or {}).items()):
+                    if isinstance(value, (int, float)) \
+                            and not isinstance(value, bool):
+                        events.append({"ph": "C", "name": metric,
+                                       "cat": "mem", "ts": ts, "pid": pid,
+                                       "tid": _TID_SPANS,
+                                       "args": {"value": value}})
+                continue
+            events.append({"ph": "i", "name": name,
                            "cat": "point", "ts": ts, "pid": pid,
                            "tid": _TID_SPANS, "s": "t",
                            "args": rec.get("attrs") or {}})

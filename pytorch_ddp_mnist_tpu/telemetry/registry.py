@@ -130,6 +130,10 @@ class Histogram:
             "n": self.n,
             "mean": self.mean,
             "max": self.max,
+            # the exact recorded sum (not a bucket estimate): the whole
+            # bill a rate-style reader wants — e.g. xla.compile_s total is
+            # the process's compile-time spend, the bench/cost stamp field
+            "total": self.total,
             "p50": self.percentile(0.50),
             "p95": self.percentile(0.95),
             "p99": self.percentile(0.99),

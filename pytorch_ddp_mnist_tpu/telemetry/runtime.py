@@ -44,18 +44,30 @@ def process_index_cached() -> int:
     return _process_index
 
 
-# -- XLA compile counting ----------------------------------------------------
+# -- XLA compile counting + duration attribution -----------------------------
 
 _compile_counter = None  # the one counter the process listener feeds
+_compile_hist = None     # its sibling xla.compile_s duration histogram
+# label -> {"count": int, "total_s": float}: per-program compile attribution,
+# fed by the listener whenever a `label_compiles(...)` block is active on the
+# compiling thread (jax compiles synchronously on the calling thread, so the
+# thread-local label set around a `.compile()`/first-call is the program
+# being compiled). Guarded by _LOCK like the other module singletons.
+_compile_attr: dict = {}
+_compile_label = threading.local()
 
 
 def install_compile_listener(registry=None,
-                             counter_name: str = "xla.compiles") -> bool:
-    """Count backend compiles into `registry.counter(counter_name)` via
+                             counter_name: str = "xla.compiles",
+                             hist_name: str = "xla.compile_s") -> bool:
+    """Count backend compiles into `registry.counter(counter_name)` AND
+    record each compile's duration into `registry.histogram(hist_name)` via
     `jax.monitoring`'s duration events (one
     `/jax/core/compile/backend_compile_duration` event per XLA compile —
     jit cache hits fire nothing, so the counter reads true compile work,
-    the cold-compile signal serve/'s bucket ladder exists to eliminate).
+    the cold-compile signal serve/'s bucket ladder exists to eliminate;
+    the histogram's total is the process's whole compile-time bill, the
+    `compile_s_total` bench/cost stamp).
 
     Returns True when the listener feeds the REQUESTED counter.
     jax.monitoring listeners cannot be unregistered individually, so
@@ -65,7 +77,7 @@ def install_compile_listener(registry=None,
     caller keeps the engine-probe pattern (`record_engine_compiles`) as
     the portable source. False likewise where jax.monitoring is
     unavailable."""
-    global _compile_counter
+    global _compile_counter, _compile_hist
     from .registry import get_registry
     reg = registry or get_registry()
     with _LOCK:
@@ -78,14 +90,61 @@ def install_compile_listener(registry=None,
         except ImportError:
             return False  # no counter created: the stamp reads absent, not 0
         counter = reg.counter(counter_name)
+        hist = reg.histogram(hist_name)
 
         def _on_duration(key: str, duration: float, **kw) -> None:
             if "backend_compile" in key:
                 counter.inc()
+                hist.record(float(duration))
+                label = getattr(_compile_label, "value", None)
+                if label:
+                    with _LOCK:
+                        slot = _compile_attr.setdefault(
+                            label, {"count": 0, "total_s": 0.0})
+                        slot["count"] += 1
+                        slot["total_s"] += float(duration)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
         _compile_counter = counter
+        _compile_hist = hist
         return True
+
+
+class label_compiles:
+    """Context manager naming the program whose compiles are about to run:
+    every backend-compile duration the jax.monitoring listener sees while
+    the block is active on THIS thread is attributed to `label` in
+    `compile_attribution()` (the telemetry/costs.py per-program
+    compile-time table). Nestable (inner label wins, outer restored);
+    costs nothing when the listener is not armed."""
+
+    def __init__(self, label: str):
+        self.label = str(label)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_compile_label, "value", None)
+        _compile_label.value = self.label
+        return self
+
+    def __exit__(self, *exc):
+        _compile_label.value = self._prev
+        return None
+
+
+def current_compile_label() -> Optional[str]:
+    """The innermost `label_compiles` label active on this thread (None
+    outside any block) — the OOM classifier names it in its forensics."""
+    return getattr(_compile_label, "value", None)
+
+
+def compile_attribution() -> dict:
+    """{label: {"count": n, "total_s": s}} of every labeled compile the
+    listener has seen — the per-program compile-time story
+    (docs/OBSERVABILITY.md §Program forensics). Unlabeled compiles are in
+    the xla.compiles/xla.compile_s registry metrics only."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _compile_attr.items()}
 
 
 def record_engine_compiles(registry, compile_count: int,
@@ -93,7 +152,9 @@ def record_engine_compiles(registry, compile_count: int,
     """The compile-cache probe fallback: adopt an engine's own
     `compile_count` (serve/engine.py's structural no-cold-compile
     instrument) into the registry, portable to builds without
-    jax.monitoring."""
+    jax.monitoring. Counting-only by construction — the probe is an
+    integer the engine kept, so no durations exist to feed
+    `xla.compile_s` here (the listener path owns those)."""
     registry.counter(counter_name).set_total(compile_count)
 
 
@@ -132,12 +193,49 @@ def host_rss_bytes() -> Optional[int]:
         return None  # no resource module / no uname: no RSS source
 
 
+def _device_mem_field(key: str) -> Optional[int]:
+    """One field of the first device's memory_stats, None when the backend
+    has no memory picture (CPU) — the live-gauge provider body."""
+    stats = device_memory_stats()
+    if stats and key in stats:
+        return int(stats[key])
+    return None
+
+
+# The HBM watermark gauge names (docs/OBSERVABILITY.md §Program forensics):
+# live set_fn gauges, so every Prometheus scrape and registry snapshot reads
+# the INSTANT — a None value (CPU, dead backend) renders as absent in
+# Prometheus and null in snapshots, the memory_stats degrade contract.
+MEM_GAUGES = (
+    ("mem.device_in_use_bytes", lambda: _device_mem_field("bytes_in_use")),
+    ("mem.device_peak_bytes",
+     lambda: _device_mem_field("peak_bytes_in_use")),
+    ("mem.host_rss_bytes", host_rss_bytes),
+)
+
+
+def install_memory_watermarks(registry=None) -> None:
+    """Bind the `mem.*` watermark gauges as LIVE providers on `registry`:
+    `mem.device_in_use_bytes` / `mem.device_peak_bytes` (guarded like the
+    memory_stats probe — None off-accelerator) and `mem.host_rss_bytes`
+    (always a number where /proc or getrusage exists). Idempotent —
+    re-installing rebinds the same providers."""
+    from .registry import get_registry
+    reg = registry or get_registry()
+    for name, fn in MEM_GAUGES:
+        reg.gauge(name).set_fn(fn)
+
+
 def collect_memory(registry=None) -> dict:
     """Stamp the current memory picture into registry gauges and return it:
     `host.rss_bytes` always, `device.bytes_in_use` / `device.peak_bytes_in_use`
-    when the backend reports them."""
+    when the backend reports them. Also installs the live `mem.*` watermark
+    gauges (install_memory_watermarks) so any snapshot taken after one
+    collect carries the watermark names — the `--require mem.` gate's
+    contract."""
     from .registry import get_registry
     reg = registry or get_registry()
+    install_memory_watermarks(reg)
     out = {}
     rss = host_rss_bytes()
     if rss is not None:
@@ -150,3 +248,21 @@ def collect_memory(registry=None) -> dict:
                 reg.gauge(f"device.{key}").set(int(stats[key]))
                 out[f"device.{key}"] = int(stats[key])
     return out
+
+
+def record_memory_point(tracer, name: str = "mem_watermark") -> None:
+    """Emit one `mem_watermark` point event carrying the current watermark
+    values (device in-use/peak when the backend reports them, host RSS
+    always) — the train loop calls this once per epoch so Perfetto renders
+    an HBM counter track under the epoch spans (telemetry/export.py). Pure
+    host-side probes: no device sync, no fetch (the loop's zero-sync
+    contract holds); a NullTracer costs one attribute check."""
+    if not getattr(tracer, "enabled", False):
+        return
+    attrs = {}
+    for key, fn in MEM_GAUGES:
+        v = fn()
+        if v is not None:
+            attrs[key] = v
+    if attrs:
+        tracer.point(name, **attrs)

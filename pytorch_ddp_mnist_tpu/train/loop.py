@@ -40,6 +40,7 @@ from ..pipeline import feed as pipeline_feed
 from ..utils.logging import progress
 from ..utils.profiling import CumulativeTimer
 from ..telemetry.events import get_tracer
+from ..telemetry.runtime import record_memory_point
 
 
 @dataclass
@@ -568,6 +569,11 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                            batch_size,
                            perm=eval_perm(epoch) if eval_perm else None)
             tracer.complete_span("eval", time.perf_counter() - t_eval)
+            # one HBM/RSS watermark sample per epoch, under the epoch
+            # span — Perfetto renders it as a memory counter track
+            # (telemetry/export.py). Host-side probes only: no device
+            # sync, no fetch; a NullTracer costs one attribute check.
+            record_memory_point(tracer)
             if ddp_record is not None:
                 ddp_record(len(losses), params)
             dt = time.perf_counter() - t0

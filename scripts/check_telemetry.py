@@ -28,9 +28,14 @@ structural checks are the span-tree reconstructor shared with
 `pytorch_ddp_mnist_tpu/telemetry/analysis.py` (file-loaded, not
 package-imported, so no framework import happens); when the analysis
 module is not beside this script (a copied-alone checker), they degrade to
-the orphaned-parent check with a stderr note. Pure stdlib, no jax import:
-the checker must run anywhere the trace lands, including hosts without the
-framework installed.
+the orphaned-parent check with a stderr note. `program_cost` point records
+(the `trace cost` harvest, telemetry/costs.py) get their own shared
+contract: a non-empty string `program` label and non-negative byte/flop
+fields — `--require xla.` / `--require mem.` gate the compile metrics and
+HBM watermark gauges being present (the cost-smoke pattern), with the same
+named degrade when analysis.py predates `cost_record_errors`. Pure stdlib,
+no jax import: the checker must run anywhere the trace lands, including
+hosts without the framework installed.
 """
 
 from __future__ import annotations
@@ -93,19 +98,24 @@ def _fallback_structure_errors(segment):
     return errors
 
 
-_degrade_noted: "set[str]" = set()   # print-once latch (single-threaded CLI)
+_degrade_noted: "set[str]" = set()   # print-once latches (per skipped check)
 
 
-def _note_degraded(why: str) -> None:
-    """One stderr line naming exactly which checks were skipped — a
-    checker copied beside an older/missing analysis.py must say it
-    degraded, or a partial copy masquerades as a full pass."""
-    if _degrade_noted:
+def _note_degraded(why: str, skipped: str) -> None:
+    """One stderr line per degraded check, naming exactly what was
+    skipped — a checker copied beside an older/missing analysis.py must
+    say it degraded, or a partial copy masquerades as a full pass."""
+    if skipped in _degrade_noted:
         return
-    _degrade_noted.add(why)
-    print(f"check_telemetry: note: {why}; skipping the serve span "
-          f"contract (serve.request request_id, batch links resolving, "
-          f"pipeline-ordered batch stages)", file=sys.stderr)
+    _degrade_noted.add(skipped)
+    print(f"check_telemetry: note: {why}; skipping {skipped}",
+          file=sys.stderr)
+
+
+_SERVE_SKIP = ("the serve span contract (serve.request request_id, batch "
+               "links resolving, pipeline-ordered batch stages)")
+_COST_SKIP = ("the program_cost record contract (non-empty program label, "
+              "non-negative byte/flop fields)")
 
 
 def span_structure_errors(segment):
@@ -115,15 +125,25 @@ def span_structure_errors(segment):
         # non-empty request_id, batch links resolving to a real
         # serve.batch span, pipeline-ordered batch stages. hasattr-guarded
         # so this checker still runs beside an older analysis.py — but
-        # NOT silently: the degradation is named once on stderr.
+        # NOT silently: each degradation is named once on stderr.
         if hasattr(_analysis, "serve_structure_errors"):
             errors.extend(_analysis.serve_structure_errors(segment))
-            errors.sort(key=lambda e: e[0])
         else:
-            _note_degraded("analysis.py predates serve_structure_errors")
+            _note_degraded("analysis.py predates serve_structure_errors",
+                           _SERVE_SKIP)
+        # the program-cost record contract (telemetry/costs.py harvest
+        # points) — same file-load sharing, same named degrade
+        if hasattr(_analysis, "cost_record_errors"):
+            errors.extend(_analysis.cost_record_errors(segment))
+        else:
+            _note_degraded("analysis.py predates cost_record_errors",
+                           _COST_SKIP)
+        errors.sort(key=lambda e: e[0])
         return errors
     _note_degraded("analysis.py not found beside this script (span "
-                   "structure degrades to orphaned-parent detection)")
+                   "structure degrades to orphaned-parent detection)",
+                   _SERVE_SKIP)
+    _note_degraded("analysis.py not found beside this script", _COST_SKIP)
     return _fallback_structure_errors(segment)
 
 
@@ -198,6 +218,12 @@ def check_file(path: str, errors: list) -> int:
                         errors.append(f"{where}: unknown health severity "
                                       f"{attrs['severity']!r}; known: "
                                       f"{HEALTH_SEVERITIES}")
+            if rec["kind"] == "point" and rec["name"] == "program_cost":
+                # cost records ride the segment so the shared validator
+                # (analysis.cost_record_errors) sees them; the span-tree
+                # checks skip non-span kinds by construction
+                rec["_line"] = line_no
+                segment.append(rec)
             if rec["kind"] == "span":
                 for k in ("span", "dur_s"):
                     if k not in rec:

@@ -156,6 +156,12 @@ def test_ddp_mode_contract_8_fake_devices():
         assert 0 < r["scaling_efficiency_vs_1dev"] < 2
         assert r["bytes_on_wire_per_step_per_device"] > 0
         assert r["collective_s_p50"] > 0
+        # the roofline stamp (telemetry/costs.py): predicted efficiency
+        # were the step only compute + wire, and the batch the row was
+        # measured at (the attribution reader's input)
+        assert 0 < r["analytic_efficiency"] <= 1
+        assert r["per_chip_batch"] == 16
+        assert "peak_hbm_bytes" in r and "compile_s_total" in r
     assert by["pmean"]["parity_max_abs_diff_vs_pmean"] == 0.0
     assert by["sharded"]["parity_max_rel_diff_vs_pmean"] < 1e-6
     # the compressed wire is half the f32 wire, exactly

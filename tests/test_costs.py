@@ -1,0 +1,644 @@
+"""Program forensics (telemetry/costs.py): the analytic cost model pinned
+against the hand-computed 118,272-param MLP, the harvest/record machinery,
+the OOM classifier + flight-dump path, the measured-vs-analytic roofline
+attribution, and the compile/HBM regression gate behind
+`trace report --cost`."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_ddp_mnist_tpu import telemetry
+from pytorch_ddp_mnist_tpu.telemetry import analysis, costs, flight
+from pytorch_ddp_mnist_tpu.telemetry.runtime import (
+    compile_attribution, install_compile_listener, label_compiles)
+from pytorch_ddp_mnist_tpu.cli import trace as trace_cli
+from pytorch_ddp_mnist_tpu.models.mlp import MLP_DIMS, init_mlp
+from pytorch_ddp_mnist_tpu.parallel import collectives
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the analytic model: exact, hand-computed for the reference MLP
+# ---------------------------------------------------------------------------
+
+def test_analytic_model_pinned_to_hand_computed_mlp():
+    # 784*128 + 128*128 + 128*10 forward MACs/image — the bench.py
+    # roofline constant, recomputed from the dims
+    assert costs.model_macs(MLP_DIMS) == 118_016
+    import bench
+    assert costs.model_macs(MLP_DIMS) == bench.MACS_FWD_PER_IMG
+    # train step: 6 FLOPs/MAC (fwd 2, bwd ~4), exact per-device batch
+    assert costs.analytic_step_flops(MLP_DIMS, 16) == 6 * 118_016 * 16
+    # inference: 2 FLOPs/MAC, the serve ladder's floor
+    assert costs.analytic_forward_flops(MLP_DIMS, 8) == 1_888_256
+    # the scaled r07 geometry: dims follow the zoo's width rule
+    from pytorch_ddp_mnist_tpu.models.zoo import resolve_model
+    dims16 = resolve_model("mlp", 16).dims
+    assert costs.model_macs(dims16) == 784 * 2048 + 2048 * 2048 + 2048 * 10
+
+
+def test_cost_labels_cannot_drift_from_parallel():
+    """costs.py keeps a framework-free literal twin of
+    collectives.step_cost_label; this is the no-drift pin."""
+    for comm in collectives.STRATEGIES:
+        for overlap in (False, True):
+            for form in ("step", "run"):
+                assert (costs._label(comm, overlap, form)
+                        == collectives.step_cost_label(comm, overlap, form))
+
+
+def test_dp_step_carries_cost_label():
+    from pytorch_ddp_mnist_tpu.compat import abstract_mesh
+    from pytorch_ddp_mnist_tpu.parallel.ddp import make_dp_train_step
+    step = make_dp_train_step(abstract_mesh((8,), ("dp",)), 0.01,
+                              comm="bf16", overlap=True)
+    assert step.cost_label == "ddp.step.bf16+overlap"
+
+
+def test_checker_field_catalogs_cannot_drift():
+    """analysis.py's literal catalog (the file-loading checker's) must
+    cover exactly the numeric fields a CostRecord can carry."""
+    numeric = {"flops", "transcendentals", "bytes_accessed",
+               "argument_bytes", "output_bytes", "temp_bytes",
+               "generated_code_bytes", "alias_bytes", "peak_bytes",
+               "analytic_flops", "wire_bytes", "compile_s"}
+    assert set(analysis.COST_NUMERIC_FIELDS) == numeric
+    assert analysis.COST_POINT == costs.COST_POINT
+
+
+# ---------------------------------------------------------------------------
+# harvest
+# ---------------------------------------------------------------------------
+
+def test_harvest_program_compiled_record():
+    def f(x):
+        return (x * 2.0 + 1.0).sum()
+
+    rec = costs.harvest_program(f, (np.ones((4, 8), np.float32),),
+                                label="test.tiny", kind="ddp", n_devices=1,
+                                analytic_flops=32)
+    assert rec.compiled is True and rec.error is None
+    assert rec.flops is not None and rec.flops >= 0
+    assert rec.compile_s is not None and rec.compile_s >= 0
+    # the peak estimate sums the resident parts minus donated aliases
+    parts = sum(p or 0 for p in (rec.argument_bytes, rec.output_bytes,
+                                 rec.temp_bytes, rec.generated_code_bytes))
+    assert rec.peak_bytes == parts - (rec.alias_bytes or 0)
+    # harvest registers into the OOM-forensics program table
+    assert costs.loaded_program_table()["test.tiny"]["compiled"] is True
+
+
+def test_harvest_step_matrix_deviceless_fallback():
+    """Forced mesh=None (no real mesh, the builders' AbstractMesh path):
+    compile is impossible, but `lowered.cost_analysis()` still prices the
+    math — records degrade to compiled=False with the error named, never
+    raise."""
+    recs = costs.harvest_step_matrix(comms=("pmean",), overlaps=(False,),
+                                     n_dev=8, batch=4, mesh=None)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec.program == "ddp.step.pmean" and rec.compiled is False
+    assert rec.error and "compile" in rec.error
+    assert rec.flops is not None and rec.flops > 0      # deviceless analysis
+    assert rec.peak_bytes is None                       # needs a compile
+    assert rec.wire_bytes == collectives.bytes_on_wire(
+        init_mlp(jax.random.PRNGKey(0)), 8, "pmean")
+    assert rec.analytic_flops == costs.analytic_step_flops(MLP_DIMS, 4)
+
+
+def test_harvest_step_matrix_compiled_on_fake_mesh():
+    """The acceptance geometry: on the suite's 8 fake CPU devices the
+    harvest compiles the real sharded program and fills the memory
+    table."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh (conftest)")
+    recs = costs.harvest_step_matrix(comms=("sharded",), overlaps=(False,),
+                                     n_dev=8, batch=4)
+    rec = recs[0]
+    assert rec.program == "ddp.step.sharded" and rec.compiled is True
+    assert rec.flops and rec.flops > 0
+    assert rec.peak_bytes and rec.peak_bytes > 0
+    assert rec.compile_s and rec.compile_s > 0
+    # per-device analytic floor under the XLA bill for the per-device
+    # partition (8 local rows of the 32-row global batch)
+    assert rec.analytic_flops == costs.analytic_step_flops(MLP_DIMS, 4)
+    assert costs.loaded_program_table()["ddp.step.sharded"]["compiled"]
+
+
+def test_harvest_run_form_prices_all_steps():
+    """A run-form record covers the scan body's RUN_EPOCHS x RUN_STEPS
+    train steps: its analytic/wire totals must be the per-step figures
+    times the step count, not one step's."""
+    recs = costs.harvest_step_matrix(comms=("pmean",), overlaps=(False,),
+                                     forms=("step", "run"), n_dev=8,
+                                     batch=4, mesh=None)
+    by_form = {r.form: r for r in recs}
+    n_steps = costs.RUN_EPOCHS * costs.RUN_STEPS
+    assert by_form["run"].analytic_flops \
+        == by_form["step"].analytic_flops * n_steps
+    assert by_form["run"].wire_bytes \
+        == by_form["step"].wire_bytes * n_steps
+    assert by_form["run"].program == "ddp.run.pmean"
+
+
+def test_bench_overlap_copy_rows_carry_overlap_bound():
+    """The byte-identical sharded/int8 overlap rows copy the MEASUREMENT
+    but must stamp the overlap-form analytic bound (max(C, M)), so the
+    artifact row and `trace report --cost`'s attribution of the same row
+    can never disagree."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh (conftest)")
+    import bench
+    rows = bench.ddp_strategy_rows(per_chip_batch=4, epochs=2, n_rows=64,
+                                   strategies=("sharded",),
+                                   parity_steps=1,
+                                   overlap_variants=(False, True))
+    by = {r["overlap"]: r for r in rows}
+    assert by[True]["images_per_sec"] == by[False]["images_per_sec"]  # copy
+    # recompute both bounds from the row's own fields
+    t1 = 4 / ((by[False]["per_chip_images_per_sec"] / 1)
+              / by[False]["scaling_efficiency_vs_1dev"])  # C = b/one_dev_rate
+    m = by[False]["collective_s_p50"]
+    assert by[False]["analytic_efficiency"] == pytest.approx(
+        t1 / (t1 + m), abs=2e-4)
+    assert by[True]["analytic_efficiency"] == pytest.approx(
+        t1 / max(t1, m), abs=2e-4)
+
+
+def test_harvest_engine_ladder_and_accessor():
+    from pytorch_ddp_mnist_tpu.serve.engine import InferenceEngine
+    eng = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=8)
+    assert sorted(eng.compiled_programs()) == [1, 2, 4, 8]
+    recs = costs.harvest_engine(eng)
+    assert [r.program for r in recs] == [
+        "serve.bucket1", "serve.bucket2", "serve.bucket4", "serve.bucket8"]
+    for r in recs:
+        assert r.compiled and r.kind == "serve" and r.wire_bytes == 0
+        assert r.analytic_flops == costs.analytic_forward_flops(
+            MLP_DIMS, int(r.program.replace("serve.bucket", "")))
+        # XLA's bill is at least the matmul floor
+        if r.flops is not None:
+            assert r.flops >= r.analytic_flops
+    # engine warmup already registered the ladder (constructor path)
+    assert "serve.bucket8" in costs.loaded_program_table()
+
+
+def test_compile_listener_records_durations_and_labels():
+    """Satellite: the monitoring listener no longer drops the durations —
+    xla.compile_s fills alongside xla.compiles, and a label_compiles block
+    attributes them per program."""
+    if not install_compile_listener():
+        pytest.skip("jax.monitoring unavailable")
+    hist = telemetry.get_registry().histogram("xla.compile_s")
+    before_n = hist.n
+    with label_compiles("test.labeled_compile"):
+        jax.jit(lambda x: x * 5 + 2)(np.ones((3, 11, 5), np.float32))
+    assert hist.n > before_n
+    assert hist.total > 0
+    attr = compile_attribution()
+    assert attr["test.labeled_compile"]["count"] >= 1
+    assert attr["test.labeled_compile"]["total_s"] > 0
+
+
+def test_label_compiles_nests_and_restores():
+    from pytorch_ddp_mnist_tpu.telemetry.runtime import current_compile_label
+    assert current_compile_label() is None
+    with label_compiles("outer"):
+        assert current_compile_label() == "outer"
+        with label_compiles("inner"):
+            assert current_compile_label() == "inner"
+        assert current_compile_label() == "outer"
+    assert current_compile_label() is None
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def test_looks_like_oom_matrix():
+    oom = [
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                     "1073741824 bytes."),
+        RuntimeError("Resource exhausted: failed to allocate request for "
+                     "2.5GiB"),
+        ValueError("allocation failure on device 0"),
+    ]
+    not_oom = [
+        RuntimeError("UNAVAILABLE: socket closed"),           # backend loss
+        RuntimeError("DEADLINE_EXCEEDED: collective timeout"),
+        RuntimeError("Incompatible shapes for dot: (3, 4) vs (5, 6)"),
+        ValueError("start_offset=9 must be >= 0"),
+    ]
+    for e in oom:
+        assert costs.looks_like_oom(e), e
+    for e in not_oom:
+        assert not costs.looks_like_oom(e), e
+    # disjoint from the retry classifier: an OOM must never read as a
+    # retryable outage, and vice versa
+    from pytorch_ddp_mnist_tpu.parallel.wireup import looks_like_backend_loss
+    for e in oom:
+        assert not looks_like_backend_loss(e), e
+
+
+def test_record_oom_forensics_dumps_program_and_watermarks(tmp_path):
+    rec = flight.get_flight_recorder()
+    costs.register_program({"program": "test.oomer", "peak_bytes": 12345,
+                            "temp_bytes": 100})
+    before = rec.recorded
+    old_dir = rec.dump_dir
+    try:
+        rec.dump_dir = str(tmp_path)
+        e = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                         "99999 bytes")
+        path = costs.record_oom_forensics(e, program="test.oomer")
+        assert path is not None and os.path.exists(path)
+        entries = [x for x in rec.snapshot()
+                   if x["kind"] == "oom_forensics" and x["seq"] >= before]
+        assert len(entries) == 1
+        entry = entries[0]
+        assert entry["program"] == "test.oomer"
+        assert entry["programs"]["test.oomer"]["peak_bytes"] == 12345
+        # host RSS watermark exists everywhere; device ones only where
+        # the backend reports memory_stats (guarded probe)
+        assert entry["watermarks"].get("mem.host_rss_bytes", 0) > 0
+        dumped = json.load(open(path))
+        assert dumped["reason"] == "oom: test.oomer"
+    finally:
+        rec.dump_dir = old_dir
+
+
+def test_record_oom_forensics_ignores_non_oom():
+    rec = flight.get_flight_recorder()
+    before = rec.recorded
+    assert costs.record_oom_forensics(
+        RuntimeError("Incompatible shapes"), program="x") is None
+    assert not [x for x in rec.snapshot()
+                if x["kind"] == "oom_forensics" and x["seq"] >= before]
+
+
+def test_engine_run_bucket_oom_names_program(tmp_path):
+    from pytorch_ddp_mnist_tpu.serve.engine import InferenceEngine
+    eng = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=4)
+    rec = flight.get_flight_recorder()
+    old_dir, rec.dump_dir = rec.dump_dir, str(tmp_path)
+    try:
+        def boom(params, x):
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory "
+                               "allocating 7 bytes")
+        eng._compiled[4] = boom
+        before = rec.recorded
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            eng.forward(np.zeros((3, 784), np.float32))
+        entries = [x for x in rec.snapshot()
+                   if x["kind"] == "oom_forensics" and x["seq"] >= before]
+        assert len(entries) == 1 and entries[0]["program"] == "serve.bucket4"
+
+        def shape_err(params, x):
+            raise RuntimeError("Incompatible shapes for dot")
+        eng._compiled[4] = shape_err
+        before = rec.recorded
+        with pytest.raises(RuntimeError, match="Incompatible"):
+            eng.forward(np.zeros((3, 784), np.float32))
+        assert not [x for x in rec.snapshot()
+                    if x["kind"] == "oom_forensics" and x["seq"] >= before]
+    finally:
+        rec.dump_dir = old_dir
+
+
+# ---------------------------------------------------------------------------
+# attribution: the measured-vs-analytic roofline decomposition
+# ---------------------------------------------------------------------------
+
+def _artifact(rows):
+    return {"n_devices": 8, "strategies": rows}
+
+
+def _row(**kw):
+    base = {"strategy": "pmean", "overlap": False, "n_devices": 8,
+            "images_per_sec": 80.0, "scaling_efficiency_vs_1dev": 0.10,
+            "collective_s_p50": 0.08}
+    base.update(kw)
+    return base
+
+
+def test_attribution_decomposition_math():
+    rows = costs.attribution_from_artifact(
+        _artifact([_row()]), per_chip_batch=4)
+    assert len(rows) == 1
+    r = rows[0]
+    t = 4 * 8 / 80.0                          # measured step seconds
+    assert r["measured_step_s"] == pytest.approx(t)
+    assert r["compute_s"] == pytest.approx(0.10 * t)
+    assert r["comm_s"] == pytest.approx(0.08)
+    assert r["bound_s"] == pytest.approx(0.10 * t + 0.08)   # serial: C + M
+    sh = r["shares"]
+    assert sh["compute"] + sh["comm_exposed"] + sh["overhead"] \
+        == pytest.approx(1.0, abs=1e-3)
+    assert sh["compute"] == pytest.approx(0.10, abs=1e-3)   # == efficiency
+    assert r["analytic_efficiency"] == pytest.approx(
+        r["compute_s"] / r["bound_s"], abs=1e-3)
+
+
+def test_attribution_overlap_bound_is_max():
+    r = costs.attribution_from_artifact(
+        _artifact([_row(overlap=True)]), per_chip_batch=4)[0]
+    assert r["bound_s"] == pytest.approx(max(r["compute_s"], r["comm_s"]))
+    assert r["program"] == "ddp.step.pmean+overlap"
+
+
+def test_attribution_prefers_row_stamp_over_default():
+    r = costs.attribution_from_artifact(
+        _artifact([_row(per_chip_batch=4)]))[0]
+    assert r["per_chip_batch"] == 4
+    # legacy row (no stamp, no override) falls back to the bench default
+    r = costs.attribution_from_artifact(_artifact([_row()]))[0]
+    assert r["per_chip_batch"] == costs.DEFAULT_PER_CHIP_BATCH
+
+
+def test_attribution_skips_undecomposable_rows():
+    rows = costs.attribution_from_artifact(_artifact([
+        _row(images_per_sec=0.0),                 # dead strategy
+        _row(n_devices=1),                        # nothing on the wire
+        _row(collective_s_p50=None),              # legacy probe-less row
+        "not a dict",
+    ]))
+    assert rows == []
+
+
+def test_committed_r07_artifact_decomposes_all_strategies():
+    """The acceptance pin: the real MULTICHIP_r07.json decomposes into
+    compute/comm/overhead for all 4 strategies on the 8-fake-device
+    mesh."""
+    report, err = costs.load_cost_report(
+        os.path.join(REPO, "MULTICHIP_r07.json"), per_chip_batch=4)
+    assert err is None
+    att = report["attribution"]
+    assert {r["strategy"] for r in att} == set(costs.COMMS)
+    assert len(att) == 8                          # x overlap variants
+    for r in att:
+        sh = r["shares"]
+        assert sh["compute"] + sh["comm_exposed"] + sh["overhead"] \
+            == pytest.approx(1.0, abs=2e-3)
+        assert 0 < r["analytic_efficiency"] <= 1
+        assert r["measured_efficiency"] <= r["analytic_efficiency"]
+
+
+def test_committed_cost_r01_stamps_required_fields():
+    d = json.load(open(os.path.join(REPO, "COST_r01.json")))
+    assert d["report"] == costs.COST_REPORT_TAG
+    s = d["summary"]
+    assert isinstance(s["peak_hbm_bytes"], int) and s["peak_hbm_bytes"] > 0
+    assert s["compile_s_total"] > 0
+    assert set(s["analytic_efficiency"]) == {
+        costs._label(c, o) for c in costs.COMMS for o in (False, True)}
+    assert d["param_scale"] == 16 and d["n_devices"] == 8  # r07 geometry
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+def _mini_report(compile_count=3, peak=1000, eff=0.3):
+    recs = [{"program": "ddp.step.pmean", "kind": "ddp", "compiled": True,
+             "peak_bytes": peak, "compile_s": 0.1}]
+    return {"report": costs.COST_REPORT_TAG, "v": 1, "records": recs,
+            "attribution": [], "summary": {
+                "programs": 1, "compile_count": compile_count,
+                "compile_s_total": 0.1, "peak_hbm_bytes": peak,
+                "analytic_efficiency": {"ddp.step.pmean": eff}}}
+
+
+def test_compare_cost_self_is_clean():
+    r = _mini_report()
+    diff = costs.compare_cost(r, r)
+    assert diff["rows"] and not diff["regressions"]
+
+
+def test_compare_cost_gates_compile_count_growth():
+    # ANY growth regresses (structural, not noisy)
+    diff = costs.compare_cost(_mini_report(compile_count=4), _mini_report())
+    assert [r["metric"] for r in diff["regressions"]] == ["compile_count"]
+    # shrinking is fine
+    diff = costs.compare_cost(_mini_report(compile_count=2), _mini_report())
+    assert not diff["regressions"]
+
+
+def test_compare_cost_gates_peak_hbm():
+    diff = costs.compare_cost(_mini_report(peak=2500), _mini_report())
+    assert {r["metric"] for r in diff["regressions"]} == {"peak_hbm_bytes",
+                                                          "peak_bytes"}
+    # under threshold: no fire
+    diff = costs.compare_cost(_mini_report(peak=1400), _mini_report())
+    assert not diff["regressions"]
+
+
+def test_compare_cost_gates_analytic_efficiency():
+    diff = costs.compare_cost(_mini_report(eff=0.1), _mini_report())
+    assert [r["metric"] for r in diff["regressions"]] \
+        == ["analytic_efficiency"]
+
+
+def test_trace_report_cost_cli_gate_exit_codes(tmp_path, capsys):
+    new = tmp_path / "new.json"
+    old = tmp_path / "old.json"
+    new.write_text(json.dumps(_mini_report(compile_count=5)))
+    old.write_text(json.dumps(_mini_report()))
+    # self-baseline: clean pass
+    assert trace_cli.main(["report", "--cost", str(old),
+                           "--baseline", str(old)]) == 0
+    # injected compile-count regression: the exit-3 acceptance
+    assert trace_cli.main(["report", "--cost", str(new),
+                           "--baseline", str(old)]) == 3
+    capsys.readouterr()
+    # peak-HBM regression alone also exits 3
+    bumped = tmp_path / "peak.json"
+    bumped.write_text(json.dumps(_mini_report(peak=5000)))
+    assert trace_cli.main(["report", "--cost", str(bumped),
+                           "--baseline", str(old)]) == 3
+    # plain report (no baseline) renders and exits 0
+    assert trace_cli.main(["report", "--cost", str(old)]) == 0
+    # unreadable target: exit 1
+    assert trace_cli.main(["report", "--cost",
+                           str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()
+
+
+def test_trace_report_cost_rejects_flag_combos(capsys):
+    with pytest.raises(SystemExit):
+        trace_cli.main(["report", "--cost", "--serve", "x"])
+    with pytest.raises(SystemExit):
+        trace_cli.main(["report", "--cost", "--data", "x"])
+    with pytest.raises(SystemExit):                 # --batch is --cost-only
+        trace_cli.main(["report", "--batch", "4", "x"])
+    capsys.readouterr()
+
+
+def test_load_cost_report_shapes(tmp_path):
+    # non-JSON
+    p = tmp_path / "x.json"
+    p.write_text("not json")
+    rep, err = costs.load_cost_report(str(p))
+    assert rep is None and "not a JSON document" in err
+    # JSON but neither shape
+    p.write_text(json.dumps({"hello": 1}))
+    rep, err = costs.load_cost_report(str(p))
+    assert rep is None and "neither" in err
+    # combined --baseline shape unwraps
+    p.write_text(json.dumps({"report": _mini_report(), "comparison": {}}))
+    rep, err = costs.load_cost_report(str(p))
+    assert err is None and rep["summary"]["compile_count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# cost records in the JSONL trace + the checker contract
+# ---------------------------------------------------------------------------
+
+def test_cost_record_errors_matrix():
+    def pt(attrs):
+        return {"v": 1, "kind": "point", "name": "program_cost",
+                "t_wall": 1.0, "t_mono": 1.0, "proc": 0, "_line": 7,
+                "attrs": attrs}
+
+    good = pt({"program": "ddp.step.pmean", "flops": 1.0, "peak_bytes": 5})
+    assert analysis.cost_record_errors([good]) == []
+    errs = analysis.cost_record_errors([pt({"program": ""})])
+    assert errs and "non-empty program" in errs[0][1]
+    errs = analysis.cost_record_errors(
+        [pt({"program": "x", "wire_bytes": -1})])
+    assert errs and "non-negative" in errs[0][1]
+    errs = analysis.cost_record_errors(
+        [pt({"program": "x", "flops": True})])   # bool is not a count
+    assert errs
+    # non-cost points are not this contract's business
+    other = {"v": 1, "kind": "point", "name": "health", "t_wall": 1.0,
+             "t_mono": 1.0, "proc": 0, "attrs": {"detector": ""}}
+    assert analysis.cost_record_errors([other]) == []
+
+
+def test_emit_records_round_trips_through_trace(tmp_path):
+    tr = telemetry.EventTrace(str(tmp_path / "events.jsonl"),
+                              process_index=0)
+    rec = costs.CostRecord(program="test.rt", kind="ddp", n_devices=8,
+                           compiled=False, flops=12.0, wire_bytes=99)
+    costs.emit_records(tr, [rec])
+    tr.close()
+    lines = [json.loads(ln) for ln in
+             open(tmp_path / "events.jsonl").read().splitlines()]
+    pts = [r for r in lines if r.get("name") == "program_cost"]
+    assert len(pts) == 1
+    a = pts[0]["attrs"]
+    assert a["program"] == "test.rt" and a["wire_bytes"] == 99
+    assert "peak_bytes" not in a                  # None fields stay absent
+
+
+def test_checker_names_skipped_cost_checks_when_degraded(
+        tmp_path, capsys, monkeypatch):
+    """A checker copied beside an analysis.py that predates
+    cost_record_errors must say so, once — the serve-contract degrade
+    rule, extended to the cost contract."""
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_for_costs",
+        pathlib.Path(__file__).resolve().parents[1] / "scripts"
+        / "check_telemetry.py")
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+
+    class _OldAnalysis:                     # pre-cost-contract surface
+        @staticmethod
+        def span_structure_errors(segment):
+            return []
+
+        @staticmethod
+        def serve_structure_errors(segment):
+            return []
+
+    rec = {"v": 1, "kind": "point", "name": "program_cost", "t_wall": 1.0,
+           "t_mono": 1.0, "proc": 0, "attrs": {"program": ""}}
+    path = tmp_path / "events.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    monkeypatch.setattr(checker, "_analysis", _OldAnalysis)
+    monkeypatch.setattr(checker, "_degrade_noted", set())
+    assert checker.main([str(path)]) == 0   # still a pass (check skipped)...
+    err = capsys.readouterr().err
+    assert err.count("skipping the program_cost record contract") == 1
+    assert "non-negative byte/flop" in err  # ...naming WHAT was skipped
+    # with the real analysis.py beside it, the same record FAILS
+    monkeypatch.setattr(checker, "_analysis", analysis)
+    monkeypatch.setattr(checker, "_degrade_noted", set())
+    assert checker.main([str(path)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# memory watermarks: gauges, per-epoch points, Perfetto counter track
+# ---------------------------------------------------------------------------
+
+def test_collect_memory_installs_mem_namespace():
+    reg = telemetry.MetricsRegistry()
+    telemetry.collect_memory(reg)
+    gauges = reg.snapshot()["gauges"]
+    # the watermark names are ALWAYS present (the --require mem. gate);
+    # device values None off-accelerator, host RSS a number where /proc
+    # exists
+    for name in ("mem.device_in_use_bytes", "mem.device_peak_bytes",
+                 "mem.host_rss_bytes"):
+        assert name in gauges
+    if telemetry.host_rss_bytes() is not None:
+        assert gauges["mem.host_rss_bytes"] > 0
+
+
+def test_record_memory_point_emits_under_enabled_tracer(tmp_path):
+    tr = telemetry.EventTrace(str(tmp_path / "events.jsonl"),
+                              process_index=0)
+    telemetry.record_memory_point(tr)
+    tr.close()
+    recs = [json.loads(ln) for ln in
+            open(tmp_path / "events.jsonl").read().splitlines()]
+    pts = [r for r in recs if r.get("name") == "mem_watermark"]
+    if telemetry.host_rss_bytes() is None:
+        pytest.skip("no RSS source on this platform")
+    assert len(pts) == 1
+    assert pts[0]["attrs"]["mem.host_rss_bytes"] > 0
+    # NullTracer: no-op, no record, no probe
+    telemetry.record_memory_point(telemetry.NullTracer())
+
+
+def test_export_renders_mem_watermark_as_counter_track(tmp_path):
+    path = tmp_path / "events.jsonl"
+    recs = [
+        {"v": 1, "kind": "meta", "name": "trace_start", "t_wall": 1.0,
+         "t_mono": 0.0, "proc": 0},
+        {"v": 1, "kind": "point", "name": "mem_watermark", "t_wall": 1.5,
+         "t_mono": 0.5, "proc": 0,
+         "attrs": {"mem.device_in_use_bytes": 4096,
+                   "mem.host_rss_bytes": 1 << 20}},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    trace = telemetry.chrome_trace([str(path)])
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert {e["name"] for e in counters} == {"mem.device_in_use_bytes",
+                                             "mem.host_rss_bytes"}
+    assert all(e["cat"] == "mem" for e in counters)
+    # no instant-event duplicate of the watermark sample
+    assert not [e for e in trace["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "mem_watermark"]
+
+
+def test_registry_stamp_carries_forensics_fields(monkeypatch):
+    monkeypatch.setenv("PDMT_STATICS_STAMP", "0")   # keep the stamp cheap
+    import bench
+    reg = telemetry.MetricsRegistry()
+    stamp = bench.registry_stamp(reg)
+    assert "peak_hbm_bytes" in stamp            # None off-accelerator
+    assert stamp["compile_s_total"] is None     # no compile_s hist yet
+    reg.histogram("xla.compile_s").record(0.25)
+    reg.histogram("xla.compile_s").record(0.5)
+    assert bench.registry_stamp(reg)["compile_s_total"] == 0.75

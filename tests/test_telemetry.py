@@ -93,7 +93,8 @@ def test_histogram_percentiles_pessimistic_and_clamped():
     assert h.percentile(0.50) == pytest.approx(0.002, rel=0.25)
     assert h.percentile(0.99) == pytest.approx(0.100, rel=1e-6)  # clamp
     snap = h.snapshot()
-    assert set(snap) == {"n", "mean", "max", "p50", "p95", "p99"}
+    assert set(snap) == {"n", "mean", "max", "total", "p50", "p95", "p99"}
+    assert snap["total"] == pytest.approx(0.108)    # exact sum, not bucketed
     assert snap["n"] == 4 and snap["max"] == 0.100
 
 
