@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -43,6 +43,24 @@ serve-trace-smoke:
 		d = json.load(open('/tmp/pdmt_serve_trace/trace.chrome.json')); \
 		assert any(e.get('ph') == 's' for e in d['traceEvents']), \
 		'no request->batch flow arrows in chrome trace'"
+
+# Fast-path smoke (docs/SERVING.md §Fast path): a loadgen burst through
+# the staged fast path (persistent staging + off-loop reply) with
+# request tracing on, then the serve.* registry surface is checked, and
+# the run is gated against ITSELF through the stage-share regression
+# gate (`trace report --serve --baseline`) — proving the gate's full
+# plumbing fires on every `make check` (a run never regresses against
+# itself; a broken gate or a missing stage exits nonzero here).
+serve-fast-smoke:
+	rm -rf /tmp/pdmt_serve_fast
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu serve \
+		--selftest 400 --offered_rps 3000 --max_batch 32 \
+		--telemetry /tmp/pdmt_serve_fast
+	$(PY) scripts/check_telemetry.py --require serve. /tmp/pdmt_serve_fast
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --serve --json \
+		/tmp/pdmt_serve_fast > /tmp/pdmt_serve_fast/self.json
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --serve \
+		/tmp/pdmt_serve_fast --baseline /tmp/pdmt_serve_fast/self.json
 
 # Observability smoke: 1 CPU epoch with --telemetry, then schema-validate
 # the emitted JSONL trace (nonzero exit on malformed/unordered records).
@@ -171,7 +189,7 @@ cost-smoke:
 # runtime sanitizers on the live paths (incl. the input pipeline), then
 # the serve request-tracing round trip (also seconds), then the program
 # cost/memory harvest round trip, then the fast test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke cost-smoke test-fast
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -391,12 +391,18 @@ def _serve_bench(a) -> None:
         engine.predict(np.zeros((b, 784), np.float32))
     telemetry.record_engine_compiles(reg, engine.compile_count)
     service = ServeService(engine, max_delay_ms=a.max_delay_ms,
-                           max_depth=a.queue_depth, registry=reg)
+                           max_depth=a.queue_depth, registry=reg,
+                           fast=a.serve_fast)
     out = run_loadgen(service, offered_rps=a.offered_rps,
                       n_requests=a.requests, seed=0)
     lat = out["latency_ms"]
     rps = out["achieved_rps"]
     counters = reg.snapshot()["counters"]
+    # the per-stage tail story rides the artifact: p50/p99 + each stage's
+    # share of the telescoped per-request time, under the serve/tracing.py
+    # stage names — the before/after evidence SERVE_r01.json commits
+    # (docs/SERVING.md §Fast path)
+    stages = service.metrics.attribution()["stages"]
     print(json.dumps({
         "metric": "mnist_serve_requests_per_sec",
         "value": rps,
@@ -418,6 +424,13 @@ def _serve_bench(a) -> None:
         # structural no-cold-compile evidence: the bucket ladder's warmup
         # compiles are the ONLY compiles the engine can ever perform
         "compile_count": counters["serve.engine_compiles"],
+        # which flush path served (the --no_fast A/B knob), whether the
+        # staging pool ever grew past its double buffer (0 in steady
+        # state — the zero-allocation-per-flush pin's observable), and
+        # the per-stage attribution under the tracing stage names
+        "fast_path": service.batcher.fast_path,
+        "staging_grown": getattr(engine, "staging_grown", None),
+        "stage_attribution": stages,
         **registry_stamp(),  # global registry: xla.compiles + memory
     }))
 
@@ -996,6 +1009,12 @@ def main(argv=None) -> None:
     p.add_argument("--queue_depth", type=int, default=256,
                    help="serve mode: admission budget (requests beyond it "
                         "are rejected with retry-after)")
+    p.add_argument("--no_fast", dest="serve_fast", action="store_false",
+                   help="serve mode: force the LEGACY stack-at-flush path "
+                        "instead of the staged fast path (persistent "
+                        "staging + off-loop reply) — the A/B knob the "
+                        "SERVE_r01 before/after artifact rides "
+                        "(docs/SERVING.md §Fast path)")
     from pytorch_ddp_mnist_tpu.parallel.wireup import backend_wait_env
     p.add_argument("--backend_wait", type=float,
                    default=backend_wait_env(3600.0),
@@ -1024,9 +1043,10 @@ def main(argv=None) -> None:
         # serve-mode knobs rejected by name elsewhere (same mislabeled-
         # measurement rule as the train knobs below)
         for dest in ("offered_rps", "requests", "max_batch",
-                     "max_delay_ms", "queue_depth"):
+                     "max_delay_ms", "queue_depth", "serve_fast"):
             if getattr(a, dest) != p.get_default(dest):
-                p.error(f"--{dest} {getattr(a, dest)} is a serve-mode "
+                flag = "no_fast" if dest == "serve_fast" else dest
+                p.error(f"--{flag} is a serve-mode "
                         f"knob; --mode {a.mode} never reads it")
     if a.mode != "input":
         # input-mode knobs rejected by name elsewhere (the same

@@ -201,6 +201,11 @@ def main(argv=None) -> int:
                    help="replicate over every device of the data-parallel "
                         "mesh (each batch's rows shard across chips); "
                         "default single-device")
+    p.add_argument("--no_fast", dest="fast", action="store_false",
+                   help="force the legacy stack-at-flush batcher instead "
+                        "of the staged fast path (persistent staging "
+                        "buffers + off-loop reply scatter) — an A/B and "
+                        "escape hatch (docs/SERVING.md §Fast path)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0,
                    help="TCP port (0 = ephemeral; the bound port prints "
@@ -244,10 +249,11 @@ def main(argv=None) -> int:
         engine, max_delay_ms=a.max_delay_ms, max_depth=a.queue_depth,
         registry=reg, admit_mode=a.admit,
         slo_p99_s=(a.slo_p99_ms / 1e3 if a.admit == "predicted_p99"
-                   else None))
+                   else None), fast=a.fast)
     print(f"engine warm: buckets={list(engine.buckets)} "
           f"compiles={engine.compile_count} "
-          f"input_dtype={engine.input_dtype} admit={a.admit}",
+          f"input_dtype={engine.input_dtype} admit={a.admit} "
+          f"fast={'on' if service.batcher.fast_path else 'off'}",
           file=sys.stderr, flush=True)
 
     def _close_telemetry(reason: str, dump: bool = True) -> None:

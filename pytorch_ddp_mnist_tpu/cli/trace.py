@@ -7,6 +7,9 @@ JSONL event traces training and serving emit.
         --baseline old_run/ --threshold 1.5      # exit 3 past threshold
     python -m pytorch_ddp_mnist_tpu trace report --serve /tmp/serve_obs
                                                  # serve-path attribution
+    python -m pytorch_ddp_mnist_tpu trace report --serve /tmp/serve_obs \
+        --baseline OLD       # stage-share gate: exit 3 when compute's
+                             # share of e2e drops past --threshold
     python -m pytorch_ddp_mnist_tpu trace report --data /tmp/obs \
         [--baseline OLD]            # input attribution + data-share gate
     python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
@@ -32,7 +35,12 @@ attribution: per-stage p50/p95/p99 and each stage's share of end-to-end
 time (admission / queue / batch_form / pad_h2d / compute / reply — they
 telescope, so the shares genuinely decompose the e2e story), batch
 occupancy / padding waste / coalesce-reason counts, and the slowest-K
-requests as full stage trees.
+requests as full stage trees. With `--baseline OLD` (a trace dir/file or
+a saved `--serve --json` report) it becomes the stage-SHARE regression
+gate: exit 3 when compute's share of e2e drops — or an overhead stage's
+share grows — past `--threshold`, sub-millisecond stages exempt. This is
+how the fast-path wins are pinned (`make serve-fast-smoke`,
+docs/SERVING.md §Fast path).
 
 `report` merges every per-process `events*.jsonl` under the target (a
 --telemetry dir, a single file, or several), reconstructs the span tree,
@@ -115,11 +123,14 @@ def _load_report(target: str):
     return report, None
 
 
-def _load_data_report(target: str):
-    """A data report from `target`: a saved `--data --json` file
-    (recognized by its "trace_data_stats" tag, plain or under the
-    combined --baseline shape) or a trace dir/file. Returns
-    (report, error_message) — mirrors `_load_report`."""
+def _load_tagged_report(target: str, tag: str, build, is_empty,
+                        empty_msg: str):
+    """A report from `target`: a saved `--json` file recognized by its
+    `tag` (plain, or nested under the combined --baseline shape
+    `{"report": {...}, "comparison": ...}`), or a trace dir/file run
+    through `build(paths)`. Returns (report, error_message) — the one
+    loader the --data and --serve report/gate paths share, so a format
+    tweak cannot silently diverge between them."""
     import os
 
     from ..telemetry import analysis
@@ -129,23 +140,40 @@ def _load_data_report(target: str):
             with open(target) as f:
                 head = json.load(f)
         except ValueError:
-            head = None
+            head = None  # not one JSON document: treat as a JSONL trace
         if isinstance(head, dict):
-            if head.get("report") == "trace_data_stats":
+            if head.get("report") == tag:
                 return head, None
             nested = head.get("report")
-            if isinstance(nested, dict) \
-                    and nested.get("report") == "trace_data_stats":
+            if isinstance(nested, dict) and nested.get("report") == tag:
                 return nested, None
     paths = analysis.trace_files(target)
     if not paths:
         return None, f"{target}: no events*.jsonl found"
-    report = analysis.data_report(paths)
-    if report["epochs"] == 0:
-        return None, (f"{target}: no epoch spans with data_wait "
-                      f"attribution (train with --telemetry on the "
-                      f"STREAMING path to emit them)")
+    report = build(paths)
+    if is_empty(report):
+        return None, f"{target}: {empty_msg}"
     return report, None
+
+
+def _load_data_report(target: str):
+    from ..telemetry import analysis
+
+    return _load_tagged_report(
+        target, "trace_data_stats", analysis.data_report,
+        lambda r: r["epochs"] == 0,
+        "no epoch spans with data_wait attribution (train with "
+        "--telemetry on the STREAMING path to emit them)")
+
+
+def _load_serve_report(target: str):
+    from ..telemetry import analysis
+
+    return _load_tagged_report(
+        target, "serve_trace_attribution", analysis.serve_report,
+        lambda r: r["requests"] == 0,
+        "no serve.request spans (serve with --telemetry DIR to emit "
+        "them)")
 
 
 def _cmd_report(a) -> int:
@@ -226,18 +254,33 @@ def _cmd_report(a) -> int:
     if a.serve:
         # the serve-path attribution report (docs/OBSERVABILITY.md
         # §Request tracing): per-stage p50/p95/p99 + %-of-e2e, batch
-        # occupancy/padding waste, slowest-request exemplar trees
-        paths = analysis.trace_files(a.target)
-        if not paths:
-            print(f"trace report: {a.target}: no events*.jsonl found",
-                  file=sys.stderr)
+        # occupancy/padding waste, slowest-request exemplar trees; with
+        # --baseline, the stage-SHARE regression gate — exit 3 when
+        # compute's share of e2e drops (or an overhead stage's share
+        # grows) past --threshold, sub-ms stages exempt (docs/SERVING.md
+        # §Fast path)
+        report, err = _load_serve_report(a.target)
+        if err:
+            print(f"trace report: {err}", file=sys.stderr)
             return 1
-        report = analysis.serve_report(paths)
-        if report["requests"] == 0:
-            print(f"trace report: {a.target}: no serve.request spans "
-                  f"(serve with --telemetry DIR to emit them)",
-                  file=sys.stderr)
-            return 1
+        if a.baseline:
+            baseline, err = _load_serve_report(a.baseline)
+            if err:
+                print(f"trace report: baseline {err}", file=sys.stderr)
+                return 1
+            diff = analysis.compare_serve(report, baseline,
+                                          threshold=a.threshold)
+            if a.json:
+                print(json.dumps({"report": report, "comparison": diff},
+                                 indent=2 if sys.stdout.isatty() else None))
+            else:
+                print(analysis.format_serve_report(report))
+                print(analysis.format_compare_serve(diff))
+            if not diff["rows"]:
+                print("trace report: no stage share overlaps the baseline "
+                      "— the gate checked nothing", file=sys.stderr)
+                return 1
+            return 3 if diff["regressions"] else 0
         if a.json:
             print(json.dumps(report,
                              indent=2 if sys.stdout.isatty() else None))
@@ -323,7 +366,10 @@ def main(argv=None) -> int:
                    help="the serve-path tail-latency attribution report "
                         "instead of the train phase report: per-stage "
                         "p50/p95/p99 + %% of e2e, batch occupancy and "
-                        "padding waste, slowest-request exemplars "
+                        "padding waste, slowest-request exemplars; with "
+                        "--baseline, the stage-share regression gate — "
+                        "exit 3 when compute's share of e2e drops past "
+                        "--threshold, sub-ms stages exempt "
                         "(docs/OBSERVABILITY.md §Request tracing)")
     r.add_argument("--data", action="store_true",
                    help="the input-attribution report instead of the train "
@@ -416,9 +462,6 @@ def main(argv=None) -> int:
     if a.cmd == "report":
         if a.threshold <= 0:
             p.error("--threshold must be > 0")
-        if a.serve and a.baseline:
-            p.error("--serve has no baseline gate (the step-time/"
-                    "efficiency gates are the non-serve report's)")
         picked = [f for f in ("serve", "data", "cost")
                   if getattr(a, f)]
         if len(picked) > 1:

@@ -50,7 +50,7 @@ class ServeService:
     def __init__(self, engine: InferenceEngine, *, max_batch=None,
                  max_delay_ms: float = 2.0, max_depth: int = 256,
                  retry_after_s: float = 0.05, clock=None, registry=None,
-                 admit_mode: str = "depth", slo_p99_s=None):
+                 admit_mode: str = "depth", slo_p99_s=None, fast=None):
         import time
         clock = clock or time.monotonic
         self.engine = engine
@@ -65,10 +65,14 @@ class ServeService:
             predictor=(self.metrics.predicted_p99
                        if admit_mode == "predicted_p99" else None))
         self.tracer = ServeTracer(clock=clock, metrics=self.metrics)
+        # fast=None auto-selects the staged fast path when the engine has
+        # the staging surface (docs/SERVING.md §Fast path); fast=False is
+        # the A/B knob (bench.py --no_fast) that forces the legacy
+        # stack-at-flush path
         self.batcher = MicroBatcher(engine, max_batch=max_batch,
                                     max_delay_ms=max_delay_ms,
                                     metrics=self.metrics, clock=clock,
-                                    tracer=self.tracer)
+                                    tracer=self.tracer, fast=fast)
         self.clock = clock
 
     async def handle(self, row) -> int:
@@ -98,12 +102,20 @@ class ServeService:
         return pred
 
     async def shutdown(self) -> None:
-        """Graceful drain: refuse new work, serve everything admitted,
-        then leave the slowest-request exemplar trees in the flight ring
-        (the post-mortem the drain-time dump carries)."""
+        """Graceful drain: refuse new work, serve everything admitted
+        (on the fast path that includes awaiting the reply thread's
+        outstanding futures), stop the reply thread, drain any in-flight
+        device transfers (engine.close — a no-op after a clean drain,
+        load-bearing on an aborted one), then leave the slowest-request
+        exemplar trees in the flight ring (the post-mortem the drain-time
+        dump carries)."""
         self.admission.begin_drain()
         await self.batcher.drain()
         await self.admission.drained()
+        self.batcher.close()
+        close = getattr(self.engine, "close", None)
+        if close is not None:   # duck-typed wrapper engines have no pool
+            close()
         self.tracer.flush_exemplars()
 
 

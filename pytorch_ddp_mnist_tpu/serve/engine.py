@@ -23,10 +23,25 @@ Inputs are float32 rows already normalized by the client, or raw uint8
 pixels normalized on device with the training path's exact op chain
 (`train.scan.device_normalize`) — chosen once at construction
 (`input_dtype`), because each choice is its own compiled program.
+
+The serve fast path (docs/SERVING.md §Fast path) adds persistent host
+staging: the engine owns a small pool of top-rung-shaped slabs, every
+ladder rung's staging array is a leading-rows view of one, and the
+micro-batcher writes request rows straight into the active slab at
+enqueue time. `dispatch_staged` then pays only the pad-tail memset and
+the H2D dispatch per flush — no stack, no concatenate, no fresh host
+allocation — and swaps slabs so the next flush accumulates while this
+one is in flight (double-buffered H2D). On accelerators the input
+donation (`donate_argnums`) closes the device half of the story: each
+flush's H2D allocation is donated into the executable, so the same
+per-rung HBM size class round-trips through the allocator instead of
+growing the footprint per flush.
 """
 
 from __future__ import annotations
 
+import threading
+from bisect import bisect_left
 from typing import Optional, Sequence
 
 import numpy as np
@@ -39,6 +54,41 @@ from ..train.checkpoint import load_checkpoint
 from ..train.scan import device_normalize
 
 IN_DIM = MLP_DIMS[0]
+
+# Host staging slabs the engine keeps warm for the serve fast path: two is
+# the double buffer (flush N+1 accumulates and dispatches its H2D while
+# flush N's compute is still in flight); the pool grows past it only when
+# replies lag more than one flush behind, and growth is counted
+# (`staging_grown`), never silent.
+STAGING_SLOTS = 2
+
+
+class InflightBatch:
+    """One dispatched bucket call whose results have not been fetched yet:
+    the device output arrays (futures under JAX async dispatch), the real
+    row count to trim back to, and — for staged dispatches — the host slab
+    the input rows rode in on, returned to the engine's staging pool at
+    fetch/teardown time."""
+
+    __slots__ = ("logits_d", "preds_d", "n", "bucket", "slab")
+
+    def __init__(self, logits_d, preds_d, n: int, bucket: int, slab=None):
+        self.logits_d = logits_d
+        self.preds_d = preds_d
+        self.n = n
+        self.bucket = bucket
+        self.slab = slab
+
+    def ready(self) -> bool:
+        """Non-blocking: True when both outputs are on-device complete,
+        so a fetch would return without waiting. The batcher uses this
+        for its opportunistic inline reply (fetch on the loop ONLY when
+        it cannot block it)."""
+        try:
+            return bool(self.logits_d.is_ready()
+                        and self.preds_d.is_ready())
+        except AttributeError:   # a jax without is_ready: never inline
+            return False
 
 
 def bucket_ladder(max_batch: int, multiple_of: int = 1) -> "tuple[int, ...]":
@@ -131,6 +181,27 @@ class InferenceEngine:
         except (AttributeError, RuntimeError, ValueError, TypeError,
                 NotImplementedError, OSError):
             pass  # forensics are advisory; the engine serves without them
+        # -- serve fast path: persistent staging + in-flight tracking -----
+        # Host slabs of the top-rung shape, allocated ONCE here; each
+        # rung's staging array is a leading-rows view of a slab, so one
+        # allocation serves the whole ladder and the batcher writes
+        # request rows straight into the active slab at enqueue time
+        # (zero-copy batch forming — no np.stack/np.concatenate per
+        # flush). A slab cycles active -> dispatched (H2D may read it
+        # until the flush's compute completes; on CPU jax.device_put can
+        # alias host memory outright) -> back to the pool at fetch. The
+        # lock guards the pool handoff between the event loop
+        # (dispatch_staged) and the reply thread (fetch_staged/close).
+        self._staging_lock = threading.Lock()
+        self._staging_pool = [self._new_slab()
+                              for _ in range(STAGING_SLOTS - 1)]
+        self._active_slab = self._new_slab()
+        self._inflight: dict = {}
+        self.staging_grown = 0
+        # whoever is currently FILLING the active slab (a MicroBatcher
+        # passes itself): two concurrent writers would silently corrupt
+        # each other's batches, so the second one fails loudly instead
+        self._staging_writer = None
 
     @classmethod
     def from_checkpoint(cls, path: str, **kw) -> "InferenceEngine":
@@ -158,20 +229,41 @@ class InferenceEngine:
     # -- serving ----------------------------------------------------------
 
     def bucket_for(self, n: int) -> int:
-        """Smallest precompiled bucket holding `n` rows."""
-        for b in self.buckets:
-            if b >= n:
-                return b
-        raise ValueError(f"batch of {n} rows exceeds the largest bucket "
-                         f"{self.buckets[-1]} (max_batch {self.max_batch})")
+        """Smallest precompiled bucket holding `n` rows — a `bisect` over
+        the precomputed ascending ladder, because this runs once per
+        request on the serve hot path (the linear scan it replaces was
+        O(rungs) per offered request)."""
+        i = bisect_left(self.buckets, n)
+        if i == len(self.buckets):
+            raise ValueError(f"batch of {n} rows exceeds the largest "
+                             f"bucket {self.buckets[-1]} "
+                             f"(max_batch {self.max_batch})")
+        return self.buckets[i]
 
-    def _run_bucket(self, x: np.ndarray, bctx=None):
-        """Pad `x` to its bucket and run the compiled executable. Returns
-        (logits, preds) for the REAL rows only. `bctx` (a
-        `serve.tracing.BatchCtx`) receives the pad/H2D and compute stage
-        stamps — plain clock reads, no extra device sync: the `np.asarray`
-        fetch below already blocks on the executable, so the compute stamp
-        lands when the results are truly on the host."""
+    def _oom_forensics(self, e: BaseException, bucket: int) -> None:
+        """An allocation failure dies naming the program and the HBM
+        budget it blew (telemetry/costs.py; no-op for non-OOM errors) —
+        the exception itself propagates unchanged. Under JAX async
+        dispatch the failure can surface at the DISPATCH or at the
+        FETCH, so both sites report through here."""
+        from ..telemetry.costs import record_oom_forensics
+        record_oom_forensics(e, program=f"serve.bucket{bucket}")
+
+    def _execute(self, bucket: int, xd):
+        """Dispatch the bucket's AOT executable (async under JAX dispatch;
+        the returned arrays are futures until fetched)."""
+        try:
+            return self._compiled[bucket](self._params, xd)
+        except RuntimeError as e:
+            self._oom_forensics(e, bucket)
+            raise
+
+    def _dispatch(self, x: np.ndarray, bctx=None) -> InflightBatch:
+        """Pad `x` to its bucket and DISPATCH the compiled executable
+        without fetching: the returned handle's arrays resolve under
+        JAX's async dispatch while the caller issues more work (the
+        multi-chunk `forward`/`predict` overlap, and the legacy
+        engine-wrapper path's first half)."""
         n = x.shape[0]
         bucket = self.bucket_for(n)
         if n != bucket:
@@ -181,19 +273,140 @@ class InferenceEngine:
               if self._x_sharding is not None else jnp.asarray(x))
         if bctx is not None:
             bctx.mark_h2d(bucket)
+        logits, preds = self._execute(bucket, xd)
+        return InflightBatch(logits, preds, n, bucket)
+
+    def _run_bucket(self, x: np.ndarray, bctx=None):
+        """Pad `x` to its bucket, run the compiled executable, and FETCH.
+        Returns (logits, preds, bucket) for the REAL rows only. `bctx` (a
+        `serve.tracing.BatchCtx`) receives the pad/H2D and compute stage
+        stamps — plain clock reads, no extra device sync: the `np.asarray`
+        fetch below already blocks on the executable, so the compute stamp
+        lands when the results are truly on the host."""
+        h = self._dispatch(x, bctx)
         try:
-            logits, preds = self._compiled[bucket](self._params, xd)
-            out = np.asarray(logits)[:n], np.asarray(preds)[:n], bucket
-        except RuntimeError as e:
-            # an allocation failure dies naming the program and the HBM
-            # budget it blew (telemetry/costs.py; no-op for non-OOM
-            # errors) — the exception itself propagates unchanged
-            from ..telemetry.costs import record_oom_forensics
-            record_oom_forensics(e, program=f"serve.bucket{bucket}")
+            out = (np.asarray(h.logits_d)[:h.n],
+                   np.asarray(h.preds_d)[:h.n], h.bucket)
+        except RuntimeError as e:   # async-dispatch failures surface at
+            self._oom_forensics(e, h.bucket)    # the fetch, not the call
             raise
         if bctx is not None:
             bctx.mark_computed()
         return out
+
+    # -- the serve fast path: persistent staging ---------------------------
+
+    def _new_slab(self) -> np.ndarray:
+        return np.zeros((self.max_batch, IN_DIM), self._np_dtype)
+
+    def staging(self, owner=None) -> np.ndarray:
+        """The host slab the NEXT staged flush dispatches from. The
+        batcher writes request row i into `staging()[i]` at enqueue time;
+        every ladder rung's staging array is a leading-rows view of this
+        one persistent allocation.
+
+        `owner` (the batcher, when writing rows) claims the active slab
+        until the next `dispatch_staged`: the slab is engine-global
+        state, so a SECOND concurrent filler would silently overwrite
+        the first's rows and serve wrong predictions — that misuse
+        raises here instead. Sequential services over one shared engine
+        (each drains before the next serves) stay fine: every dispatch
+        releases the claim."""
+        if owner is not None:
+            if self._staging_writer is None:
+                self._staging_writer = owner
+            elif self._staging_writer is not owner:
+                raise RuntimeError(
+                    "engine staging slab is already being filled by "
+                    "another batcher — one engine serves ONE batcher at "
+                    "a time (the fast path's staging is engine-global "
+                    "state)")
+        return self._active_slab
+
+    def dispatch_staged(self, n: int, bctx=None) -> InflightBatch:
+        """Dispatch rows 0..n of the active staging slab: zero the pad
+        tail (padding stays inert whatever the slab carried last flush),
+        issue the H2D + the bucket executable WITHOUT fetching, and swap
+        the active slab so the caller accumulates the next flush while
+        this one is in flight (the double buffer). Returns the in-flight
+        handle; `fetch_staged` (any thread) completes it."""
+        if not 1 <= n <= self.max_batch:
+            raise ValueError(f"staged flush of {n} rows outside "
+                             f"[1, {self.max_batch}]")
+        bucket = self.bucket_for(n)
+        self._staging_writer = None    # the claim ends with the flush
+        slab = self._active_slab
+        if n != bucket:
+            slab[n:bucket] = 0
+        xd = (jax.device_put(slab[:bucket], self._x_sharding)
+              if self._x_sharding is not None
+              else jnp.asarray(slab[:bucket]))
+        if bctx is not None:
+            bctx.mark_h2d(bucket)
+        logits, preds = self._execute(bucket, xd)
+        handle = InflightBatch(logits, preds, n, bucket, slab)
+        with self._staging_lock:
+            self._inflight[id(handle)] = handle
+            if self._staging_pool:
+                self._active_slab = self._staging_pool.pop()
+            else:
+                # replies are lagging more than a full flush behind: grow
+                # the pool rather than overwrite a slab the device may
+                # still be reading — counted, so the steady-state
+                # zero-allocation pin can see any growth
+                self._active_slab = self._new_slab()
+                self.staging_grown += 1
+        return handle
+
+    def fetch_staged(self, handle: InflightBatch):
+        """Block until `handle`'s results are on the host (exactly two
+        device->host fetches: logits + preds — the sanitizer-pinned
+        per-flush budget) and return them trimmed to the real rows. The
+        slab rides back into the staging pool EVEN when the fetch raises
+        (a failed flush's device work is over either way — leaking the
+        slab per failure would bleed the pool on a long-running server);
+        an allocation failure surfacing here still gets its OOM
+        forensics entry."""
+        try:
+            logits = np.asarray(handle.logits_d)[:handle.n]
+            preds = np.asarray(handle.preds_d)[:handle.n]
+        except RuntimeError as e:
+            self._oom_forensics(e, handle.bucket)
+            raise
+        finally:
+            self._release(handle)
+        return logits, preds
+
+    def _release(self, handle: InflightBatch) -> None:
+        with self._staging_lock:
+            if self._inflight.pop(id(handle), None) is not None \
+                    and handle.slab is not None:
+                self._staging_pool.append(handle.slab)
+
+    @property
+    def inflight_count(self) -> int:
+        with self._staging_lock:
+            return len(self._inflight)
+
+    def close(self) -> None:
+        """Drain every staged dispatch still in flight (deterministic
+        teardown, the pipeline/prefetch contract: by the time close
+        returns the device owes nothing and every slab is back in the
+        pool). Idempotent, and the engine stays serveable afterwards —
+        close quiesces, it does not poison."""
+        self._staging_writer = None   # an aborted filler's claim dies too
+        with self._staging_lock:
+            pending = list(self._inflight.values())
+            self._inflight.clear()
+        for h in pending:
+            try:
+                jax.block_until_ready((h.logits_d, h.preds_d))
+            except Exception:  # noqa: BLE001 — teardown drain only: an
+                pass           # abandoned transfer's own failure has no
+                               # waiter left to deliver to
+            if h.slab is not None:
+                with self._staging_lock:
+                    self._staging_pool.append(h.slab)
 
     def compiled_programs(self) -> dict:
         """bucket -> the AOT-compiled executable: the forensics surface
@@ -209,17 +422,46 @@ class InferenceEngine:
             raise ValueError(f"expected (n, {IN_DIM}) rows; got {x.shape}")
         return np.ascontiguousarray(x)
 
-    def forward(self, x) -> np.ndarray:
-        """Logits (n, 10) float32 for `x` (n, 784); chunks batches larger
-        than max_batch so direct callers never hit the bucket cap."""
-        x = self._as_rows(x)
-        outs = [self._run_bucket(x[i:i + self.max_batch])[0]
+    def _dispatch_chunks(self, x) -> "list[InflightBatch]":
+        """Dispatch EVERY max_batch chunk before anything is fetched, so
+        chunk k+1's H2D and compute overlap chunk k's execution under
+        JAX's async dispatch — the old loop fetched synchronously per
+        chunk, serializing the whole multi-chunk batch."""
+        return [self._dispatch(x[i:i + self.max_batch])
                 for i in range(0, len(x), self.max_batch)]
+
+    def _fetch_chunks(self, handles, which: str) -> np.ndarray:
+        """Fetch one output (`logits_d` / `preds_d`) per dispatched chunk.
+        If a fetch fails, the remaining in-flight chunks are drained
+        before the error propagates (the pipeline/prefetch teardown
+        contract: the device owes nothing once the caller sees the
+        exception)."""
+        outs = []
+        for i, h in enumerate(handles):
+            try:
+                outs.append(np.asarray(getattr(h, which))[:h.n])
+            except BaseException as e:
+                if isinstance(e, RuntimeError):   # OOM surfaces at fetch
+                    self._oom_forensics(e, h.bucket)
+                for later in handles[i + 1:]:
+                    try:
+                        jax.block_until_ready((later.logits_d,
+                                               later.preds_d))
+                    except Exception:  # noqa: BLE001 — teardown drain:
+                        pass           # the primary fetch error is the
+                                       # one the caller must see
+                raise
         return np.concatenate(outs, axis=0)
 
+    def forward(self, x) -> np.ndarray:
+        """Logits (n, 10) float32 for `x` (n, 784); chunks batches larger
+        than max_batch so direct callers never hit the bucket cap, with
+        all chunks dispatched before the first fetch (they overlap)."""
+        return self._fetch_chunks(self._dispatch_chunks(self._as_rows(x)),
+                                  "logits_d")
+
     def predict(self, x) -> np.ndarray:
-        """Argmax classes (n,) int32 for `x` (n, 784)."""
-        x = self._as_rows(x)
-        outs = [self._run_bucket(x[i:i + self.max_batch])[1]
-                for i in range(0, len(x), self.max_batch)]
-        return np.concatenate(outs, axis=0)
+        """Argmax classes (n,) int32 for `x` (n, 784); same overlapped
+        chunking as `forward`."""
+        return self._fetch_chunks(self._dispatch_chunks(self._as_rows(x)),
+                                  "preds_d")

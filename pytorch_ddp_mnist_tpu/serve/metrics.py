@@ -215,6 +215,9 @@ class ServeMetrics:
             self.registry.gauge(f"serve.stage.{stage}_rate_rps").set_fn(
                 (lambda hist: lambda: (hist.n / hist.total
                                        if hist.total > 0 else None))(h))
+        # the histograms in STAGES order, for the tuple-shaped hot-path
+        # recorder (record_stage_values: one zip, no key lookups)
+        self._stage_hist_list = [self._stage_hists[s] for s in STAGES]
         self.registry.gauge("serve.predicted_p99_s").set_fn(
             self.predicted_p99)
 
@@ -278,10 +281,20 @@ class ServeMetrics:
     def record_stages(self, stages: dict) -> None:
         """One completed request's per-stage durations (`<stage>_s` keys,
         serve/tracing.py's telescoped breakdown) into the stage
-        histograms."""
+        histograms — the dict-shaped spelling for external feeders; the
+        tracer's per-completion hot path uses `record_stage_values`."""
         for stage, hist in self._stage_hists.items():
             v = stages.get(f"{stage}_s")
             if isinstance(v, (int, float)) and v >= 0:
+                hist.record(v)
+
+    def record_stage_values(self, values) -> None:
+        """One completed request's telescoped stage durations as a bare
+        tuple in STAGES order (`tracing.RequestCtx.stage_values`): the
+        allocation-light recorder the tracer calls once per completion
+        at peak service rate — no dict, no key formatting."""
+        for hist, v in zip(self._stage_hist_list, values):
+            if v >= 0:
                 hist.record(v)
 
     def predicted_p99(self) -> Optional[float]:
@@ -301,17 +314,23 @@ class ServeMetrics:
     # -- snapshot ---------------------------------------------------------
 
     def attribution(self) -> dict:
-        """The live per-stage latency attribution — stage p50/p99 (ms) in
-        pipeline order plus the current predicted p99 — under EXACTLY the
-        stage names the JSONL trace uses (serve/tracing.py STAGES): the
-        `{"op": "stats"}` dashboard and `trace report --serve` must never
-        disagree on naming."""
+        """The live per-stage latency attribution — stage p50/p99 (ms),
+        in pipeline order, plus each stage's SHARE of the telescoped
+        per-request time (stage total / sum of stage totals: the stages
+        decompose e2e, so the shares sum to 100%) and the current
+        predicted p99 — under EXACTLY the stage names the JSONL trace
+        uses (serve/tracing.py STAGES): the `{"op": "stats"}` dashboard,
+        the bench artifact's `stage_attribution` stamp, and `trace
+        report --serve` must never disagree on naming."""
         pred = self.predicted_p99()
+        denom = sum(h.total for h in self._stage_hists.values())
         return {
             "stages": {
                 stage: {"n": h.n,
                         "p50_ms": round(h.percentile(0.50) * 1e3, 3),
-                        "p99_ms": round(h.percentile(0.99) * 1e3, 3)}
+                        "p99_ms": round(h.percentile(0.99) * 1e3, 3),
+                        "share_pct": (round(100.0 * h.total / denom, 2)
+                                      if denom > 0 else None)}
                 for stage, h in self._stage_hists.items() if h.n
             },
             "predicted_p99_ms": (round(pred * 1e3, 3)

@@ -52,6 +52,15 @@ Stage catalog (docs/OBSERVABILITY.md §Request tracing):
 All stamps use the service's injectable clock, so tests drive attribution
 deterministically under a fake clock; at span-emission time durations are
 shifted into the perf_counter/time.time frames the schema requires.
+
+Fast-path threading contract (docs/SERVING.md §Fast path): a `BatchCtx`
+is stamped from two execution contexts — `t0`/`mark_formed`/`mark_h2d`
+on the event loop at flush time, `mark_computed` on the batcher's reply
+thread when the fetch lands — but every cross-thread hop is sequenced
+(queue put/get, then `call_soon_threadsafe`), so the stamps are monotone
+in pipeline order and `batch_end` / `ServeTracer.finish` (span emission,
+exemplar heap, stage histograms) still run EXCLUSIVELY on the loop: the
+EventTrace writer's single-thread contract is preserved.
 """
 
 from __future__ import annotations
@@ -77,6 +86,9 @@ EXEMPLAR_K = 8
 
 REQUEST_SPAN = "serve.request"
 BATCH_SPAN = "serve.batch"
+# the `<stage>_s` attribute spellings, precomputed ONCE: the per-request
+# hot path must not pay six f-string formats per completion
+STAGE_KEYS = tuple(f"{s}_s" for s in STAGES)
 # batch child stage spans, in pipeline order (the checker validates their
 # start stamps are monotone in this order within one batch)
 BATCH_STAGE_SPANS = ("serve.batch_form", "serve.pad_h2d", "serve.compute")
@@ -142,22 +154,27 @@ class RequestCtx:
         self.t_done: Optional[float] = None
         self.ok: Optional[bool] = None
 
-    def stage_durations(self) -> dict:
-        """The telescoped per-stage breakdown, only for a completed
-        request that rode a fully stamped batch (a failed request has no
-        honest decomposition). Keys are `<stage>_s` in STAGES order."""
+    def stage_values(self) -> "Optional[Tuple[float, ...]]":
+        """The telescoped per-stage breakdown as a bare tuple in STAGES
+        order (None for a request without a fully stamped batch — a
+        failed request has no honest decomposition). The hot path
+        records from THIS: no dict, no per-request key formatting."""
         b = self.batch
         if (self.t_admit is None or self.t_enqueue is None
                 or self.t_done is None or b is None or not b.complete):
-            return {}
-        return {
-            "admission_s": self.t_admit - self.t_arrival,
-            "queue_s": b.t0 - self.t_enqueue,
-            "batch_form_s": b.t_formed - b.t0,
-            "pad_h2d_s": b.t_h2d - b.t_formed,
-            "compute_s": b.t_computed - b.t_h2d,
-            "reply_s": self.t_done - b.t_computed,
-        }
+            return None
+        return (self.t_admit - self.t_arrival,
+                b.t0 - self.t_enqueue,
+                b.t_formed - b.t0,
+                b.t_h2d - b.t_formed,
+                b.t_computed - b.t_h2d,
+                self.t_done - b.t_computed)
+
+    def stage_durations(self) -> dict:
+        """`stage_values` under its `<stage>_s` key spellings (the span
+        attrs / exemplar-tree shape); {} when incomplete."""
+        vals = self.stage_values()
+        return {} if vals is None else dict(zip(STAGE_KEYS, vals))
 
     def e2e_s(self) -> Optional[float]:
         if self.t_done is None:
@@ -238,14 +255,20 @@ class ServeTracer:
 
     def finish(self, rctx: RequestCtx, *, ok: bool) -> None:
         """Reply delivered (or the request failed): stamp completion, feed
-        the stage histograms, emit the request span, keep the exemplar."""
+        the stage histograms, emit the request span, keep the exemplar.
+
+        This runs once per completed request at peak service rate, so
+        the common path (tracing disabled, exemplar heap full) touches
+        no dicts and formats no strings: the stage breakdown rides a
+        bare tuple into the histograms, and the keyed spellings are only
+        built for an admitted exemplar or an enabled span."""
         rctx.t_done = self.clock()
         rctx.ok = ok
-        stages = rctx.stage_durations() if ok else {}
-        if stages and self.metrics is not None:
-            self.metrics.record_stages(stages)
+        vals = rctx.stage_values() if ok else None
+        if vals is not None and self.metrics is not None:
+            self.metrics.record_stage_values(vals)
         e2e = rctx.e2e_s()
-        if stages and e2e is not None:
+        if vals is not None and e2e is not None:
             # heap admission FIRST: at high rps most requests cannot
             # displace the minimum, and must not pay tree construction
             full = len(self._exemplars) >= self.exemplar_k
@@ -254,7 +277,7 @@ class ServeTracer:
                 tree = {"request_id": rctx.request_id,
                         "e2e_s": round(e2e, 6),
                         "stages": {k: round(v, 6)
-                                   for k, v in stages.items()},
+                                   for k, v in zip(STAGE_KEYS, vals)},
                         "batch_id": rctx.batch.batch_id,
                         "bucket": rctx.batch.bucket,
                         "coalesce": rctx.batch.coalesce}
@@ -271,7 +294,9 @@ class ServeTracer:
         attrs = {"request_id": rctx.request_id, "ok": ok}
         if rctx.batch is not None:
             attrs["batch"] = rctx.batch.batch_id
-        attrs.update((k, round(v, 9)) for k, v in stages.items())
+        if vals is not None:
+            attrs.update((k, round(v, 9))
+                         for k, v in zip(STAGE_KEYS, vals))
         tracer.emit_span(REQUEST_SPAN,
                          t0_mono=rctx.t_arrival + off_mono,
                          t0_wall=rctx.t_arrival + off_wall,

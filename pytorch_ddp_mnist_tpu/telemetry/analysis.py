@@ -665,6 +665,73 @@ def serve_report(paths: List[str], exemplar_k: int = SERVE_EXEMPLAR_K) -> dict:
     }
 
 
+# Below this per-request p95, a stage's share measures scheduler noise,
+# not the pipeline: the share gate never regresses on a sub-ms stage —
+# the same rule the step-time and data-share gates apply.
+SERVE_SUBMS_EXEMPT_S = 1e-3
+
+
+def compare_serve(new: dict, baseline: dict, threshold: float = 1.5) -> dict:
+    """The serve stage-share regression gate (`trace report --serve
+    --baseline OLD`): one row per stage present in both reports, gating
+    each stage's SHARE of end-to-end time (`pct_of_e2e`). `compute` is
+    the useful work — its share is better-BIGGER, so its ratio is old/new
+    (the efficiency-gate convention: a drop reads as > 1); every other
+    stage is overhead the fast path exists to shrink — better-smaller,
+    ratio new/old. A regression is a ratio past `threshold`, UNLESS the
+    stage's absolute per-request p95 is sub-millisecond in both runs
+    (`SERVE_SUBMS_EXEMPT_S`: at that scale the share's numerator is
+    scheduler noise — the step-time gate's exemption rule). The headline
+    row this gate exists for: compute's share of e2e at saturation must
+    not fall past threshold once the fast path lands (ROADMAP item 3)."""
+    rows, regressions = [], []
+    for stage in SERVE_STAGES:
+        old_st = (baseline.get("stages") or {}).get(stage) or {}
+        new_st = (new.get("stages") or {}).get(stage) or {}
+        old_v, new_v = old_st.get("pct_of_e2e"), new_st.get("pct_of_e2e")
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            continue
+        if stage == "compute":
+            # a compute-share COLLAPSE to zero is the worst regression,
+            # not a skippable row (the efficiency-gate convention)
+            ratio = (old_v / new_v) if new_v > 0 else float("inf")
+        else:
+            ratio = new_v / old_v
+        p95s = [v for v in (old_st.get("p95_s"), new_st.get("p95_s"))
+                if isinstance(v, (int, float))]
+        exempt = bool(p95s) and max(p95s) < SERVE_SUBMS_EXEMPT_S
+        row = {"stage": stage, "stat": "pct_of_e2e",
+               "baseline_pct": old_v, "new_pct": new_v, "ratio": ratio,
+               "sub_ms_exempt": exempt,
+               "regressed": ratio > threshold and not exempt}
+        rows.append(row)
+        if row["regressed"]:
+            regressions.append(row)
+    return {"threshold": threshold, "rows": rows,
+            "regressions": regressions}
+
+
+def format_compare_serve(diff: dict) -> str:
+    lines = [f"serve stage-share gate (ratio > {diff['threshold']:g}x "
+             f"regresses; compute share better-bigger, overhead shares "
+             f"better-smaller; sub-ms stages exempt):"]
+    for row in diff["rows"]:
+        verdict = ("REGRESSION" if row["regressed"]
+                   else "exempt (sub-ms)" if row["sub_ms_exempt"]
+                   and row["ratio"] > diff["threshold"] else "ok")
+        lines.append(f"  {row['stage']:<12} share "
+                     f"{row['baseline_pct']:6.1f}% -> "
+                     f"{row['new_pct']:6.1f}%  ({row['ratio']:.2f}x)  "
+                     f"{verdict}")
+    if not diff["rows"]:
+        lines.append("  (no stage overlaps the baseline — nothing gated)")
+    n = len(diff["regressions"])
+    lines.append(f"regression gate: "
+                 f"{f'FAIL — {n} stage share(s) past threshold' if n else 'PASS'}")
+    return "\n".join(lines)
+
+
 def format_serve_report(report: dict) -> str:
     """Human rendering of `serve_report` (the --json flag prints the dict
     itself)."""

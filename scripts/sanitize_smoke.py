@@ -53,6 +53,10 @@ def _serve_phase(sanitize, stall_ms: float) -> dict:
     engine.predict(request_rows(1, seed=7))   # warm the host path pre-arm
     service = ServeService(engine, max_delay_ms=2.0, max_depth=256,
                            registry=telemetry.MetricsRegistry())
+    if not service.batcher.fast_path:
+        raise sanitize.SanitizerError(
+            "serve selftest is not on the staged fast path — the smoke "
+            "must pin the path production actually runs")
     with sanitize.no_host_sync() as sync, \
             sanitize.event_loop_stall(threshold_ms=stall_ms) as loop_guard:
         out = run_loadgen(service, offered_rps=1500.0, n_requests=200,
@@ -65,11 +69,17 @@ def _serve_phase(sanitize, stall_ms: float) -> dict:
         raise sanitize.HostSyncError(
             f"serve path made {sync.fetches} device fetches across "
             f"{flushes} flushes; the contract is exactly 2 (logits + "
-            f"preds) per flush")
+            f"preds) per flush — now fetched on the REPLY thread, where "
+            f"the interception still counts them")
     return {"completed": out["completed"], "flushes": flushes,
             "fetches": sync.fetches,
             "block_until_ready": sync.block_until_ready_calls,
-            "stalls": len(loop_guard.stalls)}
+            "stalls": len(loop_guard.stalls),
+            # the fast-path invariants ride the smoke line: the staged
+            # path served, and the staging pool never grew past its
+            # double buffer (zero host allocations per flush)
+            "fast_path": service.batcher.fast_path,
+            "staging_grown": engine.staging_grown}
 
 
 def _train_phase(sanitize) -> dict:

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Serve fast-path before/after artifact (SERVE_r0X.json — docs/PERF.md
+§Serving path).
+
+Runs `bench.py --mode serve` over an offered-rps grid, N trials per
+point, for BOTH flush paths — the staged fast path and `--no_fast` (the
+legacy stack-at-flush path, i.e. the pre-ISSUE-14 engine) — at one fixed
+loadgen geometry, and reduces each point to per-trial medians. The
+headline each artifact commits: **max sustained QPS at the fixed p99
+SLO** (a point "sustains" when its median p99 is within the SLO and its
+median reject rate is under the cap), per path, plus the per-stage
+share table at each path's saturation point.
+
+One bench subprocess per trial: every measurement gets a fresh engine,
+registry, and reply thread — trials cannot warm each other.
+
+    JAX_PLATFORMS=cpu python scripts/serve_fast_bench.py -o SERVE_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(offered_rps: float, a, fast: bool) -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py"), "--mode",
+           "serve", "--requests", str(a.requests), "--offered_rps",
+           str(offered_rps), "--max_batch", str(a.max_batch),
+           "--max_delay_ms", str(a.max_delay_ms),
+           "--queue_depth", str(a.queue_depth)]
+    if not fast:
+        cmd.append("--no_fast")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                         timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError(f"bench failed ({' '.join(cmd)}):\n"
+                           f"{out.stderr[-2000:]}")
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def _reduce_point(rps: float, trials, a) -> dict:
+    med = {k: round(statistics.median(tr[k] for tr in trials), 3)
+           for k in ("value", "p50_ms", "p99_ms", "reject_rate",
+                     "batch_occupancy")}
+    sustained = (med["p99_ms"] <= a.slo_p99_ms
+                 and med["reject_rate"] <= a.reject_cap)
+    # the stage table of the median-achieved trial (one honest run's
+    # decomposition, not an average of averages)
+    rep = sorted(trials, key=lambda tr: tr["value"])[len(trials) // 2]
+    return {"offered_rps": rps, "trials": len(trials), **med,
+            "sustained": sustained,
+            "stage_attribution": rep.get("stage_attribution"),
+            "staging_grown": rep.get("staging_grown")}
+
+
+def _reduce_path(label: str, points) -> dict:
+    sustained = [p for p in points if p["sustained"]]
+    best = max(sustained, key=lambda p: p["value"]) if sustained else None
+    return {
+        "path": label,
+        "points": points,
+        "max_sustained_qps": best["value"] if best else None,
+        "at_offered_rps": best["offered_rps"] if best else None,
+        "p99_ms_at_max": best["p99_ms"] if best else None,
+        "stages_at_max": best["stage_attribution"] if best else None,
+    }
+
+
+def sweep(a):
+    """Both paths, INTERLEAVED trial by trial (legacy, fast, legacy,
+    fast, ...) at every grid point: this host's ambient load drifts on
+    the scale of a whole sweep, so back-to-back pairing is the only fair
+    comparison — a path never gets a quieter machine than its rival."""
+    before_pts, after_pts = [], []
+    for rps in a.grid:
+        trials = {"legacy": [], "fast": []}
+        for t in range(a.trials):
+            for fast, label in ((False, "legacy"), (True, "fast")):
+                rec = run_bench(rps, a, fast)
+                trials[label].append(rec)
+                print(f"  {label} offered={rps:.0f} trial {t + 1}: "
+                      f"ach={rec['value']:.0f} p99={rec['p99_ms']:.2f}ms "
+                      f"rej={rec['reject_rate']:.3f}", file=sys.stderr,
+                      flush=True)
+        before_pts.append(_reduce_point(rps, trials["legacy"], a))
+        after_pts.append(_reduce_point(rps, trials["fast"], a))
+    return (_reduce_path("legacy", before_pts),
+            _reduce_path("fast", after_pts))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-o", "--out", default=None,
+                   help="write the artifact JSON here (stdout always)")
+    p.add_argument("--grid", type=float, nargs="+",
+                   default=[16000.0, 20000.0, 24000.0, 28000.0],
+                   help="offered-rps grid (default spans this host's "
+                        "saturation knee — the committed SERVE_r01 "
+                        "geometry)")
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--requests", type=int, default=3600)
+    p.add_argument("--max_batch", type=int, default=32)
+    p.add_argument("--max_delay_ms", type=float, default=2.0)
+    p.add_argument("--queue_depth", type=int, default=256)
+    p.add_argument("--slo_p99_ms", type=float, default=25.0,
+                   help="the fixed p99 SLO a point must hold to count as "
+                        "sustained")
+    p.add_argument("--reject_cap", type=float, default=0.01,
+                   help="max median reject rate for a sustained point")
+    a = p.parse_args(argv)
+    if a.trials < 1 or a.requests < 1:
+        p.error("--trials/--requests must be >= 1")
+
+    t0 = time.time()
+    artifact = {
+        "artifact": "serve_fast_path_before_after",
+        "v": 1,
+        "geometry": {"requests": a.requests, "max_batch": a.max_batch,
+                     "max_delay_ms": a.max_delay_ms,
+                     "queue_depth": a.queue_depth,
+                     "grid_offered_rps": a.grid, "trials": a.trials,
+                     "slo_p99_ms": a.slo_p99_ms,
+                     "reject_cap": a.reject_cap},
+        "host": {"cpus": os.cpu_count(), "platform": "cpu"},
+    }
+    # `legacy` is the pre-fast-path flush (`--no_fast`: stack rows at
+    # flush, fetch synchronously ON the event loop) — the before side;
+    # `fast` is the staged path (persistent staging, zero-copy forming,
+    # double-buffered H2D, adaptive off-loop reply). Trials interleave.
+    artifact["before"], artifact["after"] = sweep(a)
+    b, f = artifact["before"], artifact["after"]
+    if b["max_sustained_qps"] and f["max_sustained_qps"]:
+        artifact["qps_gain"] = round(
+            f["max_sustained_qps"] / b["max_sustained_qps"], 4)
+    artifact["wall_s"] = round(time.time() - t0, 1)
+    blob = json.dumps(artifact, indent=2)
+    print(blob)
+    if a.out:
+        with open(a.out, "w") as fh:
+            fh.write(blob + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

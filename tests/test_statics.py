@@ -476,6 +476,11 @@ def test_thread_entry_map_on_the_real_tree():
     assert "flush" in auditor.entries["loop"]   # called from _on_timer
     # the input pipeline's decode workers land in the thread map
     assert "_work" in auditor.entries["thread"]
+    # the serve fast path's reply thread (ISSUE 14): the off-loop fetch
+    # worker is a thread entry, and the loop-side scatter it schedules
+    # via call_soon_threadsafe is audited as loop-resident
+    assert "_reply_worker" in auditor.entries["thread"]
+    assert "_scatter" in auditor.entries["loop"]
 
 
 def test_lock001_groups_attributes_per_class():

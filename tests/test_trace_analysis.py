@@ -781,6 +781,98 @@ def test_compare_data_sub_ms_exempt():
     assert not diff["regressions"]
 
 
+def _serve_stage_report(shares, p95_s=0.01):
+    """A minimal serve report: stage -> pct_of_e2e (p95 defaults past the
+    sub-ms exemption so shares actually gate)."""
+    return {"report": "serve_trace_attribution",
+            "stages": {s: {"pct_of_e2e": pct, "p95_s": p95_s}
+                       for s, pct in shares.items()}}
+
+
+def test_compare_serve_gates_compute_share_drop():
+    """The ISSUE 14 gate: compute's share of e2e dropping past threshold
+    regresses (ratio old/new, the efficiency convention); an overhead
+    stage's share GROWING past threshold regresses too (ratio new/old);
+    an improvement in either direction passes."""
+    old = _serve_stage_report({"compute": 40.0, "reply": 20.0})
+    bad = _serve_stage_report({"compute": 10.0, "reply": 70.0})
+    diff = analysis.compare_serve(bad, old, threshold=1.5)
+    by_stage = {r["stage"]: r for r in diff["rows"]}
+    assert by_stage["compute"]["regressed"]
+    assert by_stage["compute"]["ratio"] == pytest.approx(4.0)
+    assert by_stage["reply"]["regressed"]
+    assert by_stage["reply"]["ratio"] == pytest.approx(3.5)
+    # the fast-path direction (compute share UP, overhead DOWN) passes
+    ok = analysis.compare_serve(old, bad, threshold=1.5)
+    assert not ok["regressions"]
+    # self-comparison is always a PASS with full row coverage
+    self_diff = analysis.compare_serve(old, old, threshold=1.5)
+    assert self_diff["rows"] and not self_diff["regressions"]
+    # compute share collapsing to zero is the worst regression, not a
+    # skipped row
+    dead = analysis.compare_serve(
+        _serve_stage_report({"compute": 0.0}), old)
+    assert [r for r in dead["regressions"] if r["stage"] == "compute"]
+
+
+def test_compare_serve_sub_ms_exempt():
+    old = _serve_stage_report({"batch_form": 1.0}, p95_s=0.0002)
+    new = _serve_stage_report({"batch_form": 5.0}, p95_s=0.0004)
+    diff = analysis.compare_serve(new, old, threshold=1.5)
+    assert diff["rows"] and diff["rows"][0]["sub_ms_exempt"]
+    assert not diff["regressions"]
+    # ...but a stage past a millisecond gates for real
+    slow = _serve_stage_report({"batch_form": 5.0}, p95_s=0.002)
+    assert analysis.compare_serve(slow, old, threshold=1.5)["regressions"]
+
+
+def test_trace_cli_serve_gate_round_trip(tmp_path, capsys):
+    """`trace report --serve --baseline`: a saved --json report feeds the
+    gate; a run never regresses against itself (exit 0), a doctored
+    baseline with a far larger compute share exits 3."""
+    import pathlib
+    import sys as _sys
+
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from pytorch_ddp_mnist_tpu.cli.trace import main as trace_main
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.serve import InferenceEngine, ServeService
+    from pytorch_ddp_mnist_tpu.serve.loadgen import run_loadgen
+    import jax
+
+    out_dir = tmp_path / "obs"
+    telemetry.enable(str(out_dir))
+    try:
+        eng = InferenceEngine(init_mlp(jax.random.key(0)), max_batch=8)
+        svc = ServeService(eng, max_delay_ms=2.0, max_depth=256,
+                           registry=telemetry.MetricsRegistry())
+        run_loadgen(svc, offered_rps=3000.0, n_requests=40, seed=0)
+    finally:
+        telemetry.disable()
+    assert trace_main(["report", "--serve", "--json", str(out_dir)]) == 0
+    saved = tmp_path / "self.json"
+    saved.write_text(capsys.readouterr().out)
+    # self-baseline: exit 0, the gate table prints a PASS
+    rc = trace_main(["report", "--serve", str(out_dir),
+                     "--baseline", str(saved)])
+    assert rc == 0
+    assert "regression gate: PASS" in capsys.readouterr().out
+    # a doctored baseline whose compute share was far larger -> exit 3
+    doctored = json.loads(saved.read_text())
+    st = doctored["stages"]
+    st["compute"]["pct_of_e2e"] = 100.0 * max(
+        1.0, st["compute"].get("pct_of_e2e") or 1.0)
+    st["compute"]["p95_s"] = 0.5   # past the sub-ms exemption both sides
+    for s in st.values():
+        s.setdefault("p95_s", 0.5)
+    bad = tmp_path / "doctored.json"
+    bad.write_text(json.dumps(doctored))
+    rc = trace_main(["report", "--serve", str(out_dir),
+                     "--baseline", str(bad), "--threshold", "1.5"])
+    assert rc == 3
+    assert "REGRESSION" in capsys.readouterr().out
+
+
 def test_trace_cli_data_view_and_gate(tmp_path, capsys):
     good = tmp_path / "good"
     bad = tmp_path / "bad"
