@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -119,6 +119,22 @@ ddp-smoke:
 		$(PY) bench.py --mode ddp --epochs 3 --batch_size 16 \
 			--param_scale 2
 
+# Cluster-forensics smoke (docs/OBSERVABILITY.md §Cluster forensics):
+# a 2-process journaled world trains clean (per-rank collective journals
+# agree, `check_telemetry --require cluster.,ddp.` gates BOTH metric
+# families in one invocation, the Perfetto export carries per-rank
+# collective tracks + cross-rank seq flow arrows); then an injected
+# `collective_timeout` on rank 0 must produce a `trace report --cluster`
+# hang report naming the stuck seq/kind and every rank's last journal
+# position; then a synthetic desynced journal pair must exit 3 naming
+# both ranks. On a jaxlib without CPU multiprocess collectives it
+# degrades to the same matrix at world=1 (script exit 75 = the
+# multiproc-skip signal, the chaos-smoke convention).
+cluster-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/cluster_smoke.py || \
+		{ rc=$$?; [ $$rc -eq 75 ] && \
+		JAX_PLATFORMS=cpu $(PY) scripts/cluster_smoke.py --world 1; }
+
 # Chaos smoke (docs/ROBUSTNESS.md): SIGKILL a seeded rank of a 4-process
 # fake-CPU-device training run at a seeded mid-epoch step, relaunch with
 # --resume <step-ckpt dir>, assert the finished params are BYTE-identical
@@ -177,7 +193,7 @@ cost-smoke:
 		$(PY) -m pytorch_ddp_mnist_tpu trace cost \
 		--telemetry /tmp/pdmt_cost_smoke \
 		-o /tmp/pdmt_cost_smoke/COST.json
-	$(PY) scripts/check_telemetry.py --require xla. --require mem. \
+	$(PY) scripts/check_telemetry.py --require xla.,mem. \
 		/tmp/pdmt_cost_smoke
 	$(PY) -m pytorch_ddp_mnist_tpu trace report --cost \
 		/tmp/pdmt_cost_smoke/COST.json
@@ -188,8 +204,9 @@ cost-smoke:
 # The committed pre-merge gate: static contracts first (seconds), then the
 # runtime sanitizers on the live paths (incl. the input pipeline), then
 # the serve request-tracing round trip (also seconds), then the program
-# cost/memory harvest round trip, then the fast test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke test-fast
+# cost/memory harvest round trip, then the cluster-forensics round trip
+# (collective journal + hang attribution), then the fast test tier.
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke cluster-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

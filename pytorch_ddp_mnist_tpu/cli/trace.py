@@ -12,6 +12,10 @@ JSONL event traces training and serving emit.
                              # share of e2e drops past --threshold
     python -m pytorch_ddp_mnist_tpu trace report --data /tmp/obs \
         [--baseline OLD]            # input attribution + data-share gate
+    python -m pytorch_ddp_mnist_tpu trace report --cluster /tmp/obs
+                     # cluster forensics from per-rank collective journals
+                     # (--journal runs): desync (exit 3, both ranks named),
+                     # per-rank-pair straggler skew, hang report
     python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
                                                  # load in Perfetto
     python -m pytorch_ddp_mnist_tpu trace cost -o COST.json \
@@ -179,6 +183,33 @@ def _load_serve_report(target: str):
 def _cmd_report(a) -> int:
     from ..telemetry import analysis
 
+    if a.cluster:
+        # cluster forensics (docs/OBSERVABILITY.md §Cluster forensics):
+        # TARGET is a --telemetry dir holding per-rank journal*.jsonl
+        # files (cli/train --journal) — merged into one causal timeline:
+        # desync detection (exit 3, naming both ranks and the diverging
+        # collective), per-rank-pair straggler attribution, and the hang
+        # report (open collectives + every rank's last journal position)
+        from ..telemetry import cluster
+        if not cluster.journal_files(a.target):
+            print(f"trace report: {a.target}: no journal*.jsonl found "
+                  f"(train with --journal --telemetry DIR to emit them)",
+                  file=sys.stderr)
+            return 1
+        report = cluster.cluster_report(a.target)
+        if a.json:
+            print(json.dumps(report,
+                             indent=2 if sys.stdout.isatty() else None))
+        else:
+            print(cluster.format_cluster_report(report))
+        if not report["desync"]["ok"]:
+            v = report["desync"]["violations"][0]
+            print(f"trace report: cross-rank DESYNC at seq {v['seq']} "
+                  f"between rank {v['ranks'][0]} and rank {v['ranks'][1]}"
+                  f": {v['detail']}", file=sys.stderr)
+            return 3
+        return 0
+
     if a.cost:
         # the program-forensics report + the compile/HBM/efficiency gate
         # (docs/OBSERVABILITY.md §Program forensics): TARGET is a saved
@@ -332,20 +363,26 @@ def _cmd_cost(a) -> int:
 
 
 def _cmd_export(a) -> int:
-    from ..telemetry import analysis, export
+    from ..telemetry import analysis, cluster, export
 
     paths = analysis.trace_files(a.target)
     if not paths:
         print(f"trace export: {a.target}: no events*.jsonl found",
               file=sys.stderr)
         return 1
-    n = export.write_chrome_trace(paths, a.out)
+    # per-rank collective journals beside the trace (a --journal run)
+    # render as per-rank collective tracks with seq-aligned flow arrows
+    journal_paths = cluster.journal_files(a.target)
+    n = export.write_chrome_trace(paths, a.out,
+                                  journal_paths=journal_paths)
     if n == 0:
         print(f"trace export: {a.target}: no timeline records",
               file=sys.stderr)
         return 1
-    print(f"trace export: wrote {n} event(s) from {len(paths)} file(s) to "
-          f"{a.out} (load in Perfetto or chrome://tracing)")
+    extra = (f" (+ {len(journal_paths)} collective journal(s))"
+             if journal_paths else "")
+    print(f"trace export: wrote {n} event(s) from {len(paths)} file(s)"
+          f"{extra} to {a.out} (load in Perfetto or chrome://tracing)")
     return 0
 
 
@@ -379,6 +416,16 @@ def main(argv=None) -> int:
                         "data_wait-share regression gate — exit 3 past "
                         "--threshold, sub-ms data_wait exempt "
                         "(docs/DATA.md)")
+    r.add_argument("--cluster", action="store_true",
+                   help="the cluster-forensics report instead of the "
+                        "train phase report: TARGET is a --telemetry dir "
+                        "holding per-rank collective journals (train with "
+                        "--journal) — cross-rank desync detection (exit 3 "
+                        "naming both ranks and the diverging collective), "
+                        "per-collective straggler attribution per rank "
+                        "pair, and the hang report (open collectives + "
+                        "every rank's last journal position) "
+                        "(docs/OBSERVABILITY.md §Cluster forensics)")
     r.add_argument("--cost", action="store_true",
                    help="the program-forensics report: TARGET is a saved "
                         "`trace cost` report (COST_r0X.json) or a DDP "
@@ -462,11 +509,14 @@ def main(argv=None) -> int:
     if a.cmd == "report":
         if a.threshold <= 0:
             p.error("--threshold must be > 0")
-        picked = [f for f in ("serve", "data", "cost")
+        picked = [f for f in ("serve", "data", "cost", "cluster")
                   if getattr(a, f)]
         if len(picked) > 1:
             p.error(f"--{picked[0]} and --{picked[1]} select different "
                     f"reports; pass one")
+        if a.cluster and a.baseline:
+            p.error("--cluster compares ranks against each other, not "
+                    "runs against a baseline; drop --baseline")
         if a.batch is not None and not a.cost:
             p.error("--batch only applies to the --cost report")
         if a.batch is not None and a.batch < 1:

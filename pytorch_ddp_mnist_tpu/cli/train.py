@@ -318,6 +318,27 @@ def main(argv=None) -> int:
                 f"got {tcfg['batch_size']} — use --kernel pallas instead")
     if tcfg["fused"] and not tcfg["cached"]:
         raise SystemExit("--fused fuses the epoch scan; add --cached")
+    if tcfg["journal"]:
+        # the collective journal's by-name hygiene (the unroll lesson):
+        # every configuration that would silently record nothing refuses
+        # to start instead
+        if not tcfg["telemetry"]:
+            raise SystemExit("--journal writes journal*.jsonl beside the "
+                             "JSONL trace; add --telemetry DIR")
+        if not tcfg["parallel"]:
+            raise SystemExit("--journal records the DDP step's collectives "
+                             "over the 'dp' mesh; a serial run issues none "
+                             "— add --parallel")
+        if tcfg["cached"]:
+            raise SystemExit("--journal needs the streaming path: --cached "
+                             "runs steps inside a jitted scan, so the host "
+                             "observes only chunk boundaries and the "
+                             "per-collective journal cannot stamp them — "
+                             "drop --cached (and --fused)")
+        if tcfg["kernel"] in ("pallas", "pallas_rng", "pallas_epoch"):
+            raise SystemExit(f"--journal needs the XLA step program (it "
+                             f"declares its collective schedule); --kernel "
+                             f"{tcfg['kernel']} owns its own comms")
     if tcfg["ddp_comm"] != "pmean":
         # the comm strategies are per-step XLA collectives over the 'dp'
         # mesh — meaningless serially, and the whole-epoch kernel owns its
@@ -543,6 +564,7 @@ def main(argv=None) -> int:
     put = None
     mesh = None
     runtime = None
+    journal = None
     if tcfg["parallel"]:
         from ..parallel.wireup import initialize_runtime
         from ..parallel.ddp import (make_dp_train_step, dp_mesh,
@@ -550,9 +572,14 @@ def main(argv=None) -> int:
         runtime = initialize_runtime(tcfg["wireup_method"])
         process_index, num_processes = jax.process_index(), jax.process_count()
         faultpoints.set_rank(process_index)  # rank-gated specs bind here
+        telemetry.flight.set_rank(process_index)  # flight entries likewise
         if tcfg["telemetry"]:  # post-rendezvous: the real rank is known now
             telemetry.enable(tcfg["telemetry"], process_index=process_index)
         use_pallas = _resolve_kernel()
+        if tcfg["journal"] and use_pallas:
+            raise SystemExit("--journal needs the XLA step program; "
+                             "--kernel auto resolved to pallas here — pass "
+                             "--kernel xla to journal this run")
         mesh = dp_mesh()  # global: all devices of all processes
         if not tcfg["cached"]:  # the cached path builds its own step fns
             if use_pallas:
@@ -577,6 +604,34 @@ def main(argv=None) -> int:
         put = lambda b: global_batch_from_local(mesh, b)  # noqa: E731
         num_shards = mesh.devices.size  # data sharding is per-device
         local_shards = len(jax.local_devices())
+        if tcfg["journal"]:
+            # the per-rank collective journal + hang watchdog
+            # (telemetry/cluster.py; docs/OBSERVABILITY.md §Cluster
+            # forensics). The startup barrier right after enabling puts
+            # seq 0 on every rank's journal at the same collective — the
+            # alignment anchor every cross-rank comparison rides — and is
+            # the injectable `collective_timeout` faultpoint: an injected
+            # (or real) timeout leaves the barrier's enter open, and the
+            # except below turns it into a named hang report instead of a
+            # raw traceback (the journal and flight ring ARE the report).
+            journal = telemetry.cluster.enable_journal(
+                tcfg["telemetry"], rank=process_index,
+                world=num_processes)
+            from ..parallel.wireup import looks_like_backend_loss
+            try:
+                runtime.barrier()
+            except RuntimeError as e:
+                if not looks_like_backend_loss(e):
+                    raise
+                entry = journal.open_entry() or {"seq": 0,
+                                                 "kind": "barrier"}
+                telemetry.cluster.report_hang(journal, entry)
+                telemetry.cluster.disable_journal(clean=False)
+                raise SystemExit(
+                    f"[cluster] collective timeout in the startup barrier "
+                    f"(seq {entry.get('seq')}): {e} — hang report in the "
+                    f"flight dump under {tcfg['telemetry']}; read it with "
+                    f"`trace report --cluster {tcfg['telemetry']}`")
     else:
         use_pallas = _resolve_kernel()
         if use_pallas and not tcfg["cached"]:
@@ -1051,7 +1106,8 @@ def main(argv=None) -> int:
                        eval_perm=eval_perm,
                        watchdog=watchdog,
                        input_workers=tcfg["input_workers"],
-                       prefetch_depth=tcfg["prefetch_depth"])
+                       prefetch_depth=tcfg["prefetch_depth"],
+                       journal=journal)
     from ..telemetry.health import TrainingHealthError
     try:
         state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,
@@ -1062,6 +1118,13 @@ def main(argv=None) -> int:
         # diverged model is a diagnosed outcome, not a crash)
         raise SystemExit(f"[health] {e}")
 
+    if journal is not None:
+        # clean shutdown: the journal_end trailer marks this rank as
+        # having finished its collective sequence (the desync detector
+        # only compares positions of cleanly-closed journals), and the
+        # watchdog thread stops. BEFORE the registry snapshot below so
+        # the cluster.* metrics land in the trace's final record.
+        telemetry.cluster.disable_journal()
     if tcfg["telemetry"]:
         # End of run: stamp the memory gauges, write the final registry
         # snapshot as the trace's last record, close the file, and print

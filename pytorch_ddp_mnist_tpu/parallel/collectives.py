@@ -317,6 +317,78 @@ def bytes_on_wire(params_or_count, n_devices: int, comm: str, *,
     return int(2 * ring * 2 * n_params)            # bf16 allreduce
 
 
+def collective_schedule(params_or_count, n_devices: int, comm: str, *,
+                        overlap: bool = False,
+                        bucket_elems: int = DEFAULT_BUCKET_ELEMS,
+                        quant_block: int = QUANT_BLOCK) -> list:
+    """The static half of the per-rank collective journal
+    (telemetry/cluster.py): the ordered list of PAYLOAD collectives one
+    step of this strategy issues, as dicts
+    `{kind, dtype, axis, elems, bytes, bucket}` — kinds/counts/bytes from
+    the SAME bucket math the strategies run, so the journal a rank writes
+    is the program the auditor proved (the `journal-schedule` contract in
+    statics/jaxpr_audit.py pins this list against the walked jaxpr,
+    entry for entry).
+
+    `bytes` is the ring-model per-device wire cost of that ONE collective
+    (allreduce 2(N-1)/N*M, RS/A2A/AG (N-1)/N*M); the entries sum to
+    `bytes_on_wire` exactly. Control-plane scalars (the loss pmean, the
+    health aux vector) are excluded by the same rule the auditor applies
+    (<= SMALL_ELEMS elements is not payload). 1-device meshes keep the
+    schedule's SHAPE (seq numbering must not depend on world size) with
+    zero bytes — the ring moves nothing."""
+    from .mesh import DATA_AXIS
+    validate_comm(comm)
+    n = int(n_devices)
+    ring = (n - 1) / n if n > 1 else 0.0
+    if isinstance(params_or_count, (int, np.integer)):
+        leaves = [_count_leaf(int(params_or_count))]
+    else:
+        leaves = jax.tree_util.tree_leaves(params_or_count)
+
+    def entry(kind, dtype, elems, nbytes, bucket):
+        return {"kind": kind, "dtype": dtype, "axis": DATA_AXIS,
+                "elems": int(elems), "bytes": int(round(nbytes)),
+                "bucket": int(bucket)}
+
+    out = []
+    if comm in ("pmean", "bf16"):
+        itemsize = 4 if comm == "pmean" else 2
+        dtype = "float32" if comm == "pmean" else "bfloat16"
+        if not overlap:
+            # one whole-leaf allreduce per parameter leaf
+            for i, leaf in enumerate(leaves):
+                elems = _leaf_size(leaf)
+                out.append(entry("allreduce", dtype, elems,
+                                 2 * ring * elems * itemsize, i))
+        else:
+            for b, (_bucket, _n_real, padded) in enumerate(
+                    _bucket_layout(leaves, bucket_elems, 1)):
+                out.append(entry("allreduce", dtype, padded,
+                                 2 * ring * padded * itemsize, b))
+    elif comm == "sharded":
+        for b, (_bucket, _n_real, padded) in enumerate(
+                _bucket_layout(leaves, bucket_elems, max(n, 1))):
+            out.append(entry("reduce_scatter", "float32", padded,
+                             ring * padded * 4, b))
+            out.append(entry("all_gather", "float32", padded,
+                             ring * padded * 4, b))
+    else:  # int8: quantized payload + block scales ride BOTH phases
+        qb = int(quant_block)
+        for b, (_bucket, _n_real, padded) in enumerate(
+                _bucket_layout(leaves, bucket_elems, max(n, 1) * qb)):
+            blocks = padded // qb
+            out.append(entry("all_to_all", "int8", padded,
+                             ring * padded, b))
+            out.append(entry("all_to_all", "float32", blocks,
+                             ring * blocks * 4, b))
+            out.append(entry("all_gather", "int8", padded,
+                             ring * padded, b))
+            out.append(entry("all_gather", "float32", blocks,
+                             ring * blocks * 4, b))
+    return out
+
+
 def stochastic_round_bf16(key: jax.Array, x: jax.Array) -> jax.Array:
     """Stochastically round an f32 array to bfloat16: add uniform random
     bits below the bf16 mantissa cut, then truncate. Unbiased in

@@ -306,6 +306,17 @@ def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
     # the program-forensics name (telemetry/costs.py): compile attribution
     # and OOM dumps key cost records on exactly this label
     step.cost_label = collectives.step_cost_label(comm, overlap)
+
+    def collective_schedule(params):
+        # the per-rank collective journal's static half (telemetry/
+        # cluster.py): the ordered payload collectives ONE step of this
+        # exact configuration issues — a thunk, not a list, because the
+        # leaf sizes come from the live params tree the loop holds
+        return collectives.collective_schedule(
+            params, step.ddp_devices, comm, overlap=overlap,
+            bucket_elems=be, quant_block=qb)
+
+    step.collective_schedule = collective_schedule
     return step
 
 

@@ -76,23 +76,38 @@ class Runtime:
         collective produces (utils/faultpoints) — the injectable version
         of the failure `looks_like_backend_loss` triages. Imported lazily:
         this module must stay importable without jax or the package's
-        heavier utils."""
+        heavier utils.
+
+        Journal bracket (telemetry/cluster.py): the barrier is a
+        host-BLOCKING collective, so the journal records a true
+        enter/exit pair around it — the enter lands BEFORE the faultpoint
+        fires, so an injected (or real) timeout leaves an open entry: the
+        exact evidence the hang report and the collective watchdog key
+        on. A NullJournal (the default) makes this one attribute check."""
+        from ..telemetry import cluster
         from ..utils import faultpoints
+        seq = cluster.get_journal().enter("barrier", axis="world")
         faultpoints.fire("barrier", rank=self.rank)
         if self.size > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("pytorch_ddp_mnist_tpu.barrier")
+        cluster.get_journal().exit(seq)
 
     def reduce_max(self, value: float) -> float:
         """Global max of a host scalar (reference reduceMAX via
         MPI.Reduce(op=MAX), mnist_cpu_mp.py:193-199) — delivered to ALL
         processes (allreduce; the reference's root-only Reduce result is a
-        strict subset of this)."""
+        strict subset of this). Journal-bracketed like `barrier` (it is a
+        host-blocking 4-byte allreduce)."""
         if self.size == 1:
             return float(value)
+        from ..telemetry import cluster
+        seq = cluster.get_journal().enter("allreduce", axis="world",
+                                          nbytes=4)
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
         gathered = multihost_utils.process_allgather(jnp.float32(value))
+        cluster.get_journal().exit(seq)
         return float(gathered.max())
 
     def finalize(self) -> None:

@@ -22,7 +22,13 @@ contracts the repo otherwise guards with hand-written per-test pins:
   * **wire-bytes** — per-step bytes recomputed from the AUDITED program
     (ring cost model: allreduce 2(N-1)/N * M, RS/A2A (N-1)/N * M_in, AG
     (N-1)/N * M_out) equals `parallel.collectives.bytes_on_wire` exactly —
-    the telemetry cost model can never drift from the lowered program.
+    the telemetry cost model can never drift from the lowered program;
+  * **journal-schedule** — the per-rank collective journal's static half
+    (`parallel.collectives.collective_schedule`, what a `--journal` run
+    records per step — telemetry/cluster.py) matches the audited
+    program's payload collectives entry for entry (same multiset of
+    kind + ring bytes): the journal can never describe a program nobody
+    ran.
 
 Two program forms per config: `step` (parallel.ddp.dp_step_program — the
 streaming make_dp_train_step body) and `run` (train.scan.make_dp_run_fn —
@@ -466,6 +472,28 @@ def audit_collected(ops: List[CollectiveOp], f64_ops: List, callbacks: List,
             "wire-bytes", cfg,
             f"bytes recomputed from the audited program ({program}) != "
             f"ddp.bytes_on_wire cost model ({model})")
+
+    # journal-schedule: the per-rank collective journal's static half
+    # (telemetry/cluster.py records what collectives.collective_schedule
+    # enumerates) must match the AUDITED program entry-for-entry — same
+    # multiset of (kind, ring bytes) — or the journal a rank writes would
+    # describe a program nobody ran and every cross-rank comparison built
+    # on it would be fiction.
+    schedule = collectives.collective_schedule(
+        _example_params(), n_dev, comm, overlap=overlap,
+        bucket_elems=be, quant_block=qb)
+    want = sorted((e["kind"], int(e["bytes"])) for e in schedule)
+    got = sorted((o.kind, int(round(_ring_bytes(o, n_dev))))
+                 for o in payload)
+    if want != got:
+        missing = [w for w in want if w not in got]
+        extra = [g for g in got if g not in want]
+        raise AuditViolation(
+            "journal-schedule", cfg,
+            f"collective_schedule ({len(want)} entr(ies)) does not match "
+            f"the audited program's payload collectives ({len(got)}): "
+            f"schedule-only {missing[:4]}, program-only {extra[:4]} — "
+            f"the journal would record a program nobody ran")
 
     return AuditReport(comm=comm, overlap=overlap, form=form,
                        n_devices=n_dev, n_buckets=n_buckets,

@@ -49,6 +49,14 @@ shared by train, serve, and bench alike:
     the compile/HBM regression gate (`trace report --cost --baseline`),
     and OOM forensics (`looks_like_oom` + the flight-recorder program
     memory table).
+  * `cluster.py`   — CLUSTER forensics: the per-rank collective journal
+    (static kinds/bytes from the audited schedule, host boundary stamps;
+    NullJournal zero-overhead default), cross-rank desync detection,
+    per-collective straggler attribution, and hang forensics — the
+    collective watchdog that dumps a who-is-where table and flips
+    `/healthz` when an entered collective never exits. Front doors:
+    `cli/train.py --journal`, `trace report --cluster`,
+    `make cluster-smoke`.
 
 Front doors: `cli/train.py --telemetry DIR` (JSONL + rank-0 end-of-run
 summary) / `--health POLICY` / `--metrics_port N`, `python -m
@@ -82,6 +90,11 @@ from . import costs  # noqa: F401
 from .export import chrome_trace, profiler_trace, write_chrome_trace  # noqa: F401
 from .flight import (FlightRecorder, get_flight_recorder)  # noqa: F401
 from . import flight  # noqa: F401
+from .cluster import (CollectiveJournal, CollectiveWatchdog,  # noqa: F401
+                      NullJournal, cluster_report, disable_journal,
+                      enable_journal, format_cluster_report, get_journal,
+                      journal_files, load_journal, who_is_where)
+from . import cluster  # noqa: F401
 from .health import (HealthConfig, HealthEvent, TrainingHealthError,  # noqa: F401
                      Watchdog, device_health_aux, health_summary)
 from .prom import (metric_name, render_prometheus,  # noqa: F401

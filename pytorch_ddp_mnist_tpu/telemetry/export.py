@@ -43,21 +43,64 @@ from .analysis import (SERVE_BATCH_SPAN, SERVE_BATCH_STAGE_ORDER,
 # Serve traces add two more: concurrent request spans (which overlap
 # without nesting — they would render as a garbled stack on the spans
 # thread) and the batch pipeline, connected by flow arrows so clicking a
-# request walks to the batch that carried it.
+# request walks to the batch that carried it. Collective journals
+# (--journal runs, telemetry/cluster.py) add a per-rank collectives
+# track, with seq-aligned flow arrows binding the SAME collective across
+# ranks — straggler skew renders as visible arrow slant.
 _TID_SPANS = 0
 _TID_AGGREGATES = 1
 _TID_REQUESTS = 2
 _TID_BATCHES = 3
+_TID_COLLECTIVES = 4
 _SERVE_BATCH_TRACK = (SERVE_BATCH_SPAN,) + SERVE_BATCH_STAGE_ORDER
+# seq-aligned cross-rank arrows are capped (a long run journals thousands
+# of collectives; Perfetto renders arrows per flow id, and the first few
+# hundred seqs carry the alignment story) — the cap is stamped into
+# otherData so a truncated arrow set never reads as complete
+COLLECTIVE_ARROW_CAP = 512
 
 
 def _scale_us(seconds: float) -> float:
     return round(seconds * 1e6, 3)
 
 
-def chrome_trace(paths: List[str]) -> dict:
+def _journal_slices(journal_paths: List[str]) -> List[tuple]:
+    """Per-rank collective journal records as (start_wall_s, rank, rec)
+    triples — wall stamps are comparable across ranks directly (each
+    record carries t_wall at its enter), so they join the events' aligned
+    timeline without an offset computation. Open entries (enter, no exit)
+    render as zero-duration slices marked open=True — a stuck collective
+    is visible as the track's abrupt end."""
+    from .cluster import load_journal
+    out = []
+    for path in journal_paths:
+        j = load_journal(path)
+        rank = j["rank"]
+        for rec in j["records"]:
+            # t_wall is the window's ENTER wall stamp (the writer's
+            # contract), directly comparable across ranks
+            t_wall = rec.get("t_wall")
+            if not isinstance(t_wall, (int, float)):
+                continue
+            out.append((float(t_wall), rank, rec))
+        for e in j["open"]:
+            t_wall = e.get("t_wall")
+            if not isinstance(t_wall, (int, float)):
+                continue
+            rec = {"seq": e["seq"], "k": e["kind"], "t_wall": t_wall,
+                   "t_enter": e.get("t_enter"),
+                   "t_exit": e.get("t_enter"), "open": True}
+            out.append((float(t_wall), rank, rec))
+    return out
+
+
+def chrome_trace(paths: List[str],
+                 journal_paths: Optional[List[str]] = None) -> dict:
     """Merge per-process JSONL trace files into one Chrome trace-event
-    object: `{"traceEvents": [...], "displayTimeUnit": "ms"}`."""
+    object: `{"traceEvents": [...], "displayTimeUnit": "ms"}`.
+    `journal_paths` (per-rank collective journals from a --journal run)
+    add one `collectives` track per rank plus seq-aligned cross-rank flow
+    arrows."""
     records, _errors = load_traces(paths)
     by_file: dict = {}
     for rec in records:
@@ -91,9 +134,11 @@ def chrome_trace(paths: List[str]) -> dict:
                 else:  # meta records / stamp-less records: no timeline
                     continue
                 aligned.append((start, rec))
-    if not aligned:
+    jslices = _journal_slices(journal_paths or [])
+    if not aligned and not jslices:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t_base = min(start for start, _rec in aligned)
+    t_base = min([start for start, _rec in aligned]
+                 + [start for start, _r, _rec in jslices])
 
     # serve flow arrows (request -> the batch that carried it) need the
     # batch slice's position BEFORE the request slices render: one pass
@@ -194,16 +239,71 @@ def chrome_trace(paths: List[str]) -> dict:
                                        "cat": "registry", "ts": ts,
                                        "pid": pid, "tid": _TID_SPANS,
                                        "args": {"value": value}})
+    # -- per-rank collective tracks + seq-aligned cross-rank arrows ------
+    arrows_capped = False
+    if jslices:
+        by_seq: dict = {}   # seq -> [(rank, ts_us)]
+        for start, rank, rec in sorted(jslices, key=lambda it: it[0]):
+            pid = int(rank)
+            if pid not in named_pids:
+                named_pids.add(pid)
+                events.append({"ph": "M", "name": "process_name",
+                               "pid": pid, "tid": _TID_SPANS,
+                               "args": {"name": f"process {pid}"}})
+            ts = _scale_us(start - t_base)
+            t_enter, t_exit = rec.get("t_enter"), rec.get("t_exit")
+            dur = (max(float(t_exit) - float(t_enter), 0.0)
+                   if isinstance(t_enter, (int, float))
+                   and isinstance(t_exit, (int, float)) else 0.0)
+            seq = rec.get("seq")
+            args = {"seq": seq, "bytes": rec.get("bytes"),
+                    "bucket": rec.get("bucket"), "step": rec.get("step")}
+            if rec.get("open"):
+                args["open"] = True
+            events.append({
+                "ph": "X", "name": str(rec.get("k", "coll")),
+                "cat": "collective", "ts": ts, "dur": _scale_us(dur),
+                "pid": pid, "tid": _TID_COLLECTIVES,
+                "args": {k: v for k, v in args.items() if v is not None},
+            })
+            if isinstance(seq, int):
+                by_seq.setdefault(seq, []).append((pid, ts))
+        for pid in sorted({int(r) for _s, r, _rec in jslices}):
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": _TID_COLLECTIVES,
+                           "args": {"name": "collectives"}})
+        # one flow per seq present on >= 2 ranks: the arrow binds the SAME
+        # collective across ranks, so straggler skew renders as slant
+        arrow_seqs = sorted(s for s, where in by_seq.items()
+                            if len(where) >= 2)
+        arrows_capped = len(arrow_seqs) > COLLECTIVE_ARROW_CAP
+        for seq in arrow_seqs[:COLLECTIVE_ARROW_CAP]:
+            where = sorted(by_seq[seq])
+            flow_seq += 1
+            flow = {"cat": "collective_flow", "name": f"seq {seq}",
+                    "id": flow_seq}
+            pid0, ts0 = where[0]
+            events.append({"ph": "s", "ts": ts0, "pid": pid0,
+                           "tid": _TID_COLLECTIVES, **flow})
+            for pid_n, ts_n in where[1:]:
+                events.append({"ph": "f", "bp": "e", "ts": ts_n,
+                               "pid": pid_n, "tid": _TID_COLLECTIVES,
+                               **flow})
+    other = {"source": "pytorch_ddp_mnist_tpu telemetry schema v1",
+             "files": sorted(by_file)}
+    if journal_paths:
+        other["journals"] = sorted(journal_paths)
+        if arrows_capped:
+            other["collective_arrow_cap"] = COLLECTIVE_ARROW_CAP
     return {"traceEvents": events, "displayTimeUnit": "ms",
-            "otherData": {"source": "pytorch_ddp_mnist_tpu telemetry "
-                                    "schema v1",
-                          "files": sorted(by_file)}}
+            "otherData": other}
 
 
-def write_chrome_trace(paths: List[str], out_path: str) -> int:
-    """Render `paths` and write the trace-event JSON to `out_path`;
-    returns the event count."""
-    trace = chrome_trace(paths)
+def write_chrome_trace(paths: List[str], out_path: str,
+                       journal_paths: Optional[List[str]] = None) -> int:
+    """Render `paths` (+ optional per-rank collective journals) and write
+    the trace-event JSON to `out_path`; returns the event count."""
+    trace = chrome_trace(paths, journal_paths=journal_paths)
     with open(out_path, "w") as f:
         json.dump(trace, f)
         f.write("\n")

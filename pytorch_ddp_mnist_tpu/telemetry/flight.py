@@ -44,7 +44,22 @@ import time
 from typing import List, Optional
 
 DEFAULT_CAPACITY = 256
-_SCHEMA = 1
+# v2: every entry carries a `rank` field stamped at RECORD time (merged
+# multi-rank dumps are attributable; previously only some wireup entries
+# carried process identity). Backward-compatible: v1 dumps stay readable,
+# and the checker (scripts/check_telemetry.py) only enforces the rank
+# field on v2 payloads.
+_SCHEMA = 2
+
+
+def _env_rank() -> int:
+    """Pre-wireup default: the launcher's $RANK (the env wireup chain's
+    spelling), else 0 — the same seed faultpoints uses. cli/train rebinds
+    the real process index after rendezvous via `set_rank`."""
+    try:
+        return int(os.environ.get("RANK", "0"))
+    except ValueError:
+        return 0
 
 
 class FlightRecorder:
@@ -65,11 +80,16 @@ class FlightRecorder:
         self._lock = threading.RLock()
         self._recorded = 0  # total ever recorded (dropped = this - len)
         self.dump_dir: Optional[str] = None
+        self.rank = _env_rank()
 
     def record(self, kind: str, **fields) -> None:
         entry = {"t_wall": time.time(), "t_mono": time.perf_counter(),
                  "kind": str(kind)}
         entry.update(fields)
+        # rank stamped at record time (a producer that knows better — the
+        # fault injector's rank-gated specs — passes its own and wins)
+        if "rank" not in entry:
+            entry["rank"] = self.rank
         with self._lock:
             entry["seq"] = self._recorded
             self._recorded += 1
@@ -106,6 +126,7 @@ class FlightRecorder:
             "v": _SCHEMA,
             "reason": str(reason),
             "pid": os.getpid(),
+            "rank": self.rank,
             "dumped_t_wall": time.time(),
             "recorded": self._recorded,
             "dropped": self._recorded - len(entries),
@@ -142,6 +163,13 @@ def set_dump_dir(path: Optional[str]) -> None:
     """Route dumps next to the JSONL trace (cli/train wires `--telemetry
     DIR` here, so the post-mortem lands with the run's other evidence)."""
     _RECORDER.dump_dir = path
+
+
+def set_rank(rank: int) -> None:
+    """Late rank binding, the faultpoints.set_rank twin: cli/train calls
+    this after wireup so every later entry is stamped with the real
+    process index (pre-wireup entries carry the $RANK-seeded default)."""
+    _RECORDER.rank = int(rank)
 
 
 def dump(reason: str, path: Optional[str] = None) -> Optional[str]:

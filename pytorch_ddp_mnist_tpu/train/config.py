@@ -240,6 +240,18 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "scripts/check_telemetry.py DIR. Off by default — "
                         "disabled telemetry adds no per-step host sync. See "
                         "docs/OBSERVABILITY.md")
+    t.add_argument("--journal", action="store_true",
+                   help="write the per-rank COLLECTIVE journal beside the "
+                        "JSONL trace (telemetry/cluster.py: one record per "
+                        "payload collective the step program issues — seq/"
+                        "kind/bytes/bucket from the audited schedule, "
+                        "enter/exit stamps from the host boundary — plus a "
+                        "hang watchdog that flips /healthz when an entered "
+                        "collective never exits). Read it back with `trace "
+                        "report --cluster DIR`. Needs --telemetry and "
+                        "--parallel on the streaming XLA path; zero device "
+                        "syncs, bitwise-identical training. See "
+                        "docs/OBSERVABILITY.md §Cluster forensics")
     t.add_argument("--health", choices=("off", "warn", "checkpoint-and-warn",
                                         "abort"),
                    default="off",
@@ -352,7 +364,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "dtype": a.dtype, "impl": a.impl,
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,
-            "telemetry": a.telemetry,
+            "telemetry": a.telemetry, "journal": a.journal,
             "health": a.health, "metrics_port": a.metrics_port,
         },
         "data": {

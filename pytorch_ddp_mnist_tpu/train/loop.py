@@ -399,7 +399,8 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         step_hook: Callable | None = None,
         eval_perm: Callable | None = None,
         watchdog=None, model_apply: Callable | None = None,
-        input_workers: int = 0, prefetch_depth: int = 1) -> TrainState:
+        input_workers: int = 0, prefetch_depth: int = 1,
+        journal=None) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -446,6 +447,18 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     with workers live, and the consumer side adds zero host syncs —
     the data_wait span and the epoch-granular fetch budget
     (statics/sanitize.no_host_sync) hold unchanged. See docs/DATA.md.
+
+    `journal` (telemetry.cluster.CollectiveJournal) is the per-rank
+    collective journal: the step must declare its static collective
+    schedule (`step.collective_schedule` — the XLA DDP step does;
+    rejected by name otherwise), and every dispatched step then expands
+    into per-collective journal records sharing the step's host dispatch
+    window, while the end-of-epoch loss fetch — the host-side drain of
+    every step's collectives, where a dead peer actually wedges this
+    process — is bracketed as an open/close `flush` entry the collective
+    watchdog can age. Pure host clock reads + JSONL writes: journaled
+    training stays bitwise identical to unjournaled and adds zero host
+    syncs (pinned by tests/test_cluster.py under sanitize.no_host_sync).
     """
     from ..utils import faultpoints
 
@@ -486,6 +499,17 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             step.ddp_mesh, step.ddp_comm, step.ddp_devices, params,
             quant_block=getattr(step, "ddp_quant_block", None),
             bucket_elems=getattr(step, "ddp_bucket_elems", None))
+    if journal is not None:
+        schedule_fn = getattr(step, "collective_schedule", None)
+        if schedule_fn is None:
+            raise ValueError(
+                "journal= needs a train step that declares its collective "
+                "schedule (parallel.ddp.make_dp_train_step does); this "
+                "step carries none — the journal cannot attribute "
+                "collectives it cannot enumerate")
+        journal.bind_program(getattr(step, "ddp_comm", "?"),
+                             bool(getattr(step, "ddp_overlap", False)),
+                             schedule_fn(params))
     nsteps = len(train_loader)
     if start_epoch < epochs and start_offset >= nsteps:
         raise ValueError(f"start_offset={start_offset} >= the epoch's "
@@ -526,6 +550,16 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                 if batch is None:
                     break
                 x, y = batch
+                # journal stamps bracket the DISPATCH (clock reads only,
+                # and only when journaling): the step's collectives share
+                # this window; completion is observed at the bracketed
+                # flush. The wall stamp is the window's ENTER (the
+                # cross-rank comparison key — every rank stamps the same
+                # boundary of the same step).
+                if journal is not None:
+                    jt0, jt0w = time.perf_counter(), time.time()
+                else:
+                    jt0 = jt0w = 0.0
                 with step_timer:
                     if step_comm:
                         out = step(params, key, x, y, resid)
@@ -538,6 +572,9 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                         aux_list.append(aux)
                     else:
                         params, key, loss = step(params, key, x, y)
+                if journal is not None:
+                    journal.record_step(epoch * nsteps + i,
+                                        jt0, time.perf_counter(), jt0w)
                 # the nan value-fault point: poisons only this REPORTED
                 # loss (params untouched), staying on device — the
                 # watchdog's detection path, deterministically testable
@@ -554,7 +591,15 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                 i += 1
                 live.poll(losses)  # async bar update; never waits on device
             t_fetch = time.perf_counter()
+            # the epoch flush drains every dispatched step's collectives:
+            # bracketed as an open journal entry, because THIS is where a
+            # dead peer wedges the host — the collective watchdog ages it
+            # and the hang report names the pending seq range
+            fseq = (journal.enter("flush", axis="dp", steps=len(losses))
+                    if journal is not None else -1)
             losses = np.asarray(jnp.stack(losses))  # single fetch per epoch
+            if journal is not None:
+                journal.exit(fseq)
             fetch_s = time.perf_counter() - t_fetch
             # batches = STEPS this epoch (step_timer.count): io_timer also
             # wraps the end-of-epoch sentinel next() that returns None, so

@@ -5,7 +5,10 @@
     python scripts/check_telemetry.py events.jsonl        # or one file
     python scripts/check_telemetry.py --require ddp. DIR  # + metric gate:
         # fail unless the trace's registry snapshot carries at least one
-        # metric per --require prefix (repeatable; the ddp-smoke contract)
+        # metric per --require prefix (the ddp-smoke contract). Repeatable,
+        # AND one --require takes a comma-separated prefix list —
+        # `--require cluster.,ddp.` gates two metric families in ONE
+        # invocation (smoke scripts used to chain one process per family)
 
 Exit 0 when every `events*.jsonl` is schema-valid; nonzero (with one line
 per violation on stderr) on malformed JSON, unknown schema version or kind,
@@ -241,6 +244,40 @@ def check_file(path: str, errors: list) -> int:
     return n
 
 
+def check_flight_dump(path: str, errors: list) -> int:
+    """Validate a flight-recorder dump (`flight.<pid>.json`, dumped beside
+    the trace by --telemetry runs) — the merged-dump attribution contract:
+    a v2+ dump's entries each carry an int `rank` stamped at record time
+    (telemetry/flight.py), so a merged multi-rank post-mortem is
+    attributable. v1 dumps predate the field and are exempt (backward
+    compatibility is the dump READER's contract; the checker enforces only
+    what the writer of that schema version promised). Returns the entry
+    count."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except ValueError as e:
+        errors.append(f"{path}: malformed flight dump JSON ({e})")
+        return 0
+    if not isinstance(payload, dict) or not isinstance(
+            payload.get("entries"), list):
+        errors.append(f"{path}: flight dump is not an object with an "
+                      f"'entries' list")
+        return 0
+    v = payload.get("v")
+    for i, e in enumerate(payload["entries"]):
+        if not isinstance(e, dict):
+            errors.append(f"{path}: entry {i} is not an object")
+            continue
+        if isinstance(v, int) and v >= 2:
+            r = e.get("rank")
+            if not isinstance(r, int) or isinstance(r, bool):
+                errors.append(f"{path}: entry {i} "
+                              f"({e.get('kind', '?')}) missing the int "
+                              f"rank field a v{v} dump promises")
+    return len(payload["entries"])
+
+
 def _snapshot_metric_names(path: str) -> set:
     """All metric names appearing in a file's registry-snapshot records
     (counters + gauges + histograms). Tolerant of malformed lines — the
@@ -275,7 +312,15 @@ def main(argv=None) -> int:
         if i + 1 >= len(argv):
             print(__doc__.strip(), file=sys.stderr)
             return 2
-        require.append(argv[i + 1])
+        # one value may carry several comma-separated prefixes (gate N
+        # metric families in one invocation); empty segments — a trailing
+        # comma — are usage errors, not silently-satisfied gates
+        prefixes = [p.strip() for p in argv[i + 1].split(",")]
+        if not all(prefixes):
+            print(f"check_telemetry: --require {argv[i + 1]!r} contains "
+                  f"an empty prefix", file=sys.stderr)
+            return 2
+        require.extend(prefixes)
         del argv[i:i + 2]
     if len(argv) != 1:
         print(__doc__.strip(), file=sys.stderr)
@@ -299,6 +344,12 @@ def main(argv=None) -> int:
         if got == 0:
             errors.append(f"{path}: empty trace")
         total += got
+    if os.path.isdir(target):
+        # flight dumps landing beside the trace (set_dump_dir wires
+        # --telemetry DIR): validate the rank-attribution contract
+        for path in sorted(glob.glob(os.path.join(target,
+                                                  "flight.*.json"))):
+            total += check_flight_dump(path, errors)
     if require:
         names: set = set()
         for path in files:

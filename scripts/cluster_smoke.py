@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Cluster-forensics smoke: journal a small world, wedge a rank in a
+collective, and prove the forensics name the culprit.
+
+    python scripts/cluster_smoke.py [--world 2] [--workdir DIR] ...
+
+The front door of docs/OBSERVABILITY.md §Cluster forensics
+(`make cluster-smoke`). Three legs:
+
+  1. CLEAN   — a `--parallel --journal --telemetry` world trains one
+     epoch; every rank's collective journal must agree (no desync), the
+     `cluster.*` AND `ddp.*` metric families must gate in ONE
+     check_telemetry invocation (`--require cluster.,ddp.` — the
+     comma-prefix form), and the Perfetto export must carry the per-rank
+     collective tracks (with cross-rank seq flow arrows at world >= 2).
+  2. HANG    — the same world with `PDMT_FAULT=collective_timeout:rank=0`:
+     rank 0's startup barrier raises the DEADLINE_EXCEEDED-shaped error a
+     dead collective produces, its journal keeps the barrier's OPEN enter
+     record, and `trace report --cluster` must render a hang report
+     naming the stuck seq, its kind, and every rank's last journal
+     position — instead of the silent wedge the fault used to be.
+  3. DESYNC  — a synthetic journal pair recording DIFFERENT collectives
+     at the same seq: `trace report --cluster` must exit 3 naming both
+     ranks and the diverging collective. Process-free, so this leg runs
+     even in the world-1 fallback.
+
+Exit codes: 0 = every leg held; 1 = any leg failed; 75 = skipped, this
+jax has no CPU multiprocess collectives (rerun with --world 1 — the
+chaos_smoke convention, which `make cluster-smoke` does automatically).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, port: int, argv, world: int, extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(world),
+        "RANK": str(rank),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train", *argv],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _run_world(argv, world: int, timeout: float, extra_env=None):
+    port = _free_port()
+    procs = [_spawn(r, port, argv, world, extra_env) for r in range(world)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, err = p.communicate()
+            outs.append((None, out, err))
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+    return outs
+
+
+def _run_hang_world(argv, world: int, timeout: float, fault: str):
+    """Run a world expecting rank 0 to die at the faulted barrier; the
+    survivors (blocked in the barrier whose peer will never arrive) are
+    reaped once it does — the gang-scheduler model chaos_smoke uses.
+    Returns rank 0's (rc, out, err)."""
+    port = _free_port()
+    procs = [_spawn(r, port, argv, world,
+                    {"PDMT_FAULT": fault} if r == 0 else None)
+             for r in range(world)]
+    victim = procs[0]
+    deadline = time.monotonic() + timeout
+    while victim.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.25)
+    for p in procs[1:]:
+        if p.poll() is None:
+            p.kill()
+    rc = victim.poll()
+    out, err = victim.communicate()
+    for p in procs[1:]:
+        p.communicate()
+    return rc, out, err
+
+
+def _tool(args, timeout=120.0):
+    return subprocess.run(
+        [sys.executable, *args], cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")))
+
+
+def _forge_desync_pair(out_dir: str) -> None:
+    """Two journals recording DIFFERENT collectives at the same seq —
+    the synthetic desync the acceptance pins."""
+    os.makedirs(out_dir, exist_ok=True)
+    now = time.time()
+    for rank, (kind, nbytes) in enumerate(
+            (("allreduce", 1024), ("reduce_scatter", 512))):
+        name = "journal.jsonl" if rank == 0 else f"journal.rank{rank}.jsonl"
+        with open(os.path.join(out_dir, name), "w") as f:
+            f.write(json.dumps({"kind": "journal_start", "v": 1,
+                                "rank": rank, "world": 2,
+                                "t_wall": now, "t_mono": 0.0}) + "\n")
+            f.write(json.dumps({"kind": "coll", "seq": 0, "k": kind,
+                                "axis": "dp", "bytes": nbytes, "bucket": 0,
+                                "step": 0, "t_enter": 0.0, "t_exit": 0.1,
+                                "t_wall": now}) + "\n")
+            f.write(json.dumps({"kind": "journal_end", "seq": 1,
+                                "t_wall": now, "t_mono": 0.2}) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="collective-journal forensics smoke (clean / hang / "
+                    "desync legs)")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--keep_workdir", action="store_true")
+    a = ap.parse_args(argv)
+
+    # CPU multiprocess collectives need jax >= 0.5 (the chaos_smoke /
+    # test_multiprocess gate): absent capability = skip signal 75, and
+    # the Makefile reruns at --world 1.
+    import jax
+    if (a.world > 1
+            and tuple(int(x)
+                      for x in jax.__version__.split(".")[:2]) < (0, 5)):
+        print("cluster_smoke: SKIP — this jaxlib has no CPU multiprocess "
+              "collectives (needs jax >= 0.5)", file=sys.stderr)
+        return 75
+
+    work = a.workdir or tempfile.mkdtemp(prefix="pdmt_cluster_")
+    os.makedirs(work, exist_ok=True)
+    clean_dir = os.path.join(work, "clean")
+    hang_dir = os.path.join(work, "hang")
+    desync_dir = os.path.join(work, "desync")
+    base = ["--parallel", "--wireup_method", "env", "--kernel", "xla",
+            "--n_epochs", "1", "--limit", "256", "--batch_size", "64",
+            "--lr", "0.1", "--checkpoint", "",
+            "--path", os.path.join(work, "data")]
+
+    def fail(msg, *streams):
+        print(f"cluster_smoke: FAIL — {msg}", file=sys.stderr)
+        for s in streams:
+            print(s, file=sys.stderr)
+        return 1
+
+    # -- 1. CLEAN: journaled world, metric gate, report, export ----------
+    outs = _run_world(base + ["--journal", "--telemetry", clean_dir],
+                      a.world, a.timeout)
+    if any(rc != 0 for rc, _, _ in outs):
+        return fail("clean journaled world",
+                    *[f"rank {r} rc={rc}\n{o}\n{e}"
+                      for r, (rc, o, e) in enumerate(outs)])
+    # the comma-prefix form: TWO metric families, ONE checker invocation
+    chk = _tool([os.path.join(REPO, "scripts", "check_telemetry.py"),
+                 "--require", "cluster.,ddp.", clean_dir])
+    if chk.returncode != 0:
+        return fail("check_telemetry --require cluster.,ddp.",
+                    chk.stdout, chk.stderr)
+    rep = _tool(["-m", "pytorch_ddp_mnist_tpu", "trace", "report",
+                 "--cluster", "--json", clean_dir])
+    if rep.returncode != 0:
+        return fail("trace report --cluster (clean)", rep.stdout,
+                    rep.stderr)
+    report = json.loads(rep.stdout)
+    if (not report["desync"]["ok"] or report["n_ranks"] != a.world
+            or report["totals"]["collectives"] == 0
+            or report["hang"]["stuck"] is not None):
+        return fail(f"clean report wrong: {json.dumps(report)[:800]}")
+    exp = _tool(["-m", "pytorch_ddp_mnist_tpu", "trace", "export",
+                 clean_dir, "-o",
+                 os.path.join(clean_dir, "trace.chrome.json")])
+    if exp.returncode != 0:
+        return fail("trace export (clean)", exp.stdout, exp.stderr)
+    with open(os.path.join(clean_dir, "trace.chrome.json")) as f:
+        chrome = json.load(f)
+    colls = [e for e in chrome["traceEvents"]
+             if e.get("cat") == "collective"]
+    arrows = [e for e in chrome["traceEvents"]
+              if e.get("cat") == "collective_flow"]
+    if not colls:
+        return fail("chrome trace has no collective track events")
+    if a.world >= 2 and not any(e.get("ph") == "s" for e in arrows):
+        return fail("chrome trace has no cross-rank collective flow "
+                    "arrows at world >= 2")
+
+    # -- 2. HANG: injected collective_timeout names the stuck seq --------
+    rc, out, err = _run_hang_world(
+        base + ["--journal", "--telemetry", hang_dir], a.world,
+        a.timeout, "collective_timeout:rank=0")
+    if rc in (0, None) or "[cluster] collective timeout" not in err:
+        return fail(f"hang leg: rank 0 rc={rc}, expected the named "
+                    f"collective-timeout exit", out, err)
+    rep = _tool(["-m", "pytorch_ddp_mnist_tpu", "trace", "report",
+                 "--cluster", "--json", hang_dir])
+    if rep.returncode != 0:
+        return fail("trace report --cluster (hang)", rep.stdout,
+                    rep.stderr)
+    report = json.loads(rep.stdout)
+    stuck = report["hang"]["stuck"]
+    if stuck is None or stuck["kind"] != "barrier" or stuck["rank"] != 0:
+        return fail(f"hang report did not name the stuck barrier: "
+                    f"{json.dumps(report['hang'])[:800]}")
+    who = report["hang"]["who_is_where"]
+    if len(who) != a.world or not all("seq" in w for w in who):
+        return fail(f"who-is-where table incomplete: {who}")
+    human = _tool(["-m", "pytorch_ddp_mnist_tpu", "trace", "report",
+                   "--cluster", hang_dir])
+    if f"HANG: rank 0 entered collective seq {stuck['seq']}" \
+            not in human.stdout:
+        return fail("human hang report does not name the stuck seq",
+                    human.stdout)
+    # the flight dump beside the journals carries the fault + hang trail,
+    # rank-stamped (the checker validates the v2 rank contract)
+    chk = _tool([os.path.join(REPO, "scripts", "check_telemetry.py"),
+                 hang_dir])
+    if chk.returncode != 0:
+        return fail("check_telemetry on the hang dir", chk.stdout,
+                    chk.stderr)
+    if not report["faults"]:
+        return fail("hang report carries no flight fault context")
+
+    # -- 3. DESYNC: synthetic pair exits 3 naming both ranks -------------
+    _forge_desync_pair(desync_dir)
+    rep = _tool(["-m", "pytorch_ddp_mnist_tpu", "trace", "report",
+                 "--cluster", desync_dir])
+    if rep.returncode != 3:
+        return fail(f"desync leg: expected exit 3, got {rep.returncode}",
+                    rep.stdout, rep.stderr)
+    if "rank 0" not in rep.stderr or "rank 1" not in rep.stderr:
+        return fail("desync verdict does not name both ranks", rep.stderr)
+
+    print(json.dumps({
+        "cluster_smoke": "ok", "world": a.world,
+        "hang_seq": stuck["seq"], "hang_kind": stuck["kind"],
+        "desync_exit": 3,
+        "collective_track_events": len(colls),
+        "flow_arrows": sum(1 for e in arrows if e.get("ph") == "s"),
+    }))
+    if not a.keep_workdir and a.workdir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,6 +162,11 @@ def test_ddp_mode_contract_8_fake_devices():
         assert 0 < r["analytic_efficiency"] <= 1
         assert r["per_chip_batch"] == 16
         assert "peak_hbm_bytes" in r and "compile_s_total" in r
+        # the collective-journal stamps (telemetry/cluster.py): the
+        # static schedule length and the measured journaling cost share
+        # — the in-artifact half of the zero-overhead claim
+        assert r["collectives_per_step"] >= 1
+        assert 0 <= r["journal_overhead_share"] < 0.5
     assert by["pmean"]["parity_max_abs_diff_vs_pmean"] == 0.0
     assert by["sharded"]["parity_max_rel_diff_vs_pmean"] < 1e-6
     # the compressed wire is half the f32 wire, exactly

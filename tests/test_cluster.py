@@ -1,0 +1,623 @@
+"""Cluster forensics (telemetry/cluster.py): the per-rank collective
+journal's write/read round trip, the schedule-vs-cost-model parity, desync
+detection, hang forensics + the collective watchdog's /healthz flip, the
+Perfetto per-rank collective tracks with seq-aligned cross-rank arrows,
+the journal-schedule audit contract, and THE acceptance pins — journaled
+training bitwise identical to unjournaled, zero new host syncs, the
+checker's comma --require form, and the flight recorder's rank stamp."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_ddp_mnist_tpu.data import (BatchLoader, normalize_images,
+                                        synthetic_mnist)
+from pytorch_ddp_mnist_tpu.models import init_mlp
+from pytorch_ddp_mnist_tpu.parallel import ShardedSampler, collectives
+from pytorch_ddp_mnist_tpu.parallel.ddp import (batch_sharding,
+                                                make_dp_train_step,
+                                                replicated)
+from pytorch_ddp_mnist_tpu.parallel.mesh import make_mesh
+from pytorch_ddp_mnist_tpu.parallel.wireup import Runtime
+from pytorch_ddp_mnist_tpu.statics import jaxpr_audit, sanitize
+from pytorch_ddp_mnist_tpu.telemetry import MetricsRegistry, cluster, flight
+from pytorch_ddp_mnist_tpu.telemetry.health import health_summary
+from pytorch_ddp_mnist_tpu.train import TrainState, fit
+from pytorch_ddp_mnist_tpu.utils import faultpoints
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKER = os.path.join(REPO, "scripts", "check_telemetry.py")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= 8
+    return make_mesh([8], ["dp"], jax.devices()[:8])
+
+
+@pytest.fixture(autouse=True)
+def _null_journal():
+    # every test leaves the process-wide journal AND tracer disabled (the
+    # NullTracer hygiene contract) and the fault switchboard empty
+    yield
+    import pytorch_ddp_mnist_tpu.telemetry as telemetry
+    cluster.disable_journal(clean=False)
+    telemetry.disable()
+    faultpoints.install(None)
+
+
+def _params():
+    return init_mlp(jax.random.key(0))
+
+
+# ---------------------------------------------------------------------------
+# the static half: collective_schedule
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("comm", collectives.STRATEGIES)
+@pytest.mark.parametrize("overlap", [False, True])
+def test_schedule_bytes_sum_to_cost_model(comm, overlap):
+    sched = collectives.collective_schedule(_params(), 8, comm,
+                                            overlap=overlap)
+    assert sched, "every strategy issues payload collectives"
+    assert sum(e["bytes"] for e in sched) == collectives.bytes_on_wire(
+        _params(), 8, comm)
+    assert all(e["axis"] == "dp" for e in sched)
+
+
+def test_schedule_shapes_per_strategy():
+    leaves = len(jax.tree_util.tree_leaves(_params()))
+    assert len(collectives.collective_schedule(_params(), 8,
+                                               "pmean")) == leaves
+    assert [e["kind"] for e in collectives.collective_schedule(
+        _params(), 8, "pmean", overlap=True)] == ["allreduce"]
+    assert [e["kind"] for e in collectives.collective_schedule(
+        _params(), 8, "sharded")] == ["reduce_scatter", "all_gather"]
+    int8 = collectives.collective_schedule(_params(), 8, "int8")
+    assert [e["kind"] for e in int8] == ["all_to_all", "all_to_all",
+                                        "all_gather", "all_gather"]
+    assert [e["dtype"] for e in int8] == ["int8", "float32",
+                                         "int8", "float32"]
+
+
+def test_schedule_multi_bucket_and_one_device():
+    # a 40k-element bucket splits the 118k MLP into 3 buckets
+    sched = collectives.collective_schedule(_params(), 8, "sharded",
+                                            bucket_elems=40000)
+    assert len(sched) == 6 and {e["bucket"] for e in sched} == {0, 1, 2}
+    # 1-device meshes keep the schedule SHAPE with zero bytes (the ring
+    # moves nothing; seq numbering must not depend on world size)
+    one = collectives.collective_schedule(_params(), 1, "pmean")
+    assert len(one) == len(collectives.collective_schedule(_params(), 8,
+                                                           "pmean"))
+    assert all(e["bytes"] == 0 for e in one)
+
+
+def test_journal_schedule_audit_contract(monkeypatch):
+    """The statics pin: a schedule that disagrees with the walked program
+    fails the named `journal-schedule` contract (the matrix's passing
+    side runs in test_statics' full audit)."""
+    monkeypatch.setattr(collectives, "collective_schedule",
+                        lambda *a, **k: [])
+    with pytest.raises(jaxpr_audit.AuditViolation) as e:
+        jaxpr_audit.audit_step_program("pmean")
+    assert e.value.contract == "journal-schedule"
+
+
+# ---------------------------------------------------------------------------
+# journal write/read round trip
+# ---------------------------------------------------------------------------
+
+def _write_journal(out_dir, rank, *, steps=3, comm="pmean", close=True,
+                   open_kind=None, kinds=None, t0=None):
+    reg = MetricsRegistry()
+    j = cluster.CollectiveJournal(cluster.journal_path(out_dir, rank),
+                                  rank=rank, world=2, registry=reg)
+    sched = (collectives.collective_schedule(_params(), 8, comm)
+             if kinds is None else
+             [{"kind": k, "dtype": "float32", "axis": "dp", "elems": 10,
+               "bytes": b, "bucket": 0} for k, b in kinds])
+    j.bind_program(comm, False, sched)
+    base = time.time() if t0 is None else t0
+    for i in range(steps):
+        j.record_step(i, 0.0 + i, 0.001 + i, base + i)
+    if open_kind is not None:
+        j.enter(open_kind)
+    j.close(clean=close and open_kind is None)
+    return j, reg
+
+
+def test_journal_round_trip(tmp_path):
+    d = str(tmp_path)
+    j, reg = _write_journal(d, 0, steps=3)
+    loaded = cluster.load_journal(cluster.journal_path(d, 0))
+    per_step = len(collectives.collective_schedule(_params(), 8, "pmean"))
+    assert loaded["rank"] == 0 and loaded["world"] == 2
+    assert loaded["closed"] and not loaded["open"] and not loaded["errors"]
+    assert len(loaded["records"]) == 3 * per_step
+    assert [r["seq"] for r in loaded["records"]] == list(
+        range(3 * per_step))
+    snap = reg.snapshot()
+    assert snap["counters"]["cluster.collectives"] == 3 * per_step
+    assert snap["counters"]["cluster.bytes_on_wire"] == 3 * \
+        collectives.bytes_on_wire(_params(), 8, "pmean")
+    assert snap["gauges"]["cluster.seq"] == 3 * per_step
+    assert snap["gauges"]["cluster.journal_overhead_s"] >= 0
+
+
+def test_enter_exit_and_open_entry(tmp_path):
+    d = str(tmp_path)
+    reg = MetricsRegistry()
+    j = cluster.CollectiveJournal(cluster.journal_path(d, 0), rank=0,
+                                  registry=reg)
+    seq = j.enter("barrier")
+    assert j.open_entry()["seq"] == seq
+    j.exit(seq)
+    assert j.open_entry() is None
+    j.enter("flush", steps=4)
+    j.close(clean=False)            # a crash: no trailer
+    loaded = cluster.load_journal(cluster.journal_path(d, 0))
+    assert not loaded["closed"]
+    assert [r["k"] for r in loaded["records"]] == ["barrier"]
+    assert loaded["open"][0]["kind"] == "flush"
+    assert loaded["open"][0]["steps"] == 4
+
+
+def test_appended_rerun_reports_newest_segment(tmp_path):
+    """The append-mode contract (the outage-resume re-exec and plain
+    re-runs into one --telemetry dir): seq numbering restarts per
+    segment, so the reader covers each journal's NEWEST segment — a
+    stale segment's open flush must not read as a hang a later clean
+    run already superseded, and its seqs must not double-count."""
+    d = str(tmp_path)
+    # segment 1: a crashed run (open flush, no trailer) ...
+    _write_journal(d, 0, steps=2, kinds=[("allreduce", 100)],
+                   close=False, open_kind="flush")
+    # ... then the resumed run APPENDS a clean segment to the same file
+    _write_journal(d, 0, steps=3, kinds=[("allreduce", 100)])
+    loaded = cluster.load_journal(cluster.journal_path(d, 0))
+    assert loaded["segments"] == 2
+    assert loaded["closed"] and not loaded["open"]
+    assert len(loaded["records"]) == 3          # newest segment only
+    rep = cluster.cluster_report(d)
+    assert rep["hang"]["stuck"] is None
+    assert rep["totals"]["collectives"] == 3
+    assert rep["multi_segment_ranks"] == [0]
+    assert "NEWEST segment" in cluster.format_cluster_report(rep)
+
+
+def test_journal_files_single_file_name_rule(tmp_path):
+    """A non-journal file handed to the single-file resolver must not be
+    misparsed as a collective journal (the export CLI routes one target
+    through both the events and journal resolvers)."""
+    ev = tmp_path / "events.jsonl"
+    ev.write_text("{}\n")
+    assert cluster.journal_files(str(ev)) == []
+    j = tmp_path / "journal.rank3.jsonl"
+    j.write_text("{}\n")
+    assert cluster.journal_files(str(j)) == [str(j)]
+    assert cluster.journal_files(str(tmp_path / "absent.jsonl")) == []
+
+
+def test_wireup_barrier_is_journal_bracketed(tmp_path):
+    cluster.enable_journal(str(tmp_path), rank=0, world=1, watchdog=False,
+                           registry=MetricsRegistry())
+    Runtime(method="single").barrier()
+    cluster.disable_journal()
+    loaded = cluster.load_journal(cluster.journal_path(str(tmp_path), 0))
+    assert [r["k"] for r in loaded["records"]] == ["barrier"]
+    assert not loaded["open"] and loaded["closed"]
+
+
+def test_injected_collective_timeout_leaves_open_entry(tmp_path):
+    """The acceptance's hang half at unit scale: the collective_timeout
+    faultpoint fires INSIDE the journal bracket, so the barrier's enter
+    has no exit — the evidence the hang report and watchdog key on."""
+    cluster.enable_journal(str(tmp_path), rank=0, world=1, watchdog=False,
+                           registry=MetricsRegistry())
+    faultpoints.install("collective_timeout")
+    with pytest.raises(RuntimeError, match="DEADLINE_EXCEEDED"):
+        Runtime(method="single").barrier()
+    assert cluster.get_journal().open_entry()["kind"] == "barrier"
+    cluster.disable_journal(clean=False)
+    loaded = cluster.load_journal(cluster.journal_path(str(tmp_path), 0))
+    assert loaded["open"][0]["kind"] == "barrier"
+    assert not loaded["closed"]
+
+
+# ---------------------------------------------------------------------------
+# desync detection
+# ---------------------------------------------------------------------------
+
+def test_desync_same_seq_different_collective(tmp_path):
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=1, kinds=[("allreduce", 100)])
+    _write_journal(d, 1, steps=1, kinds=[("reduce_scatter", 50)])
+    rep = cluster.cluster_report(d)
+    assert not rep["desync"]["ok"]
+    v = rep["desync"]["violations"][0]
+    assert v["ranks"] == [0, 1] and v["seq"] == 0
+    assert "rank 0" in v["detail"] and "rank 1" in v["detail"]
+    assert "allreduce" in v["detail"] and "reduce_scatter" in v["detail"]
+
+
+def test_desync_position_of_closed_journals(tmp_path):
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=2, kinds=[("allreduce", 100)])
+    _write_journal(d, 1, steps=3, kinds=[("allreduce", 100)])
+    rep = cluster.cluster_report(d)
+    fields = {v["field"] for v in rep["desync"]["violations"]}
+    assert "position" in fields
+
+
+def test_crashed_rank_is_a_hang_story_not_a_desync(tmp_path):
+    # ranks run the SAME host program, so a wedged/killed rank leaves a
+    # PREFIX journal (every shared seq agrees) — a hang/crash story, NOT
+    # a desync verdict: rank 1 wedged in its epoch flush, rank 0 was
+    # reaped before flushing (neither wrote a trailer)
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=3, kinds=[("allreduce", 100)],
+                   close=False)
+    _write_journal(d, 1, steps=3, kinds=[("allreduce", 100)],
+                   close=False, open_kind="flush")
+    rep = cluster.cluster_report(d)
+    assert rep["desync"]["ok"]
+    assert rep["hang"]["stuck"]["rank"] == 1
+    assert rep["hang"]["stuck"]["kind"] == "flush"
+    who = {w["rank"]: w for w in rep["hang"]["who_is_where"]}
+    assert not who[0]["closed"] and not who[1]["closed"]
+    assert who[0]["open"] is None
+    assert who[1]["open"]["kind"] == "flush"
+
+
+def test_skew_names_the_worst_collective(tmp_path):
+    d = str(tmp_path)
+    t0 = 1000.0
+    _write_journal(d, 0, steps=3, kinds=[("allreduce", 100)], t0=t0)
+    # rank 1 enters every collective 50ms late, and seq 2 200ms late
+    reg = MetricsRegistry()
+    j = cluster.CollectiveJournal(cluster.journal_path(d, 1), rank=1,
+                                  world=2, registry=reg)
+    j.bind_program("pmean", False,
+                   [{"kind": "allreduce", "dtype": "float32", "axis": "dp",
+                     "elems": 10, "bytes": 100, "bucket": 0}])
+    for i, late in enumerate((0.05, 0.05, 0.2)):
+        j.record_step(i, 0.0 + i, 0.001 + i, t0 + i + late)
+    j.close()
+    rep = cluster.cluster_report(d)
+    pair = rep["skew"]["pairs"]["0-1"]
+    assert pair["n"] == 3
+    assert pair["p50_s"] == pytest.approx(0.05, rel=1e-6)
+    assert rep["skew"]["worst"]["seq"] == 2
+    assert rep["skew"]["worst"]["spread_s"] == pytest.approx(0.2, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the collective watchdog (live hang forensics)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_once_and_flips_healthz(tmp_path):
+    reg = MetricsRegistry()
+    j = cluster.CollectiveJournal(cluster.journal_path(str(tmp_path), 0),
+                                  rank=0, world=1, registry=reg)
+    before = flight.get_flight_recorder().recorded
+    wd = cluster.CollectiveWatchdog(j, timeout_s=0.05, registry=reg,
+                                    poll_s=0.01)
+    wd.start()
+    j.enter("barrier")
+    deadline = time.monotonic() + 5.0
+    while (reg.snapshot()["counters"].get("cluster.hangs", 0) == 0
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    time.sleep(0.1)   # would double-fire here if firing were not latched
+    wd.stop()
+    snap = reg.snapshot()
+    assert snap["counters"]["cluster.hangs"] == 1
+    assert snap["counters"]["health.fired.collective_hang"] == 1
+    assert snap["gauges"]["health.worst_severity_level"] == 2
+    # the /healthz verdict prom.py serves reads exactly this summary
+    assert health_summary(reg)["worst_severity"] == "fatal"
+    hangs = [e for e in flight.get_flight_recorder().snapshot()
+             if e["kind"] == "collective_hang" and e["seq"] >= before]
+    assert hangs and hangs[-1]["collective"] == "barrier"
+    assert hangs[-1]["who_is_where"][0]["open"]["kind"] == "barrier"
+    j.close(clean=False)
+
+
+def test_watchdog_silent_while_collectives_exit(tmp_path):
+    reg = MetricsRegistry()
+    j = cluster.CollectiveJournal(cluster.journal_path(str(tmp_path), 0),
+                                  rank=0, registry=reg)
+    wd = cluster.CollectiveWatchdog(j, timeout_s=0.05, registry=reg,
+                                    poll_s=0.01)
+    wd.start()
+    for _ in range(5):
+        seq = j.enter("barrier")
+        time.sleep(0.02)
+        j.exit(seq)
+    time.sleep(0.1)
+    wd.stop()
+    assert reg.snapshot()["counters"].get("cluster.hangs", 0) == 0
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# trace report --cluster CLI
+# ---------------------------------------------------------------------------
+
+def _trace_cli(argv):
+    from pytorch_ddp_mnist_tpu.cli import trace as trace_cli
+    return trace_cli.main(argv)
+
+
+def test_cluster_cli_ok_and_json(tmp_path, capsys):
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=2)
+    _write_journal(d, 1, steps=2)
+    assert _trace_cli(["report", "--cluster", "--json", d]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["report"] == "cluster_forensics"
+    assert rep["ranks"] == [0, 1] and rep["desync"]["ok"]
+
+
+def test_cluster_cli_desync_exits_3_naming_both_ranks(tmp_path, capsys):
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=1, kinds=[("allreduce", 100)])
+    _write_journal(d, 1, steps=1, kinds=[("all_gather", 100)])
+    assert _trace_cli(["report", "--cluster", d]) == 3
+    err = capsys.readouterr().err
+    assert "DESYNC" in err and "rank 0" in err and "rank 1" in err
+
+
+def test_cluster_cli_empty_target_exits_1(tmp_path, capsys):
+    assert _trace_cli(["report", "--cluster", str(tmp_path)]) == 1
+    assert "no journal*.jsonl" in capsys.readouterr().err
+
+
+def test_cluster_cli_rejects_baseline(tmp_path):
+    with pytest.raises(SystemExit) as e:
+        _trace_cli(["report", "--cluster", str(tmp_path),
+                    "--baseline", "x"])
+    assert e.value.code == 2
+
+
+def test_cluster_cli_hang_report_names_stuck_seq(tmp_path, capsys):
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=1, kinds=[("allreduce", 100)],
+                   close=False, open_kind="barrier")
+    _write_journal(d, 1, steps=1, kinds=[("allreduce", 100)])
+    assert _trace_cli(["report", "--cluster", d]) == 0
+    out = capsys.readouterr().out
+    assert "HANG: rank 0 entered collective seq 1 (barrier)" in out
+    assert "who-is-where" in out and "rank 1" in out
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: per-rank collective tracks + seq-aligned arrows
+# ---------------------------------------------------------------------------
+
+def test_export_collective_tracks_and_arrows(tmp_path):
+    from pytorch_ddp_mnist_tpu.telemetry.export import chrome_trace
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=2, kinds=[("allreduce", 100)], t0=1000.0)
+    _write_journal(d, 1, steps=2, kinds=[("allreduce", 100)], t0=1000.3)
+    trace = chrome_trace([], journal_paths=cluster.journal_files(d))
+    evs = trace["traceEvents"]
+    colls = [e for e in evs if e.get("cat") == "collective"]
+    # per-rank tracks: both pids present, on the collectives tid, with
+    # seq/bytes args riding each slice
+    assert {e["pid"] for e in colls} == {0, 1}
+    assert all(e["tid"] == 4 for e in colls)
+    assert all("seq" in e["args"] and "bytes" in e["args"]
+               for e in colls)
+    names = [e for e in evs if e.get("name") == "thread_name"
+             and e.get("args", {}).get("name") == "collectives"]
+    assert {e["pid"] for e in names} == {0, 1}
+    # seq-aligned arrows: one flow per shared seq, start and finish
+    # bound to the SAME seq's slices on the two ranks
+    starts = [e for e in evs if e.get("ph") == "s"
+              and e.get("cat") == "collective_flow"]
+    finishes = [e for e in evs if e.get("ph") == "f"
+                and e.get("cat") == "collective_flow"]
+    assert len(starts) == 2 and len(finishes) == 2
+    slice_ts = {(e["pid"], e["args"]["seq"]): e["ts"] for e in colls}
+    for s, f in zip(sorted(starts, key=lambda e: e["id"]),
+                    sorted(finishes, key=lambda e: e["id"])):
+        assert s["id"] == f["id"] and s["pid"] != f["pid"]
+        seq = int(s["name"].split()[-1])
+        assert s["ts"] == slice_ts[(s["pid"], seq)]
+        assert f["ts"] == slice_ts[(f["pid"], seq)]
+    # flow arrows land ON the collectives track
+    assert all(e["tid"] == 4 for e in starts + finishes)
+
+
+def test_export_open_entry_renders_as_open_slice(tmp_path):
+    from pytorch_ddp_mnist_tpu.telemetry.export import chrome_trace
+    d = str(tmp_path)
+    _write_journal(d, 0, steps=1, kinds=[("allreduce", 100)],
+                   close=False, open_kind="barrier")
+    trace = chrome_trace([], journal_paths=cluster.journal_files(d))
+    opens = [e for e in trace["traceEvents"]
+             if e.get("cat") == "collective" and e["args"].get("open")]
+    assert len(opens) == 1 and opens[0]["name"] == "barrier"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pins: journaled fit — bitwise, zero-sync, schedule-true
+# ---------------------------------------------------------------------------
+
+def _fit_once(mesh, journal=None, n=256, batch=64, epochs=1):
+    split = synthetic_mnist(n, seed=0)
+    test = synthetic_mnist(64, seed=1)
+    sampler = ShardedSampler(n, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(split.images), split.labels,
+                         sampler, batch_size=batch)
+    step = make_dp_train_step(mesh, lr=0.1)
+    state = TrainState(
+        jax.device_put(init_mlp(jax.random.key(0)), replicated(mesh)),
+        jax.device_put(jax.random.key(1), replicated(mesh)))
+    out = fit(state, loader, normalize_images(test.images),
+              test.labels.astype(np.int32), epochs=epochs,
+              batch_size=batch, train_step=step,
+              sharding=batch_sharding(mesh), log=lambda m: None,
+              journal=journal)
+    return jax.tree_util.tree_map(np.asarray, out.params)
+
+
+def test_journaled_fit_bitwise_and_schedule_true(tmp_path, mesh):
+    plain = _fit_once(mesh)
+    reg = MetricsRegistry()
+    j = cluster.CollectiveJournal(cluster.journal_path(str(tmp_path), 0),
+                                  rank=0, world=1, registry=reg)
+    journaled = _fit_once(mesh, journal=j)
+    j.close()
+    # bitwise: the journal never touches the program or the device
+    for a, b in zip(jax.tree_util.tree_leaves(plain),
+                    jax.tree_util.tree_leaves(journaled)):
+        assert np.array_equal(a, b)
+    loaded = cluster.load_journal(cluster.journal_path(str(tmp_path), 0))
+    per_step = len(collectives.collective_schedule(_params(), 8, "pmean"))
+    steps = 256 // 64
+    colls = [r for r in loaded["records"] if r.get("k") != "flush"]
+    flushes = [r for r in loaded["records"] if r.get("k") == "flush"]
+    assert len(colls) == steps * per_step
+    assert len(flushes) == 1 and not loaded["open"]   # epoch flush closed
+    assert loaded["program"]["comm"] == "pmean"
+    # the report side agrees end to end
+    rep = cluster.cluster_report(str(tmp_path))
+    assert rep["desync"]["ok"] and rep["hang"]["stuck"] is None
+    assert rep["totals"]["collectives"] == steps * per_step + 1
+
+
+def test_journaled_fit_zero_host_sync(tmp_path, mesh):
+    j = cluster.CollectiveJournal(cluster.journal_path(str(tmp_path), 0),
+                                  rank=0, registry=MetricsRegistry())
+    with sanitize.no_host_sync(max_block_until_ready=0,
+                               max_fetches=8) as stats:
+        _fit_once(mesh, journal=j)
+    j.close()
+    assert stats.block_until_ready_calls == 0
+
+
+def test_fit_rejects_scheduleless_step(tmp_path):
+    j = cluster.CollectiveJournal(cluster.journal_path(str(tmp_path), 0),
+                                  rank=0, registry=MetricsRegistry())
+    split = synthetic_mnist(128, seed=0)
+    sampler = ShardedSampler(128, num_replicas=1, rank=0, seed=42)
+    loader = BatchLoader(normalize_images(split.images), split.labels,
+                         sampler, batch_size=64)
+    state = TrainState(init_mlp(jax.random.key(0)), jax.random.key(1))
+    with pytest.raises(ValueError, match="collective schedule"):
+        fit(state, loader, normalize_images(split.images),
+            split.labels.astype(np.int32), epochs=1, batch_size=64,
+            lr=0.1, log=lambda m: None, journal=j)
+    j.close(clean=False)
+
+
+def test_measure_journal_overhead_is_small():
+    sched = collectives.collective_schedule(_params(), 8, "int8")
+    per_step = cluster.measure_journal_overhead(sched, steps=50)
+    assert 0 < per_step < 0.01   # tens of microseconds, not milliseconds
+
+
+# ---------------------------------------------------------------------------
+# --journal CLI knob hygiene (the by-name rejection contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("argv,match", [
+    (["--journal", "--parallel"], "--telemetry"),
+    (["--journal", "--telemetry", "tdir"], "--parallel"),
+    (["--journal", "--telemetry", "tdir", "--parallel", "--cached"],
+     "streaming"),
+    (["--journal", "--telemetry", "tdir", "--parallel", "--cached",
+      "--kernel", "pallas_epoch"], "streaming|comms"),
+])
+def test_journal_cli_hygiene(argv, match, tmp_path, monkeypatch):
+    from pytorch_ddp_mnist_tpu.cli import train as train_cli
+    monkeypatch.chdir(tmp_path)   # the relative telemetry dir lands here
+    with pytest.raises(SystemExit, match=match):
+        train_cli.main(argv)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder rank stamp + checker contracts
+# ---------------------------------------------------------------------------
+
+def test_flight_entries_carry_rank_stamped_at_record_time():
+    rec = flight.get_flight_recorder()
+    old = rec.rank
+    try:
+        flight.set_rank(3)
+        flight.record("cluster_test_probe")
+        flight.record("cluster_test_probe", rank=7)   # producer wins
+        entries = [e for e in rec.snapshot()
+                   if e["kind"] == "cluster_test_probe"]
+        assert [e["rank"] for e in entries[-2:]] == [3, 7]
+    finally:
+        flight.set_rank(old)
+
+
+def test_flight_dump_payload_carries_rank(tmp_path):
+    rec = flight.FlightRecorder()
+    rec.rank = 5
+    rec.record("probe")
+    path = rec.dump("test", path=str(tmp_path / "flight.1.json"))
+    payload = json.loads(open(path).read())
+    assert payload["v"] >= 2 and payload["rank"] == 5
+    assert all(isinstance(e["rank"], int) for e in payload["entries"])
+
+
+def _run_checker(args):
+    return subprocess.run([sys.executable, CHECKER, *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _valid_trace(tmp_path, metrics):
+    p = tmp_path / "events.jsonl"
+    recs = [{"v": 1, "kind": "meta", "name": "trace_start", "t_wall": 1.0,
+             "t_mono": 1.0, "proc": 0},
+            {"v": 1, "kind": "snapshot", "name": "registry", "t_wall": 2.0,
+             "t_mono": 2.0, "proc": 0,
+             "attrs": {"counters": metrics, "gauges": {},
+                       "histograms": {}}}]
+    p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(tmp_path)
+
+
+def test_checker_comma_require_one_invocation(tmp_path):
+    d = _valid_trace(tmp_path, {"cluster.collectives": 1,
+                                "ddp.bytes_on_wire": 2})
+    assert _run_checker(["--require", "cluster.,ddp.", d]).returncode == 0
+    bad = _run_checker(["--require", "cluster.,nope.", d])
+    assert bad.returncode == 1 and "nope." in bad.stderr
+    # a trailing comma is a usage error, not a silently-satisfied gate
+    assert _run_checker(["--require", "cluster.,", d]).returncode == 2
+    # the repeatable form still composes with the comma form
+    assert _run_checker(["--require", "cluster.", "--require", "ddp.",
+                         d]).returncode == 0
+
+
+def test_checker_validates_flight_dump_rank(tmp_path):
+    d = _valid_trace(tmp_path, {"x": 1})
+    dump = {"v": 2, "reason": "t", "pid": 1, "rank": 0, "recorded": 1,
+            "dropped": 0,
+            "entries": [{"kind": "probe", "t_wall": 1.0, "t_mono": 1.0,
+                         "seq": 0}]}         # <- no rank on the entry
+    (tmp_path / "flight.1.json").write_text(json.dumps(dump))
+    out = _run_checker([d])
+    assert out.returncode == 1 and "rank" in out.stderr
+    dump["entries"][0]["rank"] = 0
+    (tmp_path / "flight.1.json").write_text(json.dumps(dump))
+    assert _run_checker([d]).returncode == 0
+    # v1 dumps predate the field: exempt (backward compatibility)
+    del dump["entries"][0]["rank"]
+    dump["v"] = 1
+    (tmp_path / "flight.1.json").write_text(json.dumps(dump))
+    assert _run_checker([d]).returncode == 0

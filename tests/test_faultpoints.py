@@ -19,10 +19,18 @@ from pytorch_ddp_mnist_tpu.utils.faultpoints import (FaultInjector,
 
 @pytest.fixture(autouse=True)
 def _clean_injector(monkeypatch):
-    """Each test builds its own injector; none leaks into the next."""
+    """Each test builds its own injector; none leaks into the next.
+
+    The teardown must clear $PDMT_FAULT ITSELF before rebuilding: this
+    fixture depends on monkeypatch, so it finalizes BEFORE monkeypatch
+    restores the env — a test that setenv'd a fault spec would otherwise
+    have it rebuilt into the process-wide injector here and fire in a
+    LATER test file's first barrier/step (a real ordering-dependent leak
+    this suite shipped for several rounds)."""
     monkeypatch.delenv(faultpoints.FAULT_ENV, raising=False)
     faultpoints.install()
     yield
+    os.environ.pop(faultpoints.FAULT_ENV, None)
     faultpoints.install()
 
 

@@ -463,11 +463,13 @@ def test_thread_entry_map_on_the_real_tree():
     registered in the statics thread-entry map)."""
     import pytorch_ddp_mnist_tpu.pipeline.workers as workers_mod
     import pytorch_ddp_mnist_tpu.serve.batcher as batcher_mod
+    import pytorch_ddp_mnist_tpu.telemetry.cluster as cluster_mod
     import pytorch_ddp_mnist_tpu.telemetry.flight as flight_mod
     import pytorch_ddp_mnist_tpu.telemetry.prom as prom_mod
 
     auditor = concurrency.ConcurrencyAuditor()
-    for mod in (prom_mod, flight_mod, batcher_mod, workers_mod):
+    for mod in (prom_mod, flight_mod, batcher_mod, workers_mod,
+                cluster_mod):
         with open(mod.__file__, encoding="utf-8") as f:
             auditor.add_source(f.read(), mod.__file__)
     assert "serve_forever" in auditor.entries["thread"]
@@ -481,6 +483,9 @@ def test_thread_entry_map_on_the_real_tree():
     # via call_soon_threadsafe is audited as loop-resident
     assert "_reply_worker" in auditor.entries["thread"]
     assert "_scatter" in auditor.entries["loop"]
+    # the cluster-forensics collective watchdog (ISSUE 15): the hang
+    # detector's poll loop is a registered thread entry
+    assert "_watch" in auditor.entries["thread"]
 
 
 def test_lock001_groups_attributes_per_class():

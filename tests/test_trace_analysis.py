@@ -539,7 +539,10 @@ def test_flight_dump_payload_and_empty_behavior(tmp_path):
     path = rec.dump("test failure")
     assert path and os.path.exists(path)
     payload = json.loads(open(path).read())
-    assert payload["v"] == 1 and payload["reason"] == "test failure"
+    # schema v2 since the rank stamp (telemetry/flight.py): every entry
+    # carries process identity so merged multi-rank dumps attribute
+    assert payload["v"] == 2 and payload["reason"] == "test failure"
+    assert all(isinstance(e["rank"], int) for e in payload["entries"])
     assert payload["recorded"] == 1 and payload["dropped"] == 0
     assert payload["entries"][0]["kind"] == "backend_probe_error"
     assert payload["pid"] == os.getpid()
