@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -147,6 +147,22 @@ chaos-smoke:
 		{ rc=$$?; [ $$rc -eq 75 ] && \
 		JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py --world 1; }
 
+# Elastic smoke (docs/ROBUSTNESS.md §Elastic training): SIGKILL one rank
+# of a seeded 2-process `--elastic` run; the survivor must
+# rescue-checkpoint, re-wire into the world-1 membership under the next
+# world generation, and finish the run — then the world grows back to 2
+# with `--resume --reshape`, with loss-curve continuity asserted across
+# the whole cycle and the post-reshape collective schedule proven by
+# `trace report --cluster`, gated by `check_telemetry --require
+# elastic.,cluster.`. On a jaxlib without CPU multiprocess collectives
+# it degrades to the world-1 matrix (script exit 75 = the multiproc-skip
+# signal): reshape math, a kill/resume-with-reshape cycle, and a forged
+# 2-device manifest re-mapped down to 1.
+elastic-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/elastic_smoke.py || \
+		{ rc=$$?; [ $$rc -eq 75 ] && \
+		JAX_PLATFORMS=cpu $(PY) scripts/elastic_smoke.py --world 1; }
+
 # Static-analysis smoke (docs/STATIC_ANALYSIS.md): the source lint over
 # the whole package (zero unbaselined findings or exit 1) plus the
 # program auditor over the full comm x overlap x {step, run} matrix
@@ -206,7 +222,7 @@ cost-smoke:
 # the serve request-tracing round trip (also seconds), then the program
 # cost/memory harvest round trip, then the cluster-forensics round trip
 # (collective journal + hang attribution), then the fast test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke cluster-smoke test-fast
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke cluster-smoke elastic-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

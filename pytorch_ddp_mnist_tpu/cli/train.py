@@ -477,6 +477,40 @@ def main(argv=None) -> int:
             "--outage_retries needs per-epoch state to resume from; "
             "--fused runs all epochs as one device program with no "
             "mid-run state (use plain --cached)")
+    # --elastic knob hygiene (the unroll lesson): every configuration under
+    # which the reaction loop could not actually rescue/re-wire is rejected
+    # by name at parse time — not discovered at the first peer loss.
+    if tcfg["reshape"] is not None and not tcfg["elastic"]:
+        raise SystemExit(
+            "--reshape re-maps checkpoint geometry across an elastic "
+            "membership change; it needs --elastic")
+    if tcfg["elastic"]:
+        tcfg["reshape"] = tcfg["reshape"] or "global_batch"
+        if not tcfg["parallel"]:
+            raise SystemExit(
+                "--elastic reacts to the loss of a PEER rank; a serial run "
+                "has no peers — add --parallel")
+        if not tcfg["telemetry"]:
+            raise SystemExit(
+                "--elastic coordinates the surviving membership through "
+                "beacon files (and leaves its forensics) in the telemetry "
+                "directory; add --telemetry DIR")
+        if not (tcfg["checkpoint"] and tcfg["ckpt_every_steps"]):
+            raise SystemExit(
+                "--elastic rescues into (and resumes out of) the "
+                "step-checkpoint directory; pass a non-empty --checkpoint "
+                "and --ckpt_every_steps N")
+        if tcfg["cached"]:
+            raise SystemExit(
+                "--elastic keeps a per-step host-side rescue stash on "
+                "every rank; --cached/--fused run steps inside a jitted "
+                "scan with no per-step host control — drop --cached")
+        if argv is not None:
+            raise SystemExit(
+                "--elastic re-wires the surviving world by re-exec'ing the "
+                "process and is only available from the CLI (argv=None); "
+                "programmatic callers should relaunch with --resume and "
+                "--reshape instead")
     if tcfg["dropout_rng"] == "torch":
         # The torch mask stream is drawn on the HOST per step (exactly like
         # torch) — that shape fits only the serial streaming loop. The
@@ -643,6 +677,47 @@ def main(argv=None) -> int:
         # the resume fast-forward needs the epoch's step count)
         num_shards = local_shards = 1
 
+    # Elastic geometry pre-pass (--elastic --reshape, elastic/reshape.py):
+    # under `global_batch` mode the per-device micro-batch is DERIVED from
+    # the manifest (manifest global_batch / surviving devices), and the
+    # data plane below sizes its loader from it — so the manifest meta is
+    # peeked (no payload touch) BEFORE global_batch/local_batch bind. The
+    # full restore further down still verifies payload intactness.
+    reshape_plan = None
+    if tcfg["elastic"] and tcfg["resume"] and os.path.isdir(tcfg["resume"]):
+        from ..elastic import ReshapeError, plan_reshape
+        from ..train.ckpt_manager import peek_latest_meta
+        peek = peek_latest_meta(tcfg["resume"])
+        if peek and "global_batch" in peek.get("meta", {}):
+            old_gb = int(peek["meta"]["global_batch"])
+            old_devices = int(peek["meta"].get("devices") or num_shards)
+            try:
+                reshape_plan = plan_reshape(
+                    old_gb, old_devices, num_shards, mode=tcfg["reshape"],
+                    per_device_batch=tcfg["batch_size"])
+            except ReshapeError as e:
+                raise SystemExit(f"--reshape: {e}")
+            if tcfg["reshape"] == "global_batch":
+                tcfg["batch_size"] = reshape_plan.per_device_batch
+
+    if tcfg["elastic"]:
+        # startup stamps: the generation/world gauges scrapes and registry
+        # snapshots read, the run-start flight marker, and beacon hygiene —
+        # rank 0 sweeps every PAST generation's beacons so a later shrink
+        # round starts clean (the CURRENT round's set went quiet before any
+        # survivor re-exec'd; stragglers past the settle window were
+        # already counted dead).
+        from ..elastic import clear_beacons, world_generation
+        _gen = world_generation()
+        telemetry.get_registry().gauge("elastic.generation").set(_gen)
+        telemetry.get_registry().gauge("elastic.world").set(num_processes)
+        telemetry.flight.record("elastic_run_start", generation=_gen,
+                                world=num_processes, rank=process_index,
+                                reshape=tcfg["reshape"])
+        if process_index == 0:
+            for g in range(_gen + 1):
+                clear_beacons(tcfg["telemetry"], g)
+
     global_batch = tcfg["batch_size"] * num_shards
     local_batch = tcfg["batch_size"] * local_shards
 
@@ -774,15 +849,18 @@ def main(argv=None) -> int:
         # would silently re-interpret the position and walk off the
         # bitwise trajectory. Refuse by name instead.
         geometry = _run_geometry(tcfg, dcfg, global_batch)
-        mismatch = {k: (v, geometry[k]) for k, v in restored.meta.items()
-                    if k in geometry and geometry[k] != v}
-        if mismatch:
-            raise SystemExit(
-                "--resume: checkpoint was written under different run "
-                "geometry; its (epoch, offset) would address different "
-                "batches: " + ", ".join(
-                    f"{k}: checkpoint={v[0]!r} vs this run={v[1]!r}"
-                    for k, v in sorted(mismatch.items())))
+        from ..train.ckpt_manager import geometry_mismatch_message
+        manifest_geo = {k: v for k, v in restored.meta.items()
+                        if k in geometry}
+        if tcfg["elastic"] and reshape_plan is not None:
+            # global_batch is the ONE stamp an elastic reshape re-maps
+            # (the plan below); the rest — limit/sampler_rng/model/
+            # param_scale — stay hard refusals (reshape re-splits a world,
+            # it does not reinterpret a dataset or a model)
+            manifest_geo.pop("global_batch", None)
+        refusal = geometry_mismatch_message(manifest_geo, geometry)
+        if refusal:
+            raise SystemExit("--resume: " + refusal)
         absent = sorted(k for k in geometry if k not in restored.meta)
         if absent:
             # a manifest written through the raw manager API (no CLI
@@ -811,27 +889,77 @@ def main(argv=None) -> int:
                   "residual this run's comm strategy never reads "
                   f"(--ddp_comm {tcfg['ddp_comm']}); ignoring it",
                   file=sys.stderr, flush=True)
-        if carries_resid and restored.resid is not None and mesh is not None:
+        resume_resid = restored.resid if carries_resid else None
+        resume_offset = restored.offset
+        if tcfg["elastic"] and reshape_plan is not None:
+            # The deliberate geometry re-mapping (elastic/reshape.py,
+            # semantics pinned by tests/test_elastic.py): offset under the
+            # new global batch, residual folded/grown/dropped per mode.
+            from ..elastic import (ReshapeError, plan_reshape,
+                                   remap_offset, remap_residual)
+            if (resume_resid is not None
+                    and int(np.asarray(resume_resid).shape[0])
+                    != reshape_plan.old_devices):
+                # a pre-elastic manifest carries no "devices" stamp and the
+                # pre-pass guessed; the residual's row count is the actual
+                # old device count — re-plan against it
+                try:
+                    reshape_plan = plan_reshape(
+                        reshape_plan.old_global_batch,
+                        int(np.asarray(resume_resid).shape[0]), num_shards,
+                        mode=tcfg["reshape"],
+                        per_device_batch=tcfg["batch_size"])
+                except ReshapeError as e:
+                    raise SystemExit(f"--reshape: {e}")
+            if reshape_plan.changed:
+                try:
+                    resume_offset = remap_offset(restored.offset,
+                                                 reshape_plan)
+                    resume_resid, resid_disp = remap_residual(resume_resid,
+                                                              reshape_plan)
+                except ReshapeError as e:
+                    raise SystemExit(f"--reshape: {e}")
+                telemetry.flight.record(
+                    "elastic_reshape", mode=reshape_plan.mode,
+                    old_global_batch=reshape_plan.old_global_batch,
+                    new_global_batch=reshape_plan.new_global_batch,
+                    old_devices=reshape_plan.old_devices,
+                    new_devices=reshape_plan.new_devices,
+                    offset_in=restored.offset, offset_out=resume_offset,
+                    resid=resid_disp)
+                telemetry.get_registry().counter("elastic.reshapes").inc()
+                print(f"[elastic] reshaped checkpoint geometry "
+                      f"({reshape_plan.mode}): global_batch "
+                      f"{reshape_plan.old_global_batch} -> "
+                      f"{reshape_plan.new_global_batch}, devices "
+                      f"{reshape_plan.old_devices} -> "
+                      f"{reshape_plan.new_devices}, offset "
+                      f"{restored.offset} -> {resume_offset}, residual "
+                      f"{resid_disp}", file=sys.stderr, flush=True)
+        if carries_resid and resume_resid is not None and mesh is not None:
             # Residual-geometry guard: the error-feedback state is
             # per-DEVICE (one row per mesh device), so _run_geometry's
             # batch/model stamp cannot catch a device-count change — an
             # 8-device residual has no meaning on a 4-device mesh. Refuse
             # by name here like every other geometry mismatch, instead of
-            # surfacing place_comm_state's ValueError mid-fit.
-            resid_rows = int(np.asarray(restored.resid).shape[0])
+            # surfacing place_comm_state's ValueError mid-fit. (An elastic
+            # resume re-mapped the rows above and sails through.)
+            resid_rows = int(np.asarray(resume_resid).shape[0])
             if resid_rows != int(mesh.devices.size):
                 raise SystemExit(
                     f"--resume: checkpoint's int8 error-feedback residual "
                     f"was saved on {resid_rows} device(s); this run has "
                     f"{int(mesh.devices.size)} — per-device residuals "
                     f"cannot be re-sharded across a different mesh size "
-                    f"(resume on {resid_rows} device(s), or restart the "
-                    f"run fresh and lose one step's quantization error)")
+                    f"(resume on {resid_rows} device(s), re-map them with "
+                    f"--elastic --reshape global_batch|per_rank, or "
+                    f"restart the run fresh and lose one step's "
+                    f"quantization error)")
         state = TrainState(restored.params, jax.random.wrap_key_data(
             jax.numpy.asarray(restored.key_data), impl=restored.impl),
-            resid=restored.resid if carries_resid else None)
+            resid=resume_resid)
         tcfg["start_epoch"] = restored.epoch
-        start_offset = restored.offset
+        start_offset = resume_offset
         start_step = restored.step
         # the manifest's PRNG engine is authoritative for the restored key
         # chain; everything downstream (stash keys, sidecars, new step
@@ -962,6 +1090,14 @@ def main(argv=None) -> int:
     # a healthy run: it degrades to a flight-recorder entry and a stderr
     # line (durability shrinks; training continues).
     step_hook = None
+    _ckpt_meta = _run_geometry(tcfg, dcfg, global_batch)
+    if tcfg["elastic"]:
+        # elastic manifests additionally stamp the device count (the
+        # reshape pre-pass plans from it; pre-elastic manifests fall back
+        # to the residual's row count) and the world generation
+        from ..elastic import world_generation as _world_generation
+        _ckpt_meta = {**_ckpt_meta, "devices": num_shards,
+                      "elastic_gen": _world_generation()}
     if tcfg["ckpt_every_steps"] and process_index == 0:
         from ..train.checkpoint import CheckpointError
         from ..train.ckpt_manager import CheckpointManager
@@ -996,13 +1132,55 @@ def main(argv=None) -> int:
                 step_mgr.save(st.params,
                               np.asarray(jax.random.key_data(st.key)),
                               tcfg["impl"], step=gs, epoch=ep, offset=off,
-                              meta=_run_geometry(tcfg, dcfg, global_batch),
-                              resid=resid)
+                              meta=_ckpt_meta, resid=resid)
             except CheckpointError as e:
                 telemetry.flight.record("checkpoint_save_failed", step=gs,
                                         error=str(e)[:500])
                 print(f"[ckpt] step checkpoint save failed (training "
                       f"continues): {e}", file=sys.stderr, flush=True)
+
+    # --elastic: EVERY rank keeps a host-side copy of the last step-hook
+    # state (the elastic stash). The rescue leader after a peer loss is the
+    # lowest SURVIVING rank — often not rank 0, since rank 0 may be the
+    # dead one — and a rescue can only pin what this rank stashed. Rides
+    # the existing step-hook cadence (--ckpt_every_steps, which --elastic
+    # requires): host copies of replicated arrays every N steps, no extra
+    # device work.
+    elastic_stash = {}
+    coordinator = None
+    if tcfg["elastic"]:
+        from ..elastic import ElasticCoordinator
+        _ckpt_step_hook = step_hook
+
+        def _stash_state(ep, off, gs, st):
+            elastic_stash["epoch"] = ep
+            elastic_stash["offset"] = off
+            elastic_stash["step"] = gs
+            elastic_stash["params"] = jax.tree_util.tree_map(np.asarray,
+                                                             st.params)
+            elastic_stash["key"] = np.asarray(jax.random.key_data(st.key))
+            # same multi-host degrade as the step hook above: a
+            # non-addressable residual is dropped from the stash (a rescue
+            # reseeds zeros — one step's quantization error, not the run)
+            elastic_stash["resid"] = (
+                np.asarray(st.resid) if st.resid is not None
+                and getattr(st.resid, "is_fully_addressable", True)
+                else None)
+
+        def step_hook(ep, off, gs, st):  # noqa: F811 — elastic wrapper
+            _stash_state(ep, off, gs, st)
+            if _ckpt_step_hook is not None:
+                _ckpt_step_hook(ep, off, gs, st)
+
+        # seed with the starting state so a peer loss BEFORE the first
+        # checkpoint interval still has something to rescue
+        _stash_state(tcfg["start_epoch"], start_offset, start_step, state)
+        coordinator = ElasticCoordinator(
+            steps_dir=tcfg["checkpoint"] + ".steps",
+            telemetry_dir=tcfg["telemetry"], rank=process_index,
+            world=num_processes, reshape_mode=tcfg["reshape"],
+            impl=tcfg["impl"], geometry=_ckpt_meta,
+            ckpt_keep=tcfg["ckpt_keep"])
 
     # --eval_shuffle: the reference's shuffled test loader, engine-faithful
     # (torch-bitwise MT19937 randperm, seeded --seed + epoch since the
@@ -1108,6 +1286,23 @@ def main(argv=None) -> int:
                        input_workers=tcfg["input_workers"],
                        prefetch_depth=tcfg["prefetch_depth"],
                        journal=journal)
+    if coordinator is not None:
+        # The elastic reaction intercepts BEFORE the outage machinery: a
+        # RuntimeError with a backend-loss signature may be a DEAD PEER
+        # (membership change — rescue, re-rank, re-exec into the surviving
+        # world; react never returns) rather than a transient backend blip.
+        # react re-raises when it is NOT a peer loss — a program error, or
+        # every rank beaconed back (nobody died) — and the error falls
+        # through to _train_with_outage_retry's existing triage unchanged.
+        _plain_run_fit = run_fit
+
+        def run_fit(st, start):  # noqa: F811 — elastic wrapper
+            try:
+                return _plain_run_fit(st, start)
+            except RuntimeError as e:
+                coordinator.react(e, elastic_stash, journal=journal)
+                raise
+
     from ..telemetry.health import TrainingHealthError
     try:
         state = _train_with_outage_retry(run_fit, state, tcfg, stash, trace,

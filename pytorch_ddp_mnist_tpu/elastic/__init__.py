@@ -1,0 +1,18 @@
+"""Preemption-tolerant elastic training (docs/ROBUSTNESS.md §Elastic).
+
+`coordinator.py` is the per-rank reaction loop over the PR 14-15 detection
+signals (peer loss -> rescue -> membership beacons -> re-wire under the
+next world generation); `reshape.py` is the checkpoint-geometry re-mapping
+(`--reshape global_batch|per_rank`) that lets a manifest written at one
+world size resume at another. `--elastic` off leaves training
+bitwise-identical to the non-elastic CLI (pinned by tests/test_elastic.py).
+"""
+
+from .coordinator import (ElasticCoordinator, ElasticHandoffError,  # noqa: F401
+                          classify_peer_loss, clear_beacons,
+                          collect_membership, next_generation,
+                          read_beacons, rendezvous_port, world_generation,
+                          write_beacon)
+from .reshape import (RESHAPE_MODES, ReshapeError, ReshapePlan,  # noqa: F401
+                      plan_reshape, remap_offset, remap_residual,
+                      reshape_checkpoint, reshard_sampler)

@@ -1,0 +1,398 @@
+"""Per-rank elastic reaction loop — shrink the world without losing the run.
+
+PRs 14-15 built the SENSORY layer: the per-rank collective journal, the
+hang watchdog, `looks_like_backend_loss`. This module is the REACTION: when
+a peer dies mid-run, the surviving ranks (each independently — a dead rank
+cannot coordinate anyone)
+
+  1. DETECT   — the collective that wedged on the dead peer surfaces as a
+     backend-loss RuntimeError (`classify_peer_loss` pairs it with the
+     journal's open entry and the watchdog's hang flip as evidence);
+  2. RESCUE   — the lowest SURVIVING rank commits the last stashed state as
+     a PINNED step checkpoint (the PR 6 rescue path: exempt from
+     keep-last-N rotation) so the resume point cannot rotate away;
+  3. MEMBERSHIP — survivors agree on who is left through beacon files in
+     the shared checkpoint directory (the same shared-fs contract step
+     checkpoints already require): each alive rank writes
+     `elastic.gen<G>.rank<R>`, waits a settle window, and reads the set
+     back — dead ranks never write, so the beacon set IS the surviving
+     membership, and dense re-ranking (sorted order) gives the new ranks;
+  4. RE-WIRE  — survivors wait for the backend out-of-process (jittered
+     exponential backoff, `parallel.wireup.backoff_schedule`, every probe
+     flight-recorded) and then re-exec into a fresh CLI invocation with
+     RANK/WORLD_SIZE/MASTER_PORT env for the surviving membership under
+     the NEXT world generation. Process replacement is the teardown: a
+     wedged jax client cannot be re-initialized in place (its bridge lock
+     may be held forever — the same reason the outage path re-execs), and
+     the fresh processes re-rendezvous through `parallel/wireup.py`
+     cleanly;
+  5. RESHAPE + CONTINUE — the re-exec'd run resumes from the rescue
+     checkpoint with `--reshape` re-mapping the manifest geometry
+     (elastic/reshape.py) instead of refusing it.
+
+GROW is scheduler-initiated: a dead process cannot resurrect itself, so
+when capacity returns the launcher relaunches the FULL world with
+`--resume <steps dir> --elastic --reshape MODE`; the same reshape path
+re-maps the shrunken-world manifest up (residual rows grow with zeros,
+offset re-maps) under the next generation. `scripts/elastic_smoke.py`
+drives the whole shrink-to-1/grow-back cycle.
+
+World-generation rules (docs/ROBUSTNESS.md §Elastic training):
+  * generation 0 is the original launch; `PDMT_ELASTIC_GEN` carries it
+    across re-execs and every checkpoint stamps its generation in meta;
+  * the counter increments on EVERY membership change (shrink or grow),
+    never reuses a value (monotonic), and a resume at unchanged geometry
+    keeps its generation;
+  * MASTER_PORT for generation G's rendezvous is base_port + G — every
+    survivor derives the same port without communicating, and the old
+    coordinator's socket (possibly held by a dead or wedged process) is
+    never reused.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+GEN_ENV = "PDMT_ELASTIC_GEN"
+_BEACON_RE = re.compile(r"^elastic\.gen(\d+)\.rank(\d+)$")
+
+# How long membership collection waits after the LAST new beacon before
+# trusting the set (every survivor hits the dead collective within one
+# step of each other; the window only needs to cover scheduling skew).
+SETTLE_S = float(os.environ.get("PDMT_ELASTIC_SETTLE_S", "5.0"))
+# Total membership deadline: a survivor that never beacons (wedged before
+# reaching the coordinator) is treated as dead — the run continues without
+# it rather than waiting forever.
+MEMBER_DEADLINE_S = float(os.environ.get("PDMT_ELASTIC_MEMBER_S", "60.0"))
+
+
+def world_generation() -> int:
+    """This process's world generation (0 = original launch)."""
+    try:
+        gen = int(os.environ.get(GEN_ENV, "0"))
+    except ValueError:
+        return 0
+    return max(gen, 0)
+
+
+def next_generation(current: int) -> int:
+    """Monotonic: every membership change mints a fresh generation."""
+    return int(current) + 1
+
+
+def rendezvous_port(base_port: int, generation: int) -> int:
+    """Generation G rendezvouses on base + G: derivable by every survivor
+    with no communication, never reusing a port a dead world may hold."""
+    return int(base_port) + int(generation)
+
+
+def beacon_path(directory: str, generation: int, rank: int) -> str:
+    return os.path.join(directory, f"elastic.gen{generation}.rank{rank}")
+
+
+def write_beacon(directory: str, generation: int, rank: int) -> str:
+    """Mark this rank alive for `generation`'s membership round. Atomic
+    (O_CREAT on a final name — no rename needed for an empty marker)."""
+    os.makedirs(directory, exist_ok=True)
+    path = beacon_path(directory, generation, rank)
+    with open(path, "w") as f:
+        f.write(f"{time.time()}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return path
+
+
+def read_beacons(directory: str, generation: int) -> list:
+    """Ranks with a beacon for `generation`, sorted ascending."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _BEACON_RE.match(name)
+        if m and int(m.group(1)) == generation:
+            out.append(int(m.group(2)))
+    return sorted(set(out))
+
+
+def collect_membership(directory: str, generation: int, rank: int, *,
+                       settle_s: float = None,
+                       deadline_s: float = None,
+                       poll_s: float = 0.25) -> list:
+    """Beacon, then wait for the survivor set to go QUIET: the membership
+    is accepted once no new beacon has appeared for `settle_s` (bounded by
+    `deadline_s` total). Every survivor runs this independently and — the
+    set being monotone-growing and the settle window shared — lands on the
+    same answer, so the dense re-rank below is consistent without any
+    collective (there is no working collective to use)."""
+    settle_s = SETTLE_S if settle_s is None else settle_s
+    deadline_s = MEMBER_DEADLINE_S if deadline_s is None else deadline_s
+    write_beacon(directory, generation, rank)
+    deadline = time.monotonic() + deadline_s
+    seen = read_beacons(directory, generation)
+    quiet_since = time.monotonic()
+    while time.monotonic() < deadline:
+        if time.monotonic() - quiet_since >= settle_s:
+            break
+        time.sleep(poll_s)
+        now = read_beacons(directory, generation)
+        if now != seen:
+            seen = now
+            quiet_since = time.monotonic()
+    return seen
+
+
+def clear_beacons(directory: str, generation: Optional[int] = None) -> None:
+    """Drop beacon files (all, or one generation's) — the resumed run's
+    startup hygiene so a later shrink round starts clean."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        m = _BEACON_RE.match(name)
+        if m and (generation is None or int(m.group(1)) == generation):
+            try:
+                os.unlink(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
+def classify_peer_loss(exc: BaseException, journal=None) -> dict:
+    """The detection evidence bundle: does this error look like a peer
+    died, and what does the sensory layer know about where? Consumes the
+    PR 14-15 signals — `looks_like_backend_loss` (the gRPC signatures a
+    dead peer's collective surfaces), the journal's OPEN entry (the exact
+    collective the world wedged in), and the watchdog's health flip (the
+    `health.worst_severity_level` gauge `report_hang` raises to 2)."""
+    from ..parallel.wireup import looks_like_backend_loss
+    evidence = {"backend_loss": looks_like_backend_loss(exc),
+                "error": str(exc)[:500], "open_entry": None,
+                "hang_flagged": False}
+    if journal is not None:
+        entry = journal.open_entry()
+        if entry:
+            evidence["open_entry"] = {k: entry.get(k)
+                                      for k in ("seq", "kind", "axis")}
+    try:
+        from ..telemetry import get_registry
+        worst = get_registry().snapshot()["gauges"].get(
+            "health.worst_severity_level")
+        evidence["hang_flagged"] = bool(worst is not None and worst >= 2)
+    except Exception:  # noqa: BLE001 — evidence gathering must never mask
+        pass           # the original failure
+    return evidence
+
+
+class ElasticHandoffError(RuntimeError):
+    """The elastic reaction could not complete (no survivors agreed, no
+    rescue state, backend never returned) — surfaced by name so the
+    caller's outage machinery (or the user) takes over."""
+
+
+@dataclass
+class ElasticCoordinator:
+    """One rank's reaction loop. Built by cli.train when --elastic is on;
+    `react()` is called with the escaped collective error and either
+    re-execs this process into the surviving world (never returns) or
+    raises — it NEVER returns normally."""
+    steps_dir: str            # the shared step-checkpoint directory
+    telemetry_dir: str        # beacons + flight dumps live here
+    rank: int
+    world: int
+    reshape_mode: str
+    impl: str                 # PRNG engine for the rescue save
+    geometry: dict            # _run_geometry stamp for the rescue save
+    ckpt_keep: int = 3
+    settle_s: float = None
+    member_deadline_s: float = None
+    argv_tail: list = field(default_factory=lambda: None)  # None = sys.argv[1:]
+
+    def react(self, exc: BaseException, stash: dict, journal=None):
+        """Detect -> rescue -> membership -> re-wire -> re-exec."""
+        from ..telemetry import flight, get_registry
+        from ..parallel.wireup import (_subprocess_backend_healthy,
+                                       backend_wait_env, backoff_schedule)
+
+        gen = world_generation()
+        evidence = classify_peer_loss(exc, journal)
+        if not evidence["backend_loss"]:
+            raise exc  # a program error, not a peer loss — fail fast
+        flight.record("elastic_peer_loss", generation=gen, world=self.world,
+                      rank=self.rank, **{k: v for k, v in evidence.items()
+                                         if k != "error"},
+                      error=evidence["error"])
+        get_registry().counter("elastic.peer_loss").inc()
+        if journal is not None:
+            # dirty close: the open entry STAYS open in the file — that is
+            # the hang evidence `trace report --cluster` attributes
+            from ..telemetry import cluster
+            cluster.disable_journal(clean=False)
+
+        # -- membership: who else is still alive? -------------------------
+        new_gen = next_generation(gen)
+        survivors = collect_membership(
+            self.telemetry_dir, new_gen, self.rank,
+            settle_s=self.settle_s, deadline_s=self.member_deadline_s)
+        if self.rank not in survivors:  # (cannot happen: we beaconed)
+            survivors = sorted(set(survivors) | {self.rank})
+        if len(survivors) >= self.world:
+            # every rank beaconed: nobody died — a transient backend blip,
+            # not a membership change. Hand back to the outage machinery.
+            clear_beacons(self.telemetry_dir, new_gen)
+            flight.record("elastic_no_peer_lost", generation=gen,
+                          survivors=survivors)
+            raise exc
+        new_rank = survivors.index(self.rank)
+        new_world = len(survivors)
+        lost = sorted(set(range(self.world)) - set(survivors))
+        flight.record("elastic_membership", generation=new_gen,
+                      survivors=survivors, lost=lost, new_rank=new_rank,
+                      new_world=new_world)
+        print(f"[elastic] peer loss at generation {gen}: rank(s) {lost} "
+              f"gone; surviving {survivors} re-rank to 0..{new_world - 1} "
+              f"under generation {new_gen}", file=sys.stderr, flush=True)
+
+        # -- rescue: lowest survivor pins the stash -----------------------
+        self._rescue(stash, new_gen, is_leader=new_rank == 0)
+
+        # -- re-wire: wait for a healthy backend, jittered backoff --------
+        budget = backend_wait_env(600.0)
+        deadline = time.monotonic() + budget
+        for attempt, delay in enumerate(
+                backoff_schedule(1.0, 30.0, seed=self.rank)):
+            healthy = _subprocess_backend_healthy(
+                min(45.0, max(deadline - time.monotonic(), 1.0)))
+            flight.record("elastic_rewire_probe", attempt=attempt,
+                          healthy=healthy, next_wait_s=round(delay, 2),
+                          generation=new_gen)
+            if healthy:
+                break
+            if time.monotonic() + delay > deadline:
+                flight.dump(reason="elastic: backend never recovered for "
+                                   "the re-wire")
+                raise ElasticHandoffError(
+                    f"elastic re-wire: backend stayed unhealthy for "
+                    f"{budget:.0f}s after the peer loss; cannot rebuild "
+                    f"the surviving world")
+            time.sleep(delay)
+
+        get_registry().gauge("elastic.generation").set(new_gen)
+        get_registry().gauge("elastic.world").set(new_world)
+        get_registry().counter("elastic.rewires").inc()
+        self._reexec(new_gen, new_rank, new_world)
+
+    # -- pieces (separately testable) -------------------------------------
+
+    def _rescue(self, stash: dict, new_gen: int, *, is_leader: bool):
+        """Pin the last stashed state as a rescue checkpoint. Leader-only
+        (lowest surviving rank): params are replicated, so one committed
+        copy serves every survivor's resume — and the leader may well NOT
+        be old rank 0 (the dead rank often is)."""
+        from ..telemetry import flight, get_registry
+        if not is_leader:
+            return None
+        if not stash or "params" not in stash:
+            flight.record("elastic_rescue_skipped", generation=new_gen,
+                          reason="no stashed state yet")
+            print("[elastic] no stashed state to rescue (loss before the "
+                  "first checkpoint interval); resuming from the newest "
+                  "committed step checkpoint instead",
+                  file=sys.stderr, flush=True)
+            return None
+        from ..train.checkpoint import CheckpointError
+        from ..train.ckpt_manager import CheckpointManager
+        mgr = CheckpointManager(self.steps_dir, keep=self.ckpt_keep)
+        meta = dict(self.geometry)
+        meta["elastic_gen"] = new_gen
+        try:
+            path = mgr.save(stash["params"], stash["key"], self.impl,
+                            step=stash.get("step", 0),
+                            epoch=stash.get("epoch", 0),
+                            offset=stash.get("offset", 0),
+                            meta=meta, pin=True, resid=stash.get("resid"))
+        except CheckpointError as e:
+            # a failed rescue must not kill the reaction: the routine step
+            # checkpoints are still on disk
+            flight.record("elastic_rescue_failed", generation=new_gen,
+                          error=str(e)[:500])
+            print(f"[elastic] rescue checkpoint failed ({e}); falling back "
+                  f"to the newest committed step checkpoint",
+                  file=sys.stderr, flush=True)
+            return None
+        flight.record("elastic_rescue", generation=new_gen, path=path,
+                      step=stash.get("step", 0))
+        get_registry().counter("elastic.rescues").inc()
+        print(f"[elastic] rescue checkpoint pinned: {path}",
+              file=sys.stderr, flush=True)
+        return path
+
+    def rewire_env(self, new_gen: int, new_rank: int,
+                   new_world: int) -> dict:
+        """The env delta the re-exec'd process rendezvouses under: dense
+        new rank/world, the generation counter, and generation-derived
+        MASTER_PORT (never the old world's socket)."""
+        base_port = int(os.environ.get("MASTER_PORT", "29500"))
+        # base port = the ORIGINAL launch's port: un-apply this process's
+        # own generation offset so port math never compounds across
+        # repeated shrinks
+        base_port -= world_generation()
+        return {
+            "RANK": str(new_rank),
+            "WORLD_SIZE": str(new_world),
+            "MASTER_ADDR": os.environ.get("MASTER_ADDR", "127.0.0.1"),
+            "MASTER_PORT": str(rendezvous_port(base_port, new_gen)),
+            GEN_ENV: str(new_gen),
+        }
+
+    def reexec_argv(self) -> list:
+        """This launch's argv with any --resume/--start_epoch replaced by
+        a resume from the shared steps directory, and the wireup method
+        forced to `env` (the re-wire env above IS the topology; a
+        scheduler-derived method would re-read the DEAD world's vars)."""
+        argv = list(self.argv_tail if self.argv_tail is not None
+                    else sys.argv[1:])
+        argv = _strip_opt(argv, "--resume", 1)
+        argv = _strip_opt(argv, "--start_epoch", 1)
+        argv = _strip_opt(argv, "--wireup_method", 1)
+        return argv + ["--resume", self.steps_dir,
+                       "--wireup_method", "env"]
+
+    def _reexec(self, new_gen: int, new_rank: int, new_world: int):
+        """Replace this process with the surviving world's member. execv
+        IS the teardown: the old client's sockets close with the image,
+        and the fresh wireup re-rendezvouses cleanly (the same contract as
+        the outage path's _persist_and_reexec)."""
+        os.environ.update(self.rewire_env(new_gen, new_rank, new_world))
+        argv = self.reexec_argv()
+        print(f"[elastic] re-wiring: rank {self.rank} -> {new_rank} of "
+              f"{new_world}, generation {new_gen}; re-exec with "
+              f"--resume {self.steps_dir} --reshape {self.reshape_mode}",
+              file=sys.stderr, flush=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable, [sys.executable, "-m",
+                                  "pytorch_ddp_mnist_tpu.cli.train", *argv])
+
+
+def _strip_opt(argv: list, flag: str, nvalues: int) -> list:
+    """Drop every `flag [value...]` occurrence (both '--flag v' and
+    '--flag=v' spellings)."""
+    out = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == flag:
+            i += 1 + nvalues
+            continue
+        if argv[i].startswith(flag + "="):
+            i += 1
+            continue
+        out.append(argv[i])
+        i += 1
+    return out

@@ -1,0 +1,197 @@
+"""Checkpoint-geometry re-mapping — the elastic shrink/grow math.
+
+A step-checkpoint manifest is stamped with the run geometry it was written
+under (`cli.train._run_geometry`): `(epoch, offset)` address batches of a
+specific `global_batch`, and the int8 error-feedback residual is shaped
+`(n_devices, elems)`. Today a resume under ANY other geometry is refused
+by name (train/ckpt_manager.geometry_mismatch_message) — correct for an
+accidental flag change, fatal for elastic training, where losing a rank
+IS a geometry change. This module computes the deliberate re-mapping
+instead, with semantics pinned by tests/test_elastic.py.
+
+Two reshape modes (`--reshape`):
+
+  global_batch  (default) the GLOBAL batch is preserved: each surviving
+                device takes a larger micro-batch (manifest global_batch /
+                new device count — must divide, refused by name
+                otherwise). The optimizer trajectory keeps its effective
+                batch and lr scaling; the sampler offset is preserved
+                verbatim (offset counts GLOBAL batches, and the global
+                batch did not change). The int8 error-feedback residual is
+                RE-MAPPED: dead device rows fold into survivors
+                round-robin — new_row[i] = sum(old_row[j] for j % new_n
+                == i) — preserving the total outstanding quantization
+                error exactly (f32 adds, drift bound 0 beyond addition
+                reordering); on grow, surviving rows keep their residual
+                and new devices start at zero.
+
+  per_rank      the PER-DEVICE batch is fixed: the global batch shrinks
+                (or grows) with the world — degraded throughput, but no
+                divisibility constraint. (epoch, offset) address DIFFERENT
+                sample counts now, so the offset is re-mapped by samples
+                consumed: new_offset = old_offset * old_gb // new_gb
+                (floor — up to one new-geometry batch's samples replay,
+                never skipped). The residual is DROPPED deliberately
+                (per-device rows have no meaning when every device's
+                batch share changed): at most one step's quantization
+                error is lost — the same bound the multi-host residual
+                skip in cli.train already documents.
+
+Both modes re-shard the `ShardedSampler` (the global permutation is a
+pure function of seed+epoch — world-independent, so survivors re-split
+the SAME order) and, through it, the `pipeline/` rank assignment
+(`pipeline.reader.reshard_source`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+RESHAPE_MODES = ("global_batch", "per_rank")
+
+
+class ReshapeError(ValueError):
+    """A geometry re-mapping that cannot be done soundly — refused by name
+    (never silently degraded)."""
+
+
+@dataclass
+class ReshapePlan:
+    """The re-mapping from a manifest's geometry to the new world's, fully
+    determined before any state is touched."""
+    mode: str
+    old_global_batch: int
+    new_global_batch: int
+    per_device_batch: int     # each device's micro-batch under the plan
+    old_devices: int
+    new_devices: int
+    offset_map: str           # "preserved" | "floor_rescaled"
+    resid_map: str            # "folded" | "grown_zeros" | "dropped" | "kept"
+
+    @property
+    def changed(self) -> bool:
+        return (self.old_devices != self.new_devices
+                or self.old_global_batch != self.new_global_batch)
+
+
+def plan_reshape(old_global_batch: int, old_devices: int, new_devices: int,
+                 *, mode: str, per_device_batch: int = 0) -> ReshapePlan:
+    """Compute the reshape plan; raises ReshapeError naming any unsound
+    geometry instead of producing one.
+
+    `per_device_batch` is the new run's --batch_size — consulted only by
+    per_rank mode (global_batch mode DERIVES the micro-batch from the
+    manifest instead, which is the point of the mode)."""
+    if mode not in RESHAPE_MODES:
+        raise ReshapeError(f"unknown reshape mode {mode!r}; expected one "
+                           f"of {RESHAPE_MODES}")
+    if old_devices < 1 or new_devices < 1:
+        raise ReshapeError(f"device counts must be >= 1; got "
+                           f"{old_devices} -> {new_devices}")
+    if mode == "global_batch":
+        if old_global_batch % new_devices:
+            raise ReshapeError(
+                f"--reshape global_batch preserves the manifest's global "
+                f"batch ({old_global_batch}) by re-splitting it over the "
+                f"surviving devices, but {old_global_batch} is not "
+                f"divisible by {new_devices} device(s) — use --reshape "
+                f"per_rank (fixed per-device batch, global batch scales "
+                f"with the world) for this geometry")
+        micro = old_global_batch // new_devices
+        resid = ("kept" if new_devices == old_devices
+                 else "folded" if new_devices < old_devices
+                 else "grown_zeros")
+        return ReshapePlan(mode=mode, old_global_batch=old_global_batch,
+                           new_global_batch=old_global_batch,
+                           per_device_batch=micro, old_devices=old_devices,
+                           new_devices=new_devices, offset_map="preserved",
+                           resid_map=resid)
+    if per_device_batch < 1:
+        raise ReshapeError("--reshape per_rank keeps the per-device batch "
+                           "fixed; it needs --batch_size >= 1")
+    new_gb = per_device_batch * new_devices
+    return ReshapePlan(mode=mode, old_global_batch=old_global_batch,
+                       new_global_batch=new_gb,
+                       per_device_batch=per_device_batch,
+                       old_devices=old_devices, new_devices=new_devices,
+                       offset_map=("preserved" if new_gb == old_global_batch
+                                   else "floor_rescaled"),
+                       resid_map=("kept" if new_gb == old_global_batch
+                                  and new_devices == old_devices
+                                  else "dropped"))
+
+
+def remap_offset(offset: int, plan: ReshapePlan) -> int:
+    """The sampler offset under the plan's new global batch.
+
+    `offset` counts whole GLOBAL batches consumed in the epoch in
+    progress. global_batch mode preserves it verbatim (same global batch
+    -> same sample position). per_rank mode re-maps by SAMPLES consumed,
+    flooring to a whole new-geometry batch: up to new_global_batch - 1
+    samples of the epoch replay (training twice is benign; silently
+    skipping samples would not be)."""
+    if offset < 0:
+        raise ReshapeError(f"offset must be >= 0; got {offset}")
+    if plan.offset_map == "preserved":
+        return int(offset)
+    samples = int(offset) * plan.old_global_batch
+    return samples // plan.new_global_batch
+
+
+def remap_residual(resid: Optional[Any], plan: ReshapePlan):
+    """The int8 error-feedback residual under the plan.
+
+    Returns `(new_resid, disposition)` where disposition is the plan's
+    resid_map string. The fold rule (global_batch shrink) is the
+    documented one the tests pin: dead device row j lands in surviving
+    row j % new_n, so column sums — the total outstanding quantization
+    error per element — are preserved exactly up to f32 addition
+    reordering. Grow appends zero rows (new devices owe no error yet).
+    per_rank DROPS the residual (None): per-device rows are meaningless
+    once every device's share of the batch changed; the cost is bounded
+    at ONE step's quantization error, same as the documented multi-host
+    degrade in cli.train's step hook."""
+    if resid is None:
+        return None, "absent"
+    arr = np.asarray(resid, np.float32)
+    if arr.ndim != 2:
+        raise ReshapeError(f"residual must be (n_devices, elems); got "
+                           f"shape {arr.shape}")
+    if arr.shape[0] != plan.old_devices:
+        raise ReshapeError(
+            f"residual carries {arr.shape[0]} device row(s) but the "
+            f"manifest geometry says {plan.old_devices} — refusing to "
+            f"re-map inconsistent state")
+    if plan.resid_map == "dropped":
+        return None, "dropped"
+    if plan.resid_map == "kept" or plan.new_devices == plan.old_devices:
+        return arr, "kept"
+    if plan.new_devices < plan.old_devices:
+        out = np.zeros((plan.new_devices, arr.shape[1]), np.float32)
+        for j in range(plan.old_devices):
+            out[j % plan.new_devices] += arr[j]
+        return out, "folded"
+    out = np.zeros((plan.new_devices, arr.shape[1]), np.float32)
+    out[:plan.old_devices] = arr
+    return out, "grown_zeros"
+
+
+def reshape_checkpoint(restored, plan: ReshapePlan):
+    """Apply the plan to a restored StepCheckpoint-shaped object: returns
+    `(new_offset, new_resid, resid_disposition)`. The params/key are
+    geometry-free (replicated) and pass through untouched; the caller
+    re-stamps the manifest meta with the NEW geometry on its next save."""
+    new_offset = remap_offset(restored.offset, plan)
+    new_resid, disposition = remap_residual(restored.resid, plan)
+    return new_offset, new_resid, disposition
+
+
+def reshard_sampler(sampler, plan: ReshapePlan, *, rank: int,
+                    num_replicas: int):
+    """Re-split the sampler for the new membership (ShardedSampler.reshard
+    — same global permutation, new round-robin split). Thin veneer so the
+    elastic call site reads as part of one reshape."""
+    return sampler.reshard(num_replicas, rank)

@@ -61,6 +61,20 @@ class ShardedSampler:
         """Re-key the shuffle for a new epoch (DistributedSampler.set_epoch)."""
         self.epoch = int(epoch)
 
+    def reshard(self, num_replicas: int, rank: int) -> "ShardedSampler":
+        """A NEW sampler over the same dataset/seed/permutation source at a
+        different world geometry, preserving the epoch position — the
+        elastic-training re-shard (elastic/reshape.py): after a shrink or
+        grow, every surviving rank re-splits the SAME global permutation
+        (a pure function of seed+epoch, world-independent) under the new
+        (num_replicas, rank), so the union of shards still covers the
+        epoch exactly. Padding/round-robin math re-derives in __init__."""
+        out = ShardedSampler(self.num_samples, num_replicas=num_replicas,
+                             rank=rank, shuffle=self.shuffle, seed=self.seed,
+                             permutation=self.permutation)
+        out.set_epoch(self.epoch)
+        return out
+
     def global_permutation(self) -> np.ndarray:
         """The padded global order all ranks agree on this epoch."""
         if self.shuffle and self.permutation == "torch":

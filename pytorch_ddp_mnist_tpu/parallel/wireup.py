@@ -358,6 +358,32 @@ def looks_like_backend_loss(e: BaseException) -> bool:
     return any(sig in msg for sig in BACKEND_LOSS_SIGNATURES)
 
 
+def backoff_schedule(base_s: float, cap_s: float, *, seed: int = 0,
+                     factor: float = 2.0):
+    """Endless jittered exponential backoff delays: attempt k waits
+    jitter * min(cap_s, base_s * factor**k), jitter uniform in [0.5, 1.5).
+
+    The jitter stream is DETERMINISTIC per (seed, attempt) — chaos runs
+    replay bit-identically — but seeding by RANK decorrelates the ranks:
+    when N survivors re-wire after a peer loss, a fixed shared cadence
+    would have all of them probe (and later rendezvous-retry) in lockstep,
+    hammering the coordinator in synchronized waves that can keep a
+    marginal backend wedged (the re-wireup storm). Exponential growth
+    bounds the total probe count against any deadline the caller enforces;
+    the cap keeps worst-case reaction latency bounded once the backend
+    returns."""
+    import random
+    if base_s <= 0 or cap_s < base_s or factor <= 1.0:
+        raise ValueError(f"need 0 < base_s <= cap_s and factor > 1; got "
+                         f"base_s={base_s}, cap_s={cap_s}, factor={factor}")
+    attempt = 0
+    while True:
+        raw = min(cap_s, base_s * factor ** attempt)
+        jitter = 0.5 + random.Random((seed << 20) ^ attempt).random()
+        yield raw * jitter
+        attempt += 1
+
+
 def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
                      hang_timeout_s: float = None):
     """Poll jax.devices() until the backend initializes; bounded retry.
@@ -369,6 +395,14 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
     list; raises BackendUnavailableError once max_wait_s is exhausted.
     Non-RuntimeError probe failures (a broken jax install, a config
     TypeError) are NOT retried — they re-raise immediately, as before.
+
+    Retry cadence is JITTERED EXPONENTIAL backoff (`backoff_schedule`,
+    seeded by this process's RANK env so ranks decorrelate), capped at
+    `poll_s` — the re-wire probe loop of the elastic coordinator runs
+    through here with N survivors at once, and the old fixed cadence had
+    every rank probing in lockstep (the re-wireup storm). `max_wait_s`
+    stays the TOTAL deadline, and every attempt (with its chosen next
+    wait) lands in the flight ring.
 
     Probes are hang-bounded (``hang_timeout_s``, default 75 s, overridable
     via ``PDMT_HANG_TIMEOUT`` for backends whose legitimate cold init is
@@ -402,6 +436,21 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
     deadline = time.monotonic() + max_wait_s
     attempt = 0
     waiter = None  # wait_fn of an abandoned (possibly just slow) probe
+    # jittered exponential retry delays, capped at poll_s (the legacy
+    # cadence is the CAP, not the floor); rank-seeded so a whole world
+    # re-wiring at once never probes in lockstep
+    try:
+        _seed = int(os.environ.get("RANK", "0"))
+    except ValueError:
+        _seed = 0
+    delays = backoff_schedule(min(1.0, poll_s), max(poll_s, 1.0),
+                              seed=_seed)
+
+    def _sleep_backoff():
+        delay = min(next(delays), max(deadline - time.monotonic(), 0.1))
+        flight.record("backend_retry_wait", wait_s=round(delay, 2),
+                      attempt=attempt)
+        time.sleep(delay)
     while True:
         remaining = deadline - time.monotonic()
         if waiter is None:
@@ -433,7 +482,7 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
             print(f"wireup: backend unavailable (attempt {attempt}), "
                   f"retrying for another {remaining:.0f}s: {payload}",
                   file=sys.stderr, flush=True)  # stdout stays machine-readable
-            time.sleep(min(poll_s, max(remaining, 0.1)))
+            _sleep_backoff()
             try:
                 from jax._src import xla_bridge
                 xla_bridge._clear_backends()
@@ -482,7 +531,7 @@ def wait_for_backend(max_wait_s: float = 300.0, poll_s: float = 10.0,
                 "init and still holds the init lock, so every in-process "
                 "query would block forever. Restart the process (bench.py "
                 "re-execs itself once automatically).")
-        time.sleep(min(poll_s, max(deadline - time.monotonic(), 0.1)))
+        _sleep_backoff()
 
 
 def initialize_runtime(method: str = "auto") -> Runtime:

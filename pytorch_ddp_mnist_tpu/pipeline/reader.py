@@ -76,6 +76,29 @@ class ShardReader:
         return self.source.read_batch(rows)
 
 
+def reshard_source(source, num_replicas: int, rank: int):
+    """Re-point a pipeline-capable source at a NEW rank geometry in place
+    (the elastic shrink/grow path, docs/ROBUSTNESS.md §Elastic training).
+
+    The plan/load split makes this a one-field swap: batch ORDER is a pure
+    function of the sampler, so replacing `source.sampler` with its
+    `reshard(num_replicas, rank)` twin (same permutation source and seed,
+    new shard slice, epoch carried over) re-maps every future `plan()` to
+    the survivor geometry without touching the load side — the .nc pread /
+    memory gather is row-addressed and geometry-blind. Returns `source`."""
+    if not pipeline_capable(source):
+        raise ValueError(
+            f"{type(source).__name__} is not pipeline-capable: elastic "
+            f"re-sharding swaps source.sampler (see pipeline/reader.py)")
+    sampler = source.sampler
+    if not hasattr(sampler, "reshard"):
+        raise ValueError(
+            f"{type(sampler).__name__} has no reshard(); elastic "
+            f"re-sharding needs parallel.sampler.ShardedSampler")
+    source.sampler = sampler.reshard(num_replicas=num_replicas, rank=rank)
+    return source
+
+
 def sequential_iter(source, start: int = 0):
     """The workers=0 path: plain in-thread iteration with the same `start`
     semantics as the worker stage — index-level skip through `iter_from`

@@ -121,6 +121,71 @@ class StepCheckpoint:
                              # refuses a resume that contradicts them
 
 
+def geometry_mismatch_message(manifest_meta: dict,
+                              requested: dict) -> "str | None":
+    """The run-geometry refusal, or None when every stamped field matches.
+
+    Names BOTH complete geometries — the manifest's and the requested
+    run's — not just the differing fields: a multi-knob drift (say batch
+    AND limit changed by a copy-pasted launch line) is diagnosable from
+    the error alone, without re-opening the manifest. Ends by pointing at
+    `--reshape` because ONE class of mismatch is now deliberate: an
+    elastic shrink/grow changes global_batch by construction, and
+    elastic/reshape.py re-maps it instead of refusing (the other fields —
+    limit/sampler_rng/model/param_scale — stay hard refusals; reshape
+    re-splits a world, it does not reinterpret a dataset or a model)."""
+    mismatch = {k: (v, requested[k]) for k, v in manifest_meta.items()
+                if k in requested and requested[k] != v}
+    if not mismatch:
+        return None
+
+    def _fmt(src: dict) -> str:
+        return ", ".join(f"{k}={src[k]!r}" for k in sorted(requested)
+                         if k in src)
+
+    return ("checkpoint was written under different run geometry; its "
+            "(epoch, offset) would address different batches.\n"
+            f"  checkpoint geometry: {_fmt(manifest_meta)}\n"
+            f"  requested geometry:  {_fmt(requested)}\n"
+            "  differing: " + ", ".join(sorted(mismatch)) + "\n"
+            "(a deliberate world-size change resumes with --elastic "
+            "--reshape global_batch|per_rank — elastic/reshape.py re-maps "
+            "the global batch, sampler offset, and int8 residual instead "
+            "of refusing)")
+
+
+def peek_latest_meta(directory: str) -> "dict | None":
+    """The newest committed manifest's position + meta stamp — WITHOUT
+    touching the payload (no template, no decode, no CRC walk).
+
+    The elastic resume pre-pass (cli.train) needs the manifest's
+    global_batch/devices BEFORE the data plane is built — the per-device
+    micro-batch under `--reshape global_batch` is derived from it, and the
+    data plane sizes its loader from that micro-batch. Falls back past
+    unreadable/foreign manifests; returns None when nothing committed.
+    Payload intactness is NOT checked here — restore_latest still owns
+    that (this peek only shapes the run; the restore verifies it)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    steps = sorted((int(m.group(1)) for n in names
+                    if (m := _NAME_RE.match(n))), reverse=True)
+    for step in steps:
+        try:
+            with open(os.path.join(directory, _manifest_name(step))) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("v") != _SCHEMA:
+            continue
+        return {"step": int(rec.get("step", step)),
+                "epoch": int(rec.get("epoch", 0)),
+                "offset": int(rec.get("offset", 0)),
+                "meta": dict(rec.get("meta") or {})}
+    return None
+
+
 class CheckpointManager:
     """Atomic, CRC-stamped, keep-last-N step checkpoints in one directory.
 

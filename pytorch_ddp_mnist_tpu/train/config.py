@@ -311,6 +311,31 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "MT19937 randperm seeded (--seed + epoch); the "
                         "reference's loader is UNseeded, so parity here is "
                         "engine-faithful determinism, not bitwise")
+    t.add_argument("--elastic", action="store_true",
+                   help="preemption-tolerant elastic training (elastic/"
+                        "coordinator.py): on peer loss — watchdog hang "
+                        "event, backend-loss error, open journal entry — "
+                        "surviving ranks rescue-checkpoint (pinned save), "
+                        "agree on membership via beacons, and re-exec into "
+                        "the surviving world under the next world "
+                        "generation, re-mapping the checkpoint geometry per "
+                        "--reshape instead of refusing it. Needs --parallel, "
+                        "--telemetry and a --checkpoint dir with "
+                        "--ckpt_every_steps. Off (the default) is "
+                        "bitwise-identical to today. See docs/ROBUSTNESS.md "
+                        "§Elastic training")
+    t.add_argument("--reshape", choices=("global_batch", "per_rank"),
+                   default=None,
+                   help="elastic geometry re-mapping mode, default "
+                        "global_batch (elastic/"
+                        "reshape.py): global_batch (default) preserves the "
+                        "manifest's GLOBAL batch by scaling the per-device "
+                        "micro-batch (must divide; int8 error-feedback "
+                        "residual folds into survivors, offset preserved); "
+                        "per_rank keeps the per-device batch fixed — global "
+                        "batch scales with the world (degraded throughput), "
+                        "offset re-mapped by samples consumed, residual "
+                        "deliberately dropped. Needs --elastic")
     t.add_argument("--cached", action="store_true",
                    help="cache the dataset in HBM and run each epoch as one "
                         "jitted lax.scan program (fastest path for datasets "
@@ -366,6 +391,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "profile": a.profile, "kernel": a.kernel,
             "telemetry": a.telemetry, "journal": a.journal,
             "health": a.health, "metrics_port": a.metrics_port,
+            "elastic": a.elastic, "reshape": a.reshape,
         },
         "data": {
             "path": a.path, "netcdf": a.netcdf, "limit": a.limit,

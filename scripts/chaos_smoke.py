@@ -27,7 +27,12 @@ runs the whole chaos matrix on fake CPU devices:
      mid-epoch step with decode workers live, resumed from the step-ckpt
      directory with the pipeline still on, and its final checkpoint must
      be BYTE-IDENTICAL to an UNPIPED golden run — mid-epoch resume and
-     the piped-vs-unpiped parity pin, in one leg.
+     the piped-vs-unpiped parity pin, in one leg;
+  6. ELASTIC LEG — the shrink/grow cycle (docs/ROBUSTNESS.md §Elastic
+     training), delegated to scripts/elastic_smoke.py: one rank killed
+     mid-run, survivors rescue + re-wire into the smaller world under the
+     next generation, then the world grows back — with loss-curve
+     continuity and the post-reshape collective schedule asserted.
 
 Exit codes: 0 = parity held; 1 = any phase failed (with the failing rank's
 output on stderr); 75 = skipped, this jax has no CPU multiprocess
@@ -146,6 +151,33 @@ def _run_serial(argv, timeout: float, extra_env=None):
         return None, e.stdout or "", e.stderr or ""
 
 
+def _sweep_stray_tmp(steps_dir: str):
+    """Sweep the dead writer's orphan `.tmp.<pid>` strays out of the step
+    directory and return their names.
+
+    A kill that lands BETWEEN a save's payload-tmp write and its manifest
+    rename (pinned rescue saves included) leaves an uncommitted
+    `*.tmp.<pid>` stray. The manager's own rotation sweeps those on the
+    NEXT save from a live writer — but this leg's resumed run may finish
+    without rank 0 rotating (kill near the end of the run), and the stray
+    then outlives the smoke, reading as a half-written checkpoint to
+    whoever inspects the directory. The smoke models the operator here:
+    sweep before resume, and assert nothing `.tmp.` survives the leg."""
+    swept = []
+    try:
+        names = os.listdir(steps_dir)
+    except OSError:
+        return swept
+    for name in names:
+        if ".tmp." in name:
+            try:
+                os.unlink(os.path.join(steps_dir, name))
+                swept.append(name)
+            except OSError:
+                pass
+    return swept
+
+
 def _pipeline_leg(work: str, chaos_seed: int, timeout: float):
     """Kill/resume THROUGH the input pipeline (step 5 of the module
     docstring). Returns (ok, detail)."""
@@ -176,6 +208,11 @@ def _pipeline_leg(work: str, chaos_seed: int, timeout: float):
     steps_dir = flaky + ".steps"
     if not os.path.isdir(steps_dir) or not os.listdir(steps_dir):
         return False, f"no step checkpoints under {steps_dir}"
+    # the kill may have landed between a save's payload-tmp and its
+    # manifest rename: sweep the dead writer's orphan strays so the
+    # directory the resume sees (and the one the smoke leaves behind)
+    # holds only committed checkpoints
+    swept = _sweep_stray_tmp(steps_dir)
     # resume: pipeline still on, restores mid-epoch and finishes
     rc, out, err = _run_serial(
         base + pipe + ["--checkpoint", flaky, "--resume", steps_dir],
@@ -187,7 +224,12 @@ def _pipeline_leg(work: str, chaos_seed: int, timeout: float):
     if _final_params(golden) != _final_params(flaky):
         return False, ("piped kill/resume final checkpoint differs from "
                        "the UNPIPED golden run")
-    return True, {"kill_step": kill_step, "steps_per_epoch": steps_per_epoch}
+    stray = [n for n in os.listdir(steps_dir) if ".tmp." in n]
+    if stray:
+        return False, (f"orphan tmp strays survived the pipeline leg: "
+                       f"{stray}")
+    return True, {"kill_step": kill_step, "steps_per_epoch": steps_per_epoch,
+                  "swept_strays": swept}
 
 
 def main(argv=None) -> int:
@@ -317,12 +359,38 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
 
+    # 6. the elastic shrink/grow leg (docs/ROBUSTNESS.md §Elastic
+    # training): one rank killed mid-run, the survivors rescue-checkpoint
+    # and re-wire into the smaller world, then the world grows back —
+    # loss-curve continuity and the post-reshape collective schedule are
+    # asserted by scripts/elastic_smoke.py (its own world-1 fallback runs
+    # the reshape math + serial kill/resume cycle when this jaxlib has no
+    # CPU multiprocess collectives).
+    elastic = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "elastic_smoke.py"),
+         "--workdir", os.path.join(work, "elastic"),
+         "--world", str(min(a.world, 2))],
+        capture_output=True, text=True)
+    if elastic.returncode == 75:
+        # re-run the driver-mechanics fallback explicitly rather than
+        # silently skipping the leg
+        elastic = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "elastic_smoke.py"),
+             "--workdir", os.path.join(work, "elastic1"), "--world", "1"],
+            capture_output=True, text=True)
+    if elastic.returncode != 0:
+        print(f"chaos_smoke: FAIL in elastic leg —\n{elastic.stdout}"
+              f"\n{elastic.stderr}", file=sys.stderr)
+        return 1
+
     print(json.dumps({
         "chaos_smoke": "ok", "world": a.world, "chaos_seed": a.chaos_seed,
         "kill_rank": kill_rank, "kill_step": kill_step,
         "steps_per_epoch": steps_per_epoch,
         "parity": "bitwise", "telemetry": "validated",
         "pipeline_leg": {"parity": "bitwise", **detail},
+        "elastic_leg": "ok",
     }))
     if not a.keep_workdir and a.workdir is None:
         shutil.rmtree(work, ignore_errors=True)

@@ -1,0 +1,429 @@
+#!/usr/bin/env python
+"""Elastic-training smoke: kill a rank mid-run, watch the survivors
+shrink the world without losing the run, then grow it back.
+
+    python scripts/elastic_smoke.py [--world 2] [--workdir DIR] ...
+
+The front door of docs/ROBUSTNESS.md §Elastic training
+(`make elastic-smoke`). At world >= 2, the full seeded shrink/grow cycle:
+
+  1. SHRINK — a `--parallel --elastic --journal` world trains with
+     `PDMT_FAULT=kill:rank=1:step=K`: rank 1 SIGKILLs itself mid-run,
+     rank 0's next collective surfaces the peer loss, and the coordinator
+     (elastic/coordinator.py) rescue-checkpoints, collects the beacon
+     membership, and re-execs rank 0 into a WORLD-1 run under generation
+     1 — which finishes every epoch. The loss curve printed across the
+     whole cycle (one stdout: execv keeps the pipe) must be CONTINUOUS:
+     every epoch logged exactly once, finite, trending down.
+  2. JOURNAL — `trace report --cluster` over the survivor's telemetry
+     proves the POST-reshape collective schedule: a clean world-1
+     journal (no desync, collectives recorded) written by the re-exec'd
+     generation.
+  3. GROW — capacity returns: the full world is relaunched
+     (scheduler-initiated, as documented) with `--resume <steps dir>
+     --elastic` and more epochs under PDMT_ELASTIC_GEN=2. The world-1
+     manifest re-maps UP (`--reshape global_batch`: same global batch,
+     smaller per-device micro-batch) and the newest manifest must carry
+     the grown geometry stamp (devices=world, elastic_gen=2).
+  4. GATE — `check_telemetry --require elastic.,cluster.` over the
+     cycle's telemetry.
+
+World-1 fallback (this jaxlib has no CPU multiprocess collectives —
+exit 75 at world >= 2, the chaos_smoke convention; `make elastic-smoke`
+reruns with --world 1 automatically):
+
+  A. RESHAPE MATH — process-free: the residual fold/drop and offset
+     re-mapping semantics, straight against elastic/reshape.py (column
+     sums preserved on fold, per_rank drops, grow appends zeros).
+  B. KILL/RESUME-WITH-RESHAPE — a 1-process `--parallel --elastic` run
+     is SIGKILLed at a seeded step and resumed with `--reshape per_rank`
+     at a DIFFERENT batch size: the geometry change is re-mapped instead
+     of refused, the loss curve stays continuous across the cycle, the
+     journal proves the post-reshape schedule, and the elastic.,cluster.
+     metric families gate.
+  C. FORGED SHRINK — the newest manifest is re-stamped as a 2-device
+     world's (devices=2, doubled global_batch) and resumed at 1 device
+     under `--reshape global_batch`: the pre-pass must derive the
+     micro-batch from the manifest and log the 2 -> 1 re-mapping.
+
+Exit codes: 0 = every leg held; 1 = any leg failed; 75 = skipped (no CPU
+multiprocess collectives; rerun with --world 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EPOCH_RE = re.compile(r"^Epoch=(\d+), train_loss=([0-9.eE+-]+)")
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank: int, port: int, argv, world: int, extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(world),
+        "RANK": str(rank),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train", *argv],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def _run_serial(argv, timeout: float, extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "pytorch_ddp_mnist_tpu.cli.train", *argv],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout)
+        return r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        return None, e.stdout or "", e.stderr or ""
+
+
+def _tool(args, timeout=120.0):
+    return subprocess.run(
+        [sys.executable, *args], cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PYTHONPATH=REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")))
+
+
+def _epoch_curve(*stdouts):
+    """(epoch, train_loss) pairs parsed from the machine-readable epoch
+    lines, in print order across the given streams."""
+    curve = []
+    for out in stdouts:
+        for line in out.splitlines():
+            m = _EPOCH_RE.match(line.strip())
+            if m:
+                curve.append((int(m.group(1)), float(m.group(2))))
+    return curve
+
+
+def _continuous(curve, epochs: int):
+    """The loss-curve continuity verdict: every epoch 0..epochs-1 logged
+    (a re-exec may replay the interrupted epoch — duplicates allowed,
+    gaps are not), every loss finite, and the curve trending down (last
+    strictly below first). Returns None when continuous, else a reason."""
+    if not curve:
+        return "no epoch lines found"
+    seen = {e for e, _ in curve}
+    missing = sorted(set(range(epochs)) - seen)
+    if missing:
+        return f"epochs {missing} never logged (curve: {curve})"
+    losses = [ls for _, ls in curve]
+    if not all(ls == ls and ls != float("inf") for ls in losses):
+        return f"non-finite loss in the curve: {curve}"
+    if losses[-1] >= losses[0]:
+        return (f"loss did not trend down across the cycle: "
+                f"{losses[0]} -> {losses[-1]}")
+    return None
+
+
+def _newest_manifest(steps_dir: str):
+    names = sorted(n for n in os.listdir(steps_dir)
+                   if n.startswith("step_") and n.endswith(".json"))
+    if not names:
+        return None, None
+    path = os.path.join(steps_dir, names[-1])
+    with open(path) as f:
+        return path, json.load(f)
+
+
+def _journal_report(tel_dir: str, world: int):
+    """trace report --cluster must show a CLEAN post-reshape schedule:
+    `world` ranks, zero desync, collectives actually recorded. Returns
+    None when it does, else a reason."""
+    rep = _tool(["-m", "pytorch_ddp_mnist_tpu", "trace", "report",
+                 "--cluster", "--json", tel_dir])
+    if rep.returncode != 0:
+        return f"trace report rc={rep.returncode}\n{rep.stdout}\n{rep.stderr}"
+    report = json.loads(rep.stdout)
+    if report["n_ranks"] != world:
+        return f"journal shows {report['n_ranks']} rank(s), expected {world}"
+    if not report["desync"]["ok"]:
+        return f"post-reshape journal desync: {json.dumps(report['desync'])}"
+    if report["totals"]["collectives"] == 0:
+        return "post-reshape journal recorded no collectives"
+    return None
+
+
+# -- world-1 fallback legs --------------------------------------------------
+
+def _reshape_math_leg():
+    """Process-free: the fold/drop/grow semantics straight against
+    elastic/reshape.py (the same rules tests/test_elastic.py pins)."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+    from pytorch_ddp_mnist_tpu.elastic import (plan_reshape, remap_offset,
+                                               remap_residual)
+    resid = np.arange(12, dtype=np.float32).reshape(4, 3)
+    # shrink 4 -> 2, global batch preserved: rows fold j -> j % 2,
+    # column sums exact
+    plan = plan_reshape(64, 4, 2, mode="global_batch")
+    out, disp = remap_residual(resid, plan)
+    if disp != "folded" or out.shape != (2, 3):
+        return f"fold disposition wrong: {disp} {out.shape}"
+    if not np.array_equal(out.sum(axis=0), resid.sum(axis=0)):
+        return "fold does not preserve column sums"
+    if remap_offset(7, plan) != 7:
+        return "global_batch mode must preserve the offset"
+    # per_rank: residual dropped, offset floor-rescaled by samples
+    plan = plan_reshape(64, 4, 2, mode="per_rank", per_device_batch=16)
+    out, disp = remap_residual(resid, plan)
+    if out is not None or disp != "dropped":
+        return f"per_rank must drop the residual: {disp}"
+    if remap_offset(7, plan) != 7 * 64 // 32:
+        return "per_rank offset must floor-rescale by samples consumed"
+    # grow 2 -> 4: surviving rows kept, new rows zero
+    plan = plan_reshape(64, 2, 4, mode="global_batch")
+    out, disp = remap_residual(resid[:2], plan)
+    if disp != "grown_zeros" or out.shape != (4, 3):
+        return f"grow disposition wrong: {disp} {out.shape}"
+    if not (np.array_equal(out[:2], resid[:2]) and not out[2:].any()):
+        return "grow must keep surviving rows and zero the new ones"
+    return None
+
+
+def _fallback_cycle_leg(work: str, timeout: float):
+    """Legs B + C of the module docstring: seeded kill -> resume with
+    `--reshape per_rank` at a different batch -> forged 2-device manifest
+    resumed under `--reshape global_batch`. Returns (ok, detail)."""
+    limit, batch, epochs, every = 256, 32, 3, 2
+    kill_step = 11
+    ckpt = os.path.join(work, "el.msgpack")
+    steps_dir = ckpt + ".steps"
+    t_kill = os.path.join(work, "t_kill")
+    t_resume = os.path.join(work, "t_resume")
+    base = ["--parallel", "--elastic", "--journal", "--kernel", "xla",
+            "--limit", str(limit), "--lr", "0.1",
+            "--path", os.path.join(work, "data"),
+            "--checkpoint", ckpt, "--ckpt_every_steps", str(every)]
+    # kill run: batch 32
+    rc, out1, err1 = _run_serial(
+        base + ["--n_epochs", str(epochs), "--batch_size", str(batch),
+                "--telemetry", t_kill], timeout,
+        extra_env={"PDMT_FAULT": f"kill:step={kill_step}"})
+    if rc != -9:
+        return False, f"kill run rc={rc}, expected SIGKILL (-9)\n{err1}"
+    if not os.path.isdir(steps_dir) or not os.listdir(steps_dir):
+        return False, f"no step checkpoints under {steps_dir}"
+    # resume run: batch 16 under per_rank — geometry re-mapped, not refused
+    rc, out2, err2 = _run_serial(
+        base + ["--n_epochs", str(epochs), "--batch_size", "16",
+                "--reshape", "per_rank", "--resume", steps_dir,
+                "--telemetry", t_resume], timeout)
+    if rc != 0:
+        return False, f"reshape resume rc={rc}\n{out2}\n{err2}"
+    if "[elastic] reshaped checkpoint geometry (per_rank)" not in err2:
+        return False, f"resume printed no reshape line\n{err2}"
+    bad = _continuous(_epoch_curve(out1, out2), epochs)
+    if bad:
+        return False, f"loss-curve continuity: {bad}"
+    bad = _journal_report(t_resume, world=1)
+    if bad:
+        return False, bad
+    chk = _tool([os.path.join(REPO, "scripts", "check_telemetry.py"),
+                 "--require", "elastic.,cluster.", t_resume])
+    if chk.returncode != 0:
+        return False, (f"check_telemetry --require elastic.,cluster.:\n"
+                       f"{chk.stdout}\n{chk.stderr}")
+    # leg C: forge the newest manifest as a 2-device world's and resume
+    # under global_batch — the pre-pass must derive micro-batch 32 (=64/1
+    # per device... the manifest's doubled global batch over 1 device)
+    # and log the 2 -> 1 residual-free shrink re-map
+    mpath, rec = _newest_manifest(steps_dir)
+    if rec is None:
+        return False, f"no manifest to forge under {steps_dir}"
+    old_gb = int(rec.get("meta", {}).get("global_batch", 16))
+    rec.setdefault("meta", {})["global_batch"] = old_gb * 2
+    rec["meta"]["devices"] = 2
+    with open(mpath, "w") as f:
+        json.dump(rec, f)
+    rc, out3, err3 = _run_serial(
+        base + ["--n_epochs", str(epochs + 1), "--batch_size", "999",
+                "--resume", steps_dir,
+                "--telemetry", os.path.join(work, "t_grow")], timeout)
+    if rc != 0:
+        return False, f"forged-shrink resume rc={rc}\n{out3}\n{err3}"
+    if (f"global_batch={old_gb * 2}" not in out3
+            or "devices 2 -> 1" not in err3):
+        return False, (f"forged 2-device manifest was not re-mapped "
+                       f"(expected global_batch={old_gb * 2}, "
+                       f"'devices 2 -> 1')\n{out3}\n{err3}")
+    return True, {"kill_step": kill_step,
+                  "reshape": "per_rank then global_batch",
+                  "forged_global_batch": old_gb * 2}
+
+
+# -- the real shrink/grow cycle (world >= 2) --------------------------------
+
+def _shrink_grow_cycle(work: str, world: int, timeout: float):
+    """Legs 1-4 of the module docstring. Returns (ok, detail)."""
+    limit, batch, epochs, every, kill_step = 512, 32, 4, 2, 9
+    ckpt = os.path.join(work, "el.msgpack")
+    steps_dir = ckpt + ".steps"
+    telemetry = os.path.join(work, "telemetry")
+    base = ["--parallel", "--elastic", "--journal", "--kernel", "xla",
+            "--wireup_method", "env", "--limit", str(limit),
+            "--batch_size", str(batch), "--lr", "0.1",
+            "--path", os.path.join(work, "data"),
+            "--checkpoint", ckpt, "--ckpt_every_steps", str(every),
+            "--telemetry", telemetry]
+    # 1. SHRINK: rank 1 killed; rank 0 reacts and re-execs to world 1
+    port = _free_port()
+    fault = f"kill:rank=1:step={kill_step}"
+    procs = [_spawn(r, port, base + ["--n_epochs", str(epochs)], world,
+                    {"PDMT_FAULT": fault,
+                     # fast hang detection for the smoke
+                     "PDMT_COLLECTIVE_HANG_S": "20",
+                     "PDMT_ELASTIC_SETTLE_S": "2"})
+             for r in range(world)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, err = p.communicate()
+            outs.append((None, out, err))
+    rc0, out0, err0 = outs[0]
+    rc1 = outs[1][0]
+    if rc1 != -9:
+        return False, f"killed rank rc={rc1}, expected SIGKILL (-9)"
+    if rc0 != 0:
+        return False, (f"survivor rc={rc0} — the shrink cycle did not "
+                       f"complete\n{out0}\n{err0}")
+    if "[elastic] re-wiring: rank 0 -> 0 of 1" not in err0:
+        return False, f"survivor printed no re-wire line\n{err0}"
+    bad = _continuous(_epoch_curve(out0), epochs)
+    if bad:
+        return False, f"shrink loss-curve continuity: {bad}"
+    # 2. JOURNAL: the post-reshape (world-1) schedule is clean
+    bad = _journal_report(telemetry, world=1)
+    if bad:
+        return False, bad
+    # 3. GROW: scheduler relaunches the full world with more epochs
+    port = _free_port()
+    grow_epochs = epochs + 2
+    procs = [_spawn(r, port, base + ["--n_epochs", str(grow_epochs),
+                                     "--resume", steps_dir], world,
+                    {"PDMT_ELASTIC_GEN": "2"})
+             for r in range(world)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+            out, err = p.communicate()
+            outs.append((None, out, err))
+    if any(rc != 0 for rc, _, _ in outs):
+        return False, "\n".join(
+            f"grow rank {r} rc={rc}\n{o}\n{e}"
+            for r, (rc, o, e) in enumerate(outs))
+    bad = _continuous(_epoch_curve(out0, outs[0][1]), grow_epochs)
+    if bad:
+        return False, f"grow loss-curve continuity: {bad}"
+    _, rec = _newest_manifest(steps_dir)
+    meta = (rec or {}).get("meta", {})
+    if meta.get("devices") != world or meta.get("elastic_gen") != 2:
+        return False, (f"grown manifest not stamped with the new "
+                       f"geometry/generation: {meta}")
+    # 4. GATE
+    chk = _tool([os.path.join(REPO, "scripts", "check_telemetry.py"),
+                 "--require", "elastic.,cluster.", telemetry])
+    if chk.returncode != 0:
+        return False, (f"check_telemetry --require elastic.,cluster.:\n"
+                       f"{chk.stdout}\n{chk.stderr}")
+    return True, {"kill_step": kill_step, "epochs": epochs,
+                  "grow_epochs": grow_epochs, "generations": [0, 1, 2]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="elastic shrink/grow smoke (kill a rank, keep the run)")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--keep_workdir", action="store_true")
+    a = ap.parse_args(argv)
+
+    # CPU multiprocess collectives need jax >= 0.5 (the chaos_smoke /
+    # cluster_smoke gate): absent capability = skip signal 75, and the
+    # Makefile reruns at --world 1.
+    import jax
+    if (a.world > 1
+            and tuple(int(x)
+                      for x in jax.__version__.split(".")[:2]) < (0, 5)):
+        print("elastic_smoke: SKIP — this jaxlib has no CPU multiprocess "
+              "collectives (needs jax >= 0.5)", file=sys.stderr)
+        return 75
+
+    work = a.workdir or tempfile.mkdtemp(prefix="pdmt_elastic_")
+    os.makedirs(work, exist_ok=True)
+
+    if a.world > 1:
+        ok, detail = _shrink_grow_cycle(work, a.world, a.timeout)
+        if not ok:
+            print(f"elastic_smoke: FAIL in shrink/grow cycle — {detail}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({"elastic_smoke": "ok", "world": a.world,
+                          "cycle": detail}))
+    else:
+        bad = _reshape_math_leg()
+        if bad:
+            print(f"elastic_smoke: FAIL in reshape-math leg — {bad}",
+                  file=sys.stderr)
+            return 1
+        ok, detail = _fallback_cycle_leg(work, a.timeout)
+        if not ok:
+            print(f"elastic_smoke: FAIL in kill/resume-with-reshape leg — "
+                  f"{detail}", file=sys.stderr)
+            return 1
+        print(json.dumps({"elastic_smoke": "ok", "world": 1,
+                          "reshape_math": "ok", "cycle": detail}))
+    if not a.keep_workdir and a.workdir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

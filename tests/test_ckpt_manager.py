@@ -464,3 +464,37 @@ def test_cli_flag_rejections(tmp_path):
         d.mkdir()
         main(base + ["--resume", str(d), "--start_epoch", "1",
                      "--n_epochs", "2"])
+
+
+def test_geometry_mismatch_message_names_both_and_points_at_reshape():
+    """The elastic satellite: a refusal must print BOTH geometries, name
+    the differing keys, and point at --reshape (docs/ROBUSTNESS.md
+    §Elastic training) — not just reject by key name."""
+    from pytorch_ddp_mnist_tpu.train.ckpt_manager import (
+        geometry_mismatch_message)
+    manifest = {"global_batch": 128, "limit": 512, "model": "mlp"}
+    requested = {"global_batch": 64, "limit": 512, "model": "mlp"}
+    msg = geometry_mismatch_message(manifest, requested)
+    assert msg is not None
+    assert "checkpoint geometry:" in msg and "requested geometry:" in msg
+    assert "global_batch=128" in msg and "global_batch=64" in msg
+    assert "differing: global_batch" in msg
+    assert "--reshape" in msg and "--elastic" in msg
+    # matching geometries -> no refusal
+    assert geometry_mismatch_message(requested, dict(requested)) is None
+    # extra manifest-only keys (devices / elastic_gen stamps) are ignored
+    stamped = dict(requested, devices=2, elastic_gen=3)
+    assert geometry_mismatch_message(stamped, requested) is None
+
+
+def test_peek_latest_meta_reads_newest_manifest_without_payload(tmp_path):
+    from pytorch_ddp_mnist_tpu.train.ckpt_manager import peek_latest_meta
+    mgr = CheckpointManager(str(tmp_path / "s"), keep=3)
+    mgr.save(_params(), _key_data(), "threefry2x32", step=2, epoch=0,
+             offset=1, meta={"global_batch": 64, "devices": 2})
+    mgr.save(_params(1), _key_data(), "threefry2x32", step=5, epoch=1,
+             offset=3, meta={"global_batch": 64, "devices": 2})
+    peek = peek_latest_meta(str(tmp_path / "s"))
+    assert peek == {"step": 5, "epoch": 1, "offset": 3,
+                    "meta": {"global_batch": 64, "devices": 2}}
+    assert peek_latest_meta(str(tmp_path / "missing")) is None

@@ -1,0 +1,326 @@
+"""Elastic training (pytorch_ddp_mnist_tpu/elastic/ — docs/ROBUSTNESS.md
+§Elastic training).
+
+Unit tier: the reshape plan/offset/residual semantics both modes pin
+(including the int8 error-feedback fold's sum-preservation drift bound and
+per_rank's deliberate drop), the beacon membership protocol, the
+world-generation and rendezvous-port rules, the coordinator's re-exec
+argv/env derivation, sampler/pipeline re-sharding, the CLI's by-name knob
+hygiene, and the `--elastic`-off inertness pin. The live shrink/grow cycle
+(SIGKILL a rank, survivors rescue + re-wire + continue) is subprocess
+territory: `scripts/elastic_smoke.py` / `make elastic-smoke`."""
+
+import os
+import types
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_mnist_tpu.elastic import (ElasticCoordinator, ReshapeError,
+                                           clear_beacons, collect_membership,
+                                           next_generation, plan_reshape,
+                                           read_beacons, remap_offset,
+                                           remap_residual, rendezvous_port,
+                                           reshape_checkpoint,
+                                           world_generation, write_beacon)
+from pytorch_ddp_mnist_tpu.elastic.coordinator import _strip_opt
+
+
+# -- reshape plans -----------------------------------------------------------
+
+def test_plan_global_batch_shrink_preserves_global_batch():
+    plan = plan_reshape(64, 4, 2, mode="global_batch")
+    assert plan.new_global_batch == 64       # the mode's whole point
+    assert plan.per_device_batch == 32       # re-split over survivors
+    assert plan.offset_map == "preserved"
+    assert plan.resid_map == "folded"
+    assert plan.changed
+
+
+def test_plan_global_batch_grow_and_unchanged():
+    plan = plan_reshape(64, 2, 4, mode="global_batch")
+    assert (plan.per_device_batch, plan.resid_map) == (16, "grown_zeros")
+    plan = plan_reshape(64, 2, 2, mode="global_batch")
+    assert plan.resid_map == "kept" and not plan.changed
+
+
+def test_plan_global_batch_indivisible_refuses_naming_per_rank():
+    """The divisibility refusal must point at the OTHER mode — the operator
+    fix — not just report the arithmetic."""
+    with pytest.raises(ReshapeError, match="per_rank"):
+        plan_reshape(64, 4, 3, mode="global_batch")
+
+
+def test_plan_per_rank_scales_global_batch_with_world():
+    plan = plan_reshape(64, 4, 2, mode="per_rank", per_device_batch=16)
+    assert plan.new_global_batch == 32       # 16 x 2 survivors
+    assert plan.offset_map == "floor_rescaled"
+    assert plan.resid_map == "dropped"
+    # same resulting geometry -> nothing to re-map
+    plan = plan_reshape(64, 4, 4, mode="per_rank", per_device_batch=16)
+    assert plan.offset_map == "preserved" and plan.resid_map == "kept"
+
+
+def test_plan_rejects_bad_shapes_by_name():
+    with pytest.raises(ReshapeError, match="unknown reshape mode"):
+        plan_reshape(64, 4, 2, mode="magic")
+    with pytest.raises(ReshapeError, match="device counts"):
+        plan_reshape(64, 0, 2, mode="global_batch")
+    with pytest.raises(ReshapeError, match="--batch_size"):
+        plan_reshape(64, 4, 2, mode="per_rank", per_device_batch=0)
+
+
+# -- offset re-mapping -------------------------------------------------------
+
+def test_offset_preserved_under_global_batch():
+    plan = plan_reshape(64, 4, 2, mode="global_batch")
+    assert remap_offset(7, plan) == 7
+
+
+def test_offset_floor_rescaled_by_samples_under_per_rank():
+    """7 batches x 64 samples = 448 samples consumed; at the new global
+    batch of 32 that is 14 whole batches — floored, so the tail of a
+    partially-consumed new batch REPLAYS rather than being skipped."""
+    plan = plan_reshape(64, 4, 2, mode="per_rank", per_device_batch=16)
+    assert remap_offset(7, plan) == 14
+    plan = plan_reshape(48, 4, 2, mode="per_rank", per_device_batch=16)
+    assert remap_offset(5, plan) == 5 * 48 // 32  # == 7, floor of 7.5
+    with pytest.raises(ReshapeError, match=">= 0"):
+        remap_offset(-1, plan)
+
+
+# -- residual re-mapping (the satellite: fold vs drop, drift bounds) ---------
+
+def test_residual_fold_preserves_column_sums_exactly_for_int_values():
+    """Shrink under global_batch: dead row j folds into survivor j % new.
+    The residual is dequantized int8 error (integer-valued f32 x a scale),
+    so the fold's additions are exact — column sums match bitwise."""
+    rng = np.random.default_rng(0)
+    resid = rng.integers(-127, 128, size=(4, 33)).astype(np.float32)
+    plan = plan_reshape(64, 4, 2, mode="global_batch")
+    out, disp = remap_residual(resid, plan)
+    assert disp == "folded" and out.shape == (2, 33)
+    assert np.array_equal(out.sum(axis=0), resid.sum(axis=0))
+    # the fold rule itself: row j lands in j % 2
+    assert np.array_equal(out[0], resid[0] + resid[2])
+    assert np.array_equal(out[1], resid[1] + resid[3])
+
+
+def test_residual_fold_drift_bound_for_general_floats():
+    """A scaled (non-integer) residual folds with only f32 reordering
+    drift: column sums agree to ~1 ulp of the magnitude, NOT the one-step
+    quantization error a drop would cost."""
+    rng = np.random.default_rng(1)
+    resid = (rng.standard_normal((8, 257)) * 1e-3).astype(np.float32)
+    with pytest.raises(ReshapeError):
+        plan_reshape(256, 8, 3, mode="global_batch")  # 256 % 3 != 0
+    plan = plan_reshape(256, 8, 2, mode="global_batch")
+    out, _ = remap_residual(resid, plan)
+    drift = np.abs(out.sum(axis=0, dtype=np.float64)
+                   - resid.sum(axis=0, dtype=np.float64))
+    assert drift.max() <= 1e-6  # reordering noise only
+
+
+def test_residual_dropped_under_per_rank_and_grown_with_zeros():
+    resid = np.ones((4, 5), np.float32)
+    plan = plan_reshape(64, 4, 2, mode="per_rank", per_device_batch=16)
+    assert remap_residual(resid, plan) == (None, "dropped")
+    plan = plan_reshape(64, 2, 4, mode="global_batch")
+    out, disp = remap_residual(resid[:2], plan)
+    assert disp == "grown_zeros"
+    assert np.array_equal(out[:2], resid[:2]) and not out[2:].any()
+
+
+def test_residual_rejects_inconsistent_state_by_name():
+    plan = plan_reshape(64, 4, 2, mode="global_batch")
+    with pytest.raises(ReshapeError, match="n_devices, elems"):
+        remap_residual(np.ones(5, np.float32), plan)
+    with pytest.raises(ReshapeError, match="inconsistent"):
+        remap_residual(np.ones((3, 5), np.float32), plan)
+    assert remap_residual(None, plan) == (None, "absent")
+
+
+def test_reshape_checkpoint_passes_params_through():
+    plan = plan_reshape(64, 4, 2, mode="global_batch")
+    restored = types.SimpleNamespace(offset=3,
+                                     resid=np.ones((4, 5), np.float32))
+    off, resid, disp = reshape_checkpoint(restored, plan)
+    assert (off, disp) == (3, "folded")
+    assert np.array_equal(resid, np.full((2, 5), 2.0, np.float32))
+
+
+# -- beacons / membership ----------------------------------------------------
+
+def test_beacon_roundtrip_and_generation_scoping(tmp_path):
+    d = str(tmp_path)
+    write_beacon(d, 1, 0)
+    write_beacon(d, 1, 2)
+    write_beacon(d, 2, 1)       # another generation's round
+    (tmp_path / "journal.jsonl").write_text("x")  # non-beacon noise
+    assert read_beacons(d, 1) == [0, 2]
+    assert read_beacons(d, 2) == [1]
+    clear_beacons(d, 1)
+    assert read_beacons(d, 1) == [] and read_beacons(d, 2) == [1]
+    clear_beacons(d)            # all generations
+    assert read_beacons(d, 2) == []
+    assert read_beacons(str(tmp_path / "missing"), 0) == []
+
+
+def test_collect_membership_settles_on_the_beacon_set(tmp_path):
+    d = str(tmp_path)
+    write_beacon(d, 3, 0)       # a peer already arrived
+    got = collect_membership(d, 3, 2, settle_s=0.05, deadline_s=2.0,
+                             poll_s=0.01)
+    assert got == [0, 2]        # both survivors, sorted = dense re-rank order
+    assert got.index(2) == 1    # this rank's new dense rank
+
+
+# -- world-generation rules --------------------------------------------------
+
+def test_generation_env_parse_and_monotonic_increment(monkeypatch):
+    monkeypatch.delenv("PDMT_ELASTIC_GEN", raising=False)
+    assert world_generation() == 0
+    monkeypatch.setenv("PDMT_ELASTIC_GEN", "3")
+    assert world_generation() == 3
+    for bad in ("", "x", "-2"):
+        monkeypatch.setenv("PDMT_ELASTIC_GEN", bad)
+        assert world_generation() == 0
+    assert next_generation(3) == 4
+    assert rendezvous_port(29500, 2) == 29502
+
+
+def _coord(**kw):
+    base = dict(steps_dir="/tmp/s.steps", telemetry_dir="/tmp/t", rank=1,
+                world=2, reshape_mode="global_batch", impl="threefry2x32",
+                geometry={"global_batch": 64})
+    base.update(kw)
+    return ElasticCoordinator(**base)
+
+
+def test_rewire_env_port_math_never_compounds(monkeypatch):
+    """MASTER_PORT for generation G is base + G where base is the ORIGINAL
+    launch's port: a process already at generation 2 must un-apply its own
+    offset, or repeated shrinks would drift the port unboundedly."""
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.7")
+    monkeypatch.setenv("MASTER_PORT", "29502")  # base 29500 + gen 2
+    monkeypatch.setenv("PDMT_ELASTIC_GEN", "2")
+    env = _coord().rewire_env(3, 0, 1)
+    assert env == {"RANK": "0", "WORLD_SIZE": "1",
+                   "MASTER_ADDR": "10.0.0.7", "MASTER_PORT": "29503",
+                   "PDMT_ELASTIC_GEN": "3"}
+
+
+def test_reexec_argv_strips_resume_and_forces_env_wireup():
+    """The re-exec'd argv must resume from the SHARED steps dir (any stale
+    --resume/--start_epoch stripped, both spellings) and rendezvous from
+    the rewire env — a scheduler-derived wireup method would re-read the
+    dead world's variables."""
+    tail = ["--parallel", "--elastic", "--resume", "/old/dir",
+            "--start_epoch=3", "--wireup_method", "slurm", "--lr", "0.1"]
+    argv = _coord(argv_tail=tail).reexec_argv()
+    assert argv == ["--parallel", "--elastic", "--lr", "0.1",
+                    "--resume", "/tmp/s.steps", "--wireup_method", "env"]
+    assert _strip_opt(["--a", "--resume=/x", "--b"], "--resume", 1) == \
+        ["--a", "--b"]
+
+
+def test_react_reraises_non_backend_errors():
+    """A program error (shape mismatch, OOM) is NOT a peer loss: react()
+    must fail fast and hand it back, never beacon/rescue on it."""
+    err = RuntimeError("dot_general shape mismatch")
+    with pytest.raises(RuntimeError, match="shape mismatch"):
+        _coord().react(err, {}, journal=None)
+
+
+# -- sampler / pipeline re-sharding ------------------------------------------
+
+def test_sampler_reshard_shard_union_covers_the_epoch():
+    from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
+    s = ShardedSampler(1000, num_replicas=4, rank=1, seed=7)
+    s.set_epoch(2)
+    survivors = [s.reshard(2, r) for r in range(2)]
+    assert all(t.epoch == 2 for t in survivors)
+    union = np.concatenate([t.indices() for t in survivors])
+    # the union re-covers the SAME epoch permutation the old world agreed
+    # on (wrap-padding may duplicate, never drop)
+    assert set(union.tolist()) == set(range(1000))
+    assert np.array_equal(np.sort(s.global_permutation()),
+                          np.sort(survivors[0].global_permutation()))
+
+
+def test_reshard_source_swaps_the_sampler_in_place():
+    from pytorch_ddp_mnist_tpu.parallel.sampler import ShardedSampler
+    from pytorch_ddp_mnist_tpu.pipeline.reader import reshard_source
+
+    class Source:
+        def __init__(self):
+            self.sampler = ShardedSampler(64, num_replicas=4, rank=3)
+            self.batch_size = 8
+
+        def read_batch(self, rows):
+            return rows, rows
+
+    src = Source()
+    src.sampler.set_epoch(5)
+    out = reshard_source(src, 2, 1)
+    assert out is src
+    assert (src.sampler.num_replicas, src.sampler.rank) == (2, 1)
+    assert src.sampler.epoch == 5
+    with pytest.raises(ValueError, match="not pipeline-capable"):
+        reshard_source(object(), 2, 0)
+    src.sampler = object()      # duck-typed sampler without reshard()
+    with pytest.raises(ValueError, match="no reshard"):
+        reshard_source(src, 2, 0)
+
+
+# -- CLI knob hygiene --------------------------------------------------------
+
+def test_cli_rejects_unsound_elastic_combinations_by_name(tmp_path):
+    from pytorch_ddp_mnist_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="needs --elastic"):
+        main(["--reshape", "per_rank", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="add --parallel"):
+        main(["--elastic", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="add --telemetry"):
+        main(["--elastic", "--parallel", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="--ckpt_every_steps"):
+        main(["--elastic", "--parallel", "--telemetry", str(tmp_path)])
+    with pytest.raises(SystemExit, match="drop --cached"):
+        main(["--elastic", "--parallel", "--telemetry", str(tmp_path),
+              "--checkpoint", str(tmp_path / "c.msgpack"),
+              "--ckpt_every_steps", "2", "--cached"])
+    # a fully-valid elastic line is still CLI-only: re-exec needs sys.argv
+    with pytest.raises(SystemExit, match="only available from the CLI"):
+        main(["--elastic", "--parallel", "--telemetry", str(tmp_path),
+              "--checkpoint", str(tmp_path / "c.msgpack"),
+              "--ckpt_every_steps", "2"])
+
+
+def test_configure_defaults_keep_elastic_off():
+    from pytorch_ddp_mnist_tpu.train.config import configure
+    tcfg = configure([])["trainer"]
+    assert tcfg["elastic"] is False
+    assert tcfg["reshape"] is None   # None != "global_batch": explicitly
+    #                                  set without --elastic is detectable
+
+
+# -- the --elastic-off inertness pin -----------------------------------------
+
+def test_non_elastic_run_stamps_no_elastic_meta(tmp_path):
+    """`--elastic` off must stay bitwise-identical to the pre-elastic CLI.
+    The on-disk half of that pin: a plain checkpointed run's manifests
+    carry NO elastic stamps (devices/elastic_gen), so its resume path —
+    geometry comparison included — is byte-for-byte the old behavior. (The
+    in-memory half is the whole rest of the suite: the elastic branch is
+    the only new code path and it is gated on the flag.)"""
+    from pytorch_ddp_mnist_tpu.cli.train import main
+    from pytorch_ddp_mnist_tpu.train.ckpt_manager import peek_latest_meta
+    ckpt = tmp_path / "plain.msgpack"
+    assert main(["--n_epochs", "1", "--limit", "128", "--batch_size", "32",
+                 "--lr", "0.1", "--checkpoint", str(ckpt),
+                 "--ckpt_every_steps", "2",
+                 "--path", str(tmp_path / "data")]) == 0
+    peek = peek_latest_meta(str(ckpt) + ".steps")
+    assert peek is not None
+    assert "devices" not in peek["meta"]
+    assert "elastic_gen" not in peek["meta"]

@@ -150,3 +150,29 @@ def test_backend_wait_env_parsing(monkeypatch, capsys):
         assert backend_wait_env(7.0) == 7.0, bad
     err = capsys.readouterr().err
     assert "PDMT_BACKEND_WAIT" in err
+
+
+def test_backoff_schedule_deterministic_and_growing():
+    """The elastic re-wire probe cadence: same seed -> same schedule,
+    exponential growth under the jitter, never above 1.5x the cap."""
+    from pytorch_ddp_mnist_tpu.parallel.wireup import backoff_schedule
+    import itertools
+    a = list(itertools.islice(backoff_schedule(0.5, 8.0, seed=3), 10))
+    b = list(itertools.islice(backoff_schedule(0.5, 8.0, seed=3), 10))
+    assert a == b
+    c = list(itertools.islice(backoff_schedule(0.5, 8.0, seed=4), 10))
+    assert a != c  # jitter is seed-dependent
+    # every delay sits in [0.5, 1.5) x the capped exponential envelope
+    for attempt, delay in enumerate(a):
+        envelope = min(8.0, 0.5 * 2.0 ** attempt)
+        assert 0.5 * envelope <= delay < 1.5 * envelope, (attempt, delay)
+    # the tail is capped: late delays never exceed 1.5 x cap
+    assert all(d < 1.5 * 8.0 for d in a[6:])
+
+
+def test_backoff_schedule_rejects_bad_shapes():
+    from pytorch_ddp_mnist_tpu.parallel.wireup import backoff_schedule
+    for base, cap, factor in ((0.0, 1.0, 2.0), (-1.0, 1.0, 2.0),
+                              (2.0, 1.0, 2.0), (0.5, 8.0, 1.0)):
+        with pytest.raises(ValueError):
+            next(backoff_schedule(base, cap, factor=factor))
