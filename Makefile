@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke overhead-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -217,12 +217,46 @@ cost-smoke:
 		/tmp/pdmt_cost_smoke/COST.json \
 		--baseline /tmp/pdmt_cost_smoke/COST.json
 
+# Dispatch-forensics smoke (docs/OBSERVABILITY.md §Dispatch forensics): a
+# profiled 2-epoch run (--profile_dispatch 4 samples the device-idle
+# drain every 4th step), then the emitted dispatch records are schema-
+# and contract-validated and gated on the dispatch.* histograms being
+# present, the host-overhead decomposition report renders (with its
+# >=90% phase-coverage assert), the phase-share regression gate
+# round-trips against itself (a run never regresses vs itself), and the
+# Perfetto export is checked to carry the host-dispatch and device-idle
+# lanes.
+overhead-smoke:
+	rm -rf /tmp/pdmt_overhead_smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytorch_ddp_mnist_tpu train --epochs 2 \
+		--limit 512 --batch_size 64 --checkpoint "" \
+		--telemetry /tmp/pdmt_overhead_smoke --profile_dispatch 4
+	$(PY) scripts/check_telemetry.py --require dispatch. \
+		/tmp/pdmt_overhead_smoke
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --overhead \
+		/tmp/pdmt_overhead_smoke
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --overhead --json \
+		/tmp/pdmt_overhead_smoke > /tmp/pdmt_overhead_smoke/self.json
+	$(PY) -m pytorch_ddp_mnist_tpu trace report --overhead \
+		/tmp/pdmt_overhead_smoke \
+		--baseline /tmp/pdmt_overhead_smoke/self.json
+	$(PY) -m pytorch_ddp_mnist_tpu trace export /tmp/pdmt_overhead_smoke \
+		-o /tmp/pdmt_overhead_smoke/trace.chrome.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/pdmt_overhead_smoke/trace.chrome.json')); \
+		lanes = {e['args']['name'] for e in d['traceEvents'] \
+			if e.get('ph') == 'M' and e.get('name') == 'thread_name'}; \
+		assert {'host dispatch', 'device idle'} <= lanes, \
+		'missing dispatch lanes: got %r' % sorted(lanes)"
+
 # The committed pre-merge gate: static contracts first (seconds), then the
 # runtime sanitizers on the live paths (incl. the input pipeline), then
 # the serve request-tracing round trip (also seconds), then the program
-# cost/memory harvest round trip, then the cluster-forensics round trip
-# (collective journal + hang attribution), then the fast test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke cluster-smoke elastic-smoke test-fast
+# cost/memory harvest round trip, then the dispatch-forensics round trip
+# (host overhead decomposition + phase-share gate), then the
+# cluster-forensics round trip (collective journal + hang attribution),
+# then the fast test tier.
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke overhead-smoke cluster-smoke elastic-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -671,6 +671,63 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                 p, k, _ = step(p, k, x, y)
         return jax.tree_util.tree_map(np.asarray, p)
 
+    def dispatch_probe(comm, overlap=False):
+        """One streaming make_dp_train_step per strategy, decomposed by
+        telemetry.dispatch.measure_dispatch_phases — the host-side half
+        of the roofline: named phases for the O the analytic bound leaves
+        unexplained (`trace report --overhead` reads the stamps back)."""
+        from pytorch_ddp_mnist_tpu.telemetry.dispatch import (
+            measure_dispatch_phases)
+        step = make_dp_train_step(mesh, lr=0.01, comm=comm,
+                                  overlap=overlap, model=model,
+                                  param_scale=param_scale)
+        rep = replicated(mesh)
+        state = [jax.device_put(params_host, rep),
+                 jax.random.wrap_key_data(jax.device_put(key_host, rep))]
+        if step.comm_state:
+            state.append(step.place_comm_state(None, state[0]))
+        bs = batch_sharding(mesh)
+        b = per_chip_batch * n
+        x = jax.device_put(x_host[:b].astype(np.float32) / 255.0, bs)
+        y = jax.device_put(y_host[:b], bs)
+
+        def step_once():
+            out = step(state[0], state[1], x, y, *state[2:])
+            state[0], state[1] = out[0], out[1]
+            if step.comm_state:
+                state[2] = out[3]
+            return out
+        return measure_dispatch_phases(step_once, steps=8)
+
+    def overhead_stamps(phases, step_s, bound_s):
+        """The row stamps `trace report --overhead` consumes
+        (telemetry/analysis.py overhead_from_artifact): O's share of the
+        measured step, the probe's per-step phase seconds, how much of O
+        the HOST phases (python_prestep + dispatch) explain, and the
+        worst host phase. sync_wait is excluded from coverage and from
+        `worst` — in the probe it is mostly the device computing, not
+        overhead."""
+        o_s = max(step_s - bound_s, 0.0)
+        host_s = phases["python_prestep"] + phases["dispatch"]
+        window = host_s + phases["sync_wait"]
+        worst = max(("python_prestep", "dispatch"),
+                    key=lambda p: phases[p])
+        return {
+            "overhead_share": (round(o_s / step_s, 4) if step_s > 0
+                               else 0.0),
+            "overhead_phases": {p: round(phases[p], 6)
+                                for p in ("python_prestep", "dispatch",
+                                          "device_idle", "sync_wait")},
+            # clamped at 1.0: the streaming probe's host cost upper-bounds
+            # the fused scan program's O (docs/PERF.md)
+            "overhead_coverage": (round(min(host_s / o_s, 1.0), 4)
+                                  if o_s > 0 else 1.0),
+            "overhead_worst_phase": worst,
+            "overhead_worst_share": (round(phases[worst] / window, 4)
+                                     if window > 0 else 0.0),
+            "overhead_probe_steps": int(phases["steps"]),
+        }
+
     one_dev_rate = measure(make_mesh([1], [DATA_AXIS], jax.devices()[:1]),
                            "pmean")
     # The pmean row below re-runs this probe from a FRESH build and diffs
@@ -709,11 +766,21 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                     # analytic bound follows the row's overlap flag —
                     # max(C, M), the attribution convention (telemetry/
                     # costs.py) — so the stamp and `trace report --cost`
-                    # can never disagree on the same row
+                    # can never disagree on the same row. The overhead
+                    # stamps recompute too: O = T - bound shrinks with
+                    # the tighter bound even though the probe's phase
+                    # seconds (same program) copy over.
+                    ov_bound = max(compute_s, coll_p50)
+                    ov_step_s = ((per_chip_batch * n)
+                                 / base["images_per_sec"])
                     rows.append({**base, "overlap": True,
                                  "analytic_efficiency": round(
-                                     compute_s / max(compute_s, coll_p50),
-                                     4)})
+                                     compute_s / ov_bound, 4),
+                                 **overhead_stamps(
+                                     {**base["overhead_phases"],
+                                      "steps":
+                                      base["overhead_probe_steps"]},
+                                     ov_step_s, ov_bound)})
                     continue
             rate = measure(mesh, comm, overlap)
             leaves = jax.tree_util.tree_leaves(parity_params(comm, overlap))
@@ -762,6 +829,8 @@ def ddp_strategy_rows(*, per_chip_batch: int = 128, epochs: int = DDP_EPOCHS,
                 "collective_s_p50": coll_p50,
                 "parity_max_rel_diff_vs_pmean": rel,
                 "parity_max_abs_diff_vs_pmean": absd,
+                **overhead_stamps(dispatch_probe(comm, overlap),
+                                  step_s, bound_s),
             })
     return rows
 

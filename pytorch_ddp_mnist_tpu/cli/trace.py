@@ -16,6 +16,11 @@ JSONL event traces training and serving emit.
                      # cluster forensics from per-rank collective journals
                      # (--journal runs): desync (exit 3, both ranks named),
                      # per-rank-pair straggler skew, hang report
+    python -m pytorch_ddp_mnist_tpu trace report --overhead /tmp/obs \
+        [--baseline OLD]   # dispatch-overhead attribution (named host
+                           # phases, >=90% coverage assert, worst phase;
+                           # gate: exit 3 when a phase's share grows) —
+                           # target may also be a stamped DDP artifact
     python -m pytorch_ddp_mnist_tpu trace export /tmp/obs -o trace.json
                                                  # load in Perfetto
     python -m pytorch_ddp_mnist_tpu trace cost -o COST.json \
@@ -180,6 +185,41 @@ def _load_serve_report(target: str):
         "them)")
 
 
+def _load_overhead_report(target: str):
+    """The dispatch-overhead report from `target`: a saved `--overhead
+    --json` report, a DDP bench artifact (MULTICHIP_r0X.json — rows
+    stamped by `bench.py --mode ddp`'s dispatch probe), or a
+    `--profile_dispatch` trace dir/file."""
+    import os
+
+    from ..telemetry import analysis
+
+    if os.path.isfile(target) and not target.endswith(".jsonl"):
+        try:
+            with open(target) as f:
+                head = json.load(f)
+        except ValueError:
+            head = None  # not one JSON document: treat as a JSONL trace
+        if isinstance(head, dict):
+            if head.get("report") == analysis.OVERHEAD_REPORT_TAG:
+                return head, None
+            nested = head.get("report")
+            if isinstance(nested, dict) \
+                    and nested.get("report") == analysis.OVERHEAD_REPORT_TAG:
+                return nested, None
+            if isinstance(head.get("strategies"), list):
+                rep = analysis.overhead_from_artifact(head, path=target)
+                if not rep["rows"]:
+                    return None, (f"{target}: artifact carries no "
+                                  f"strategy rows")
+                return rep, None
+    return _load_tagged_report(
+        target, analysis.OVERHEAD_REPORT_TAG, analysis.overhead_report,
+        lambda r: not r["rows"],
+        "no dispatch_phase/dispatch_window points (train with "
+        "--telemetry DIR --profile_dispatch to emit them)")
+
+
 def _cmd_report(a) -> int:
     from ..telemetry import analysis
 
@@ -280,6 +320,55 @@ def _cmd_report(a) -> int:
                              indent=2 if sys.stdout.isatty() else None))
         else:
             print(analysis.format_data_report(report))
+        return 0
+
+    if a.overhead:
+        # the dispatch-overhead attribution report (docs/OBSERVABILITY.md
+        # §Dispatch forensics): named host phases + coverage of the
+        # profiled window / the roofline's O, worst phase; with
+        # --baseline, the phase-SHARE regression gate (exit 3, sub-ms
+        # phases exempt). Coverage below OVERHEAD_COVERAGE_MIN is a
+        # hard failure — the decomposition stopped explaining the
+        # overhead it exists to attribute.
+        report, err = _load_overhead_report(a.target)
+        if err:
+            print(f"trace report: {err}", file=sys.stderr)
+            return 1
+        if a.baseline:
+            baseline, err = _load_overhead_report(a.baseline)
+            if err:
+                print(f"trace report: baseline {err}", file=sys.stderr)
+                return 1
+            diff = analysis.compare_overhead(report, baseline,
+                                             threshold=a.threshold)
+            if a.json:
+                print(json.dumps({"report": report, "comparison": diff},
+                                 indent=2 if sys.stdout.isatty() else None))
+            else:
+                print(analysis.format_overhead_report(report))
+                print(analysis.format_compare_overhead(diff))
+            if not diff["rows"]:
+                print("trace report: no phase share overlaps the baseline "
+                      "— the gate checked nothing", file=sys.stderr)
+                return 1
+            return 3 if diff["regressions"] else 0
+        if a.json:
+            print(json.dumps(report,
+                             indent=2 if sys.stdout.isatty() else None))
+        else:
+            print(analysis.format_overhead_report(report))
+        low = [r for r in report["rows"]
+               if isinstance(r.get("coverage"), (int, float))
+               and not r.get("note")
+               and r["coverage"] < analysis.OVERHEAD_COVERAGE_MIN]
+        if low:
+            r = low[0]
+            print(f"trace report: {r['program']}: phases explain only "
+                  f"{r['coverage']:.0%} of the overhead window (floor "
+                  f"{analysis.OVERHEAD_COVERAGE_MIN:.0%}) — unprofiled "
+                  f"host work grew outside the named phases",
+                  file=sys.stderr)
+            return 1
         return 0
 
     if a.serve:
@@ -426,6 +515,18 @@ def main(argv=None) -> int:
                         "pair, and the hang report (open collectives + "
                         "every rank's last journal position) "
                         "(docs/OBSERVABILITY.md §Cluster forensics)")
+    r.add_argument("--overhead", action="store_true",
+                   help="the dispatch-overhead attribution report instead "
+                        "of the train phase report: TARGET is a "
+                        "--profile_dispatch trace dir, a saved --json "
+                        "report, or a DDP bench artifact with stamped "
+                        "overhead decompositions — named host phases "
+                        "(python_prestep/dispatch/device_idle/sync_wait), "
+                        "coverage of the overhead window (exit 1 below "
+                        "90%%), worst phase; with --baseline, the "
+                        "phase-share regression gate — exit 3 past "
+                        "--threshold, sub-ms phases exempt "
+                        "(docs/OBSERVABILITY.md §Dispatch forensics)")
     r.add_argument("--cost", action="store_true",
                    help="the program-forensics report: TARGET is a saved "
                         "`trace cost` report (COST_r0X.json) or a DDP "
@@ -509,7 +610,8 @@ def main(argv=None) -> int:
     if a.cmd == "report":
         if a.threshold <= 0:
             p.error("--threshold must be > 0")
-        picked = [f for f in ("serve", "data", "cost", "cluster")
+        picked = [f for f in ("serve", "data", "cost", "cluster",
+                              "overhead")
                   if getattr(a, f)]
         if len(picked) > 1:
             p.error(f"--{picked[0]} and --{picked[1]} select different "

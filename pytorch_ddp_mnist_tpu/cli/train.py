@@ -339,6 +339,18 @@ def main(argv=None) -> int:
             raise SystemExit(f"--journal needs the XLA step program (it "
                              f"declares its collective schedule); --kernel "
                              f"{tcfg['kernel']} owns its own comms")
+    if tcfg.get("profile_dispatch"):
+        # same by-name hygiene: a profiler whose records nobody persists
+        # or whose trainer has no step boundary refuses to start
+        if not tcfg["telemetry"]:
+            raise SystemExit("--profile_dispatch flushes dispatch_phase/"
+                             "dispatch_window points into the JSONL trace; "
+                             "add --telemetry DIR")
+        if tcfg["fused"]:
+            raise SystemExit("--profile_dispatch decomposes the per-step/"
+                             "per-chunk host boundary; --fused runs all "
+                             "epochs as ONE device program with no such "
+                             "boundary — drop --fused")
     if tcfg["ddp_comm"] != "pmean":
         # the comm strategies are per-step XLA collectives over the 'dp'
         # mesh — meaningless serially, and the whole-epoch kernel owns its
@@ -1026,6 +1038,15 @@ def main(argv=None) -> int:
         watchdog.seed_good(state, epoch=tcfg["start_epoch"],
                            offset=start_offset, step=start_step)
 
+    # --profile_dispatch K: the per-step host-boundary decomposition
+    # (telemetry/dispatch.py; docs/OBSERVABILITY.md §Dispatch forensics).
+    # The hooks in the loops hold a NullProfiler otherwise, so this is
+    # the only place a syncing profiler can come from.
+    dispatch_profiler = None
+    if tcfg.get("profile_dispatch"):
+        dispatch_profiler = telemetry.DispatchProfiler(
+            sample_every=int(tcfg["profile_dispatch"]))
+
     # --metrics_port: the live pull endpoint (telemetry/prom.py) — the
     # unified registry as Prometheus text at GET /metrics, the health
     # verdict at GET /healthz, from a stdlib daemon thread. Rank 0 only
@@ -1249,7 +1270,8 @@ def main(argv=None) -> int:
                               step_hook=step_hook,
                               eval_perm=eval_perm,
                               watchdog=watchdog,
-                              prefetch_depth=tcfg["prefetch_depth"])
+                              prefetch_depth=tcfg["prefetch_depth"],
+                              dispatch_profiler=dispatch_profiler)
     else:
         if tcfg["dropout_rng"] == "torch":
             # Masks stream from torch's bitwise CPU bernoulli stream
@@ -1285,7 +1307,8 @@ def main(argv=None) -> int:
                        watchdog=watchdog,
                        input_workers=tcfg["input_workers"],
                        prefetch_depth=tcfg["prefetch_depth"],
-                       journal=journal)
+                       journal=journal,
+                       dispatch_profiler=dispatch_profiler)
     if coordinator is not None:
         # The elastic reaction intercepts BEFORE the outage machinery: a
         # RuntimeError with a backend-loss signature may be a DEAD PEER

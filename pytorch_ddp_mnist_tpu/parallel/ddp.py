@@ -289,11 +289,17 @@ def make_dp_train_step(mesh: Mesh, lr: float, *, dtype: str = "float32",
                 mesh, params, host=host, bucket_elems=be, quant_block=qb)
 
         step.place_comm_state = place_comm_state
+        # declared donation contract — the statics donation-aliasing
+        # audit cross-checks the TRACED program against this tuple, so
+        # silently dropping a donate_argnums entry fails by name
+        step.donates = ("params", "key", "resid")
     else:
         jitted = jax.jit(program, donate_argnums=(0, 1))
 
         def step(params, key, x, y):
             return jitted(params, key, x, y)
+
+        step.donates = ("params", "key")
 
     step.ddp_comm = comm
     step.ddp_mesh = mesh

@@ -28,7 +28,15 @@ contracts the repo otherwise guards with hand-written per-test pins:
     records per step — telemetry/cluster.py) matches the audited
     program's payload collectives entry for entry (same multiset of
     kind + ring bytes): the journal can never describe a program nobody
-    ran.
+    ran;
+  * **donation-aliasing** — the JITTED wrappers (make_dp_train_step /
+    make_dp_run_fn) donate exactly the inputs they declare (`.donates`:
+    params + key, plus the int8 error-feedback residual) and never a
+    data input: the traced program's top-level pjit `donated_invars`
+    flags are matched against the public argument tree by shape+dtype,
+    so a silently dropped `donate_argnums` entry — which would double
+    the params' HBM footprint — fails BY NAME (the regression tripwire
+    ROADMAP item 3's buffer-donation work gates on).
 
 Two program forms per config: `step` (parallel.ddp.dp_step_program — the
 streaming make_dp_train_step body) and `run` (train.scan.make_dp_run_fn —
@@ -129,6 +137,7 @@ class AuditReport:
     f64_ops: int = 0
     callbacks: int = 0
     ok: bool = True
+    donated_labels: List[str] = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {"comm": self.comm, "overlap": self.overlap,
@@ -136,7 +145,8 @@ class AuditReport:
                 "n_buckets": self.n_buckets,
                 "payload_ops": [o.to_json() for o in self.payload_ops],
                 "wire_bytes_program": self.wire_bytes_program,
-                "wire_bytes_model": self.wire_bytes_model, "ok": self.ok}
+                "wire_bytes_model": self.wire_bytes_model, "ok": self.ok,
+                "donated": list(self.donated_labels)}
 
 
 # -- jaxpr walking -----------------------------------------------------------
@@ -296,6 +306,41 @@ def build_run_program(comm: str, overlap: bool = False, *,
             jnp.float32)
         return run, (params, key, x_all, y_all, idxs, resid)
     return run, (params, key, x_all, y_all, idxs)
+
+
+def build_jit_step(comm: str, overlap: bool = False, *,
+                   n_dev: int = N_DEVICES,
+                   batch: int = BATCH_PER_DEVICE,
+                   bucket_elems: Optional[int] = None,
+                   quant_block: Optional[int] = None,
+                   model: str = "mlp", param_scale: int = 1):
+    """(step, example_args) for the JITTED streaming DP step
+    (parallel.ddp.make_dp_train_step) over an AbstractMesh — the wrapper
+    whose `donate_argnums` the donation-aliasing contract audits. Public
+    argument order (params, key, x, y[, resid]); the wrapper carries its
+    declared `.donates` tuple."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel import collectives
+    from ..parallel.ddp import make_dp_train_step
+    step = make_dp_train_step(_mesh(n_dev), 0.01, comm=comm,
+                              overlap=overlap, bucket_elems=bucket_elems,
+                              quant_block=quant_block,
+                              model=model, param_scale=param_scale)
+    params = _example_params(model, param_scale)
+    key = jax.random.PRNGKey(1)
+    x = jnp.zeros((n_dev * batch, 784), jnp.float32)
+    y = jnp.zeros((n_dev * batch,), jnp.int32)
+    if collectives.carries_state(comm):
+        qb = collectives.QUANT_BLOCK if quant_block is None else quant_block
+        be = (collectives.DEFAULT_BUCKET_ELEMS if bucket_elems is None
+              else bucket_elems)
+        resid = jnp.zeros(
+            (n_dev, collectives.comm_state_elems(
+                params, n_dev, bucket_elems=be, quant_block=qb)),
+            jnp.float32)
+        return step, (params, key, x, y, resid)
+    return step, (params, key, x, y)
 
 
 # -- the audit ---------------------------------------------------------------
@@ -501,6 +546,104 @@ def audit_collected(ops: List[CollectiveOp], f64_ops: List, callbacks: List,
                        wire_bytes_model=model)
 
 
+def collect_donation(program, args):
+    """Trace `program(*args)` and read the `donated_invars` flags off its
+    top-level pjit eqn(s): `{(shape, dtype): [donated, ...]}` over every
+    jitted-call input, plus whether ANY donation metadata was found at
+    all (a wrapper jitted without `donate_argnums` has the flags all
+    False — still "found"; an un-jitted program has no pjit eqn)."""
+    import jax
+    closed = jax.make_jaxpr(program)(*args)
+    by_sig: dict = {}
+    found = False
+    for eqn in closed.jaxpr.eqns:
+        donated = eqn.params.get("donated_invars")
+        if donated is None:
+            continue
+        found = True
+        for v, d in zip(eqn.invars, donated):
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            sig = (tuple(aval.shape), str(aval.dtype))
+            by_sig.setdefault(sig, []).append(bool(d))
+    return by_sig, found
+
+
+def _donation_arg_labels(args, form: str):
+    """Flatten the public argument tuple to (name, shape, dtype) leaves,
+    named by the builders' fixed argument order."""
+    import jax
+    names = (("params", "key", "x", "y", "resid") if form == "step"
+             else ("params", "key", "x_all", "y_all", "idxs", "resid"))
+    # stateful builders append resid LAST in the public order
+    if len(args) == len(names) - 1:
+        names = names[:-1]
+    out = []
+    for name, val in zip(names, args):
+        for leaf in jax.tree_util.tree_leaves(val):
+            out.append((name, tuple(leaf.shape), str(leaf.dtype)))
+    return out
+
+
+def audit_donation(program, args, comm: str, overlap: bool, form: str, *,
+                   n_dev: int = N_DEVICES) -> List[str]:
+    """The donation-aliasing contract: the traced program donates exactly
+    the inputs the wrapper DECLARES (`.donates` — params + key, plus the
+    int8 error-feedback residual) and never a data input. Matching is by
+    (shape, dtype) signature against the public argument tree (the
+    geometry keeps every argument class signature-distinct). Raises
+    AuditViolation naming the first leaf whose donation flag disagrees;
+    returns the sorted donated label set otherwise."""
+    cfg = f"comm={comm} overlap={overlap} form={form}"
+    declared = getattr(program, "donates", None)
+    if declared is None:
+        raise AuditViolation(
+            "donation-aliasing", cfg,
+            "the jitted wrapper declares no .donates tuple — the traced "
+            "donation flags have nothing to be cross-checked against")
+    stateful = len(args) == (5 if form == "step" else 6)
+    expected = {"params", "key"} | ({"resid"} if stateful else set())
+    if set(declared) != expected:
+        raise AuditViolation(
+            "donation-aliasing", cfg,
+            f"declared .donates {sorted(declared)} != the strategy's "
+            f"expected donation set {sorted(expected)}")
+    by_sig, found = collect_donation(program, args)
+    if not found:
+        raise AuditViolation(
+            "donation-aliasing", cfg,
+            "no donated_invars on any top-level pjit eqn — the step is "
+            "not a jitted program at all (donation audits the jit "
+            "wrapper, not the raw python body)")
+    donated = set()
+    for name, shape, dtype in _donation_arg_labels(args, form):
+        flags = by_sig.get((shape, dtype))
+        if flags is None:
+            raise AuditViolation(
+                "donation-aliasing", cfg,
+                f"input {name} {dtype}{list(shape)} never appears among "
+                f"the jitted program's invars — the tracer and the "
+                f"builder disagree about the argument tree")
+        want = name in declared
+        if want and not all(flags):
+            raise AuditViolation(
+                "donation-aliasing", cfg,
+                f"input {name} {dtype}{list(shape)} is declared donated "
+                f"but the traced program does NOT donate it — a dropped "
+                f"donate_argnums entry silently doubles its HBM "
+                f"footprint")
+        if not want and any(flags):
+            raise AuditViolation(
+                "donation-aliasing", cfg,
+                f"data input {name} {dtype}{list(shape)} IS donated — "
+                f"donating a batch input invalidates the caller's live "
+                f"buffer")
+        if want:
+            donated.add(name)
+    return sorted(donated)
+
+
 def audit_program(program, args, comm: str, overlap: bool, form: str, *,
                   n_dev: int = N_DEVICES,
                   bucket_elems: Optional[int] = None,
@@ -521,8 +664,17 @@ def audit_step_program(comm: str, overlap: bool = False, *,
     prog, args = build_step_program(comm, overlap, n_dev=n_dev,
                                     bucket_elems=bucket_elems,
                                     quant_block=quant_block)
-    return audit_program(prog, args, comm, overlap, "step", n_dev=n_dev,
-                         bucket_elems=bucket_elems, quant_block=quant_block)
+    report = audit_program(prog, args, comm, overlap, "step", n_dev=n_dev,
+                           bucket_elems=bucket_elems,
+                           quant_block=quant_block)
+    # donation-aliasing audits the JIT WRAPPER (the raw step body above
+    # carries no donation metadata), traced over the same AbstractMesh
+    step, jargs = build_jit_step(comm, overlap, n_dev=n_dev,
+                                 bucket_elems=bucket_elems,
+                                 quant_block=quant_block)
+    report.donated_labels = audit_donation(step, jargs, comm, overlap,
+                                           "step", n_dev=n_dev)
+    return report
 
 
 def audit_run_program(comm: str, overlap: bool = False, *,
@@ -532,8 +684,15 @@ def audit_run_program(comm: str, overlap: bool = False, *,
     prog, args = build_run_program(comm, overlap, n_dev=n_dev,
                                    bucket_elems=bucket_elems,
                                    quant_block=quant_block)
-    return audit_program(prog, args, comm, overlap, "run", n_dev=n_dev,
-                         bucket_elems=bucket_elems, quant_block=quant_block)
+    report = audit_program(prog, args, comm, overlap, "run", n_dev=n_dev,
+                           bucket_elems=bucket_elems,
+                           quant_block=quant_block)
+    # build_run_program already returns the jitted wrapper — one trace
+    # serves both audits in principle, but collect_donation retraces so
+    # the collective walker stays donation-agnostic
+    report.donated_labels = audit_donation(prog, args, comm, overlap,
+                                           "run", n_dev=n_dev)
+    return report
 
 
 def audit_matrix(comms: Sequence[str] = COMMS,

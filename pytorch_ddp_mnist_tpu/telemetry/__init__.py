@@ -49,6 +49,14 @@ shared by train, serve, and bench alike:
     the compile/HBM regression gate (`trace report --cost --baseline`),
     and OOM forensics (`looks_like_oom` + the flight-recorder program
     memory table).
+  * `dispatch.py`  — DISPATCH forensics: the per-step host-timeline
+    profiler that decomposes PR 12's overhead O into named phases
+    (`python_prestep` / `dispatch` / `device_idle` / `sync_wait`) as
+    `dispatch.*` histograms + flight samples + per-epoch trace points;
+    `NullProfiler` zero-overhead default, sampled 1-in-K device-idle
+    drain, `measure_dispatch_phases` bench probe. Front doors:
+    `cli/train.py --profile_dispatch`, `trace report --overhead`,
+    `make overhead-smoke`.
   * `cluster.py`   — CLUSTER forensics: the per-rank collective journal
     (static kinds/bytes from the audited schedule, host boundary stamps;
     NullJournal zero-overhead default), cross-rank desync detection,
@@ -79,9 +87,14 @@ from .runtime import (collect_memory, compile_attribution,  # noqa: F401
                       install_memory_watermarks, label_compiles,
                       process_index_cached, record_engine_compiles,
                       record_memory_point)
-from .analysis import (analyze, compare, cost_record_errors,  # noqa: F401
-                       load_trace, serve_report, serve_structure_errors,
+from .analysis import (analyze, compare, compare_overhead,  # noqa: F401
+                       cost_record_errors, dispatch_record_errors,
+                       load_trace, overhead_from_artifact, overhead_report,
+                       serve_report, serve_structure_errors,
                        span_structure_errors, trace_files)
+from .dispatch import (DispatchProfiler, NullProfiler,  # noqa: F401
+                       measure_dispatch_phases)
+from . import dispatch  # noqa: F401
 from .costs import (CostRecord, attribution_from_artifact,  # noqa: F401
                     build_cost_report, compare_cost, harvest_engine,
                     harvest_program, harvest_step_matrix, looks_like_oom,

@@ -70,6 +70,31 @@ COST_NUMERIC_FIELDS = (
     "output_bytes", "temp_bytes", "generated_code_bytes", "alias_bytes",
     "peak_bytes", "analytic_flops", "wire_bytes", "compile_s")
 
+# -- the dispatch-forensics record contract (telemetry/dispatch.py emits
+# these as `point` events at each epoch flush; literals here so the
+# file-loading checker stays framework-free — tests pin them against
+# dispatch.py's catalog). The phase catalog is the step-boundary
+# decomposition of PR 12's overhead O (docs/OBSERVABILITY.md §Dispatch
+# forensics): python_prestep (loop bookkeeping before the jitted call),
+# dispatch (inside the jitted call until the async arrays return),
+# device_idle (the DEVICE's view of the gap between consecutive
+# executions, probed on sampled steps), sync_wait (the per-epoch
+# loss/health fetch). --
+DISPATCH_PHASE_POINT = "dispatch_phase"
+DISPATCH_WINDOW_POINT = "dispatch_window"
+DISPATCH_PHASES = ("python_prestep", "dispatch", "device_idle", "sync_wait")
+# device_idle observes the SAME wall interval python_prestep + dispatch
+# occupy on the host (queue empty until the next enqueue completes), so
+# coverage counts each host interval exactly once.
+DISPATCH_COVERAGE_PHASES = ("python_prestep", "dispatch", "sync_wait")
+OVERHEAD_REPORT_TAG = "dispatch_overhead_report"
+# the acceptance floor: measured phases must explain at least this share
+# of the window (trace runs) / of the roofline's O (bench artifacts)
+OVERHEAD_COVERAGE_MIN = 0.90
+# share-ratio gate exemption: below this absolute phase total the
+# numerator is scheduler noise (the data/serve sub-ms convention)
+OVERHEAD_SUBMS_EXEMPT_S = 1e-3
+
 
 def skew(values) -> Tuple[float, float]:
     """(spread, spread as % of mean) of a set of durations — THE straggler
@@ -346,6 +371,51 @@ def cost_record_errors(segment: List[dict]) -> List[Tuple[int, str]]:
                 errors.append((line, f"program_cost field {fld!r} must be "
                                      f"a non-negative number when "
                                      f"present; got {v!r}"))
+    return errors
+
+
+def dispatch_record_errors(segment: List[dict]) -> List[Tuple[int, str]]:
+    """Violations of the dispatch-forensics point-record contract
+    (telemetry/dispatch.py emits `dispatch_phase` / `dispatch_window`
+    points at each epoch flush) within ONE segment, as (line_no, message)
+    pairs — shared with the file-loading checker like
+    `cost_record_errors`. A phase record must name a KNOWN phase
+    (DISPATCH_PHASES — an unknown name means the writer and reader
+    catalogs drifted), carry a non-negative `total_s`, and a non-negative
+    int `step` index; a window record must carry non-negative `window_s`
+    and `attributed_s`."""
+    errors: List[Tuple[int, str]] = []
+    for rec in segment:
+        if rec.get("kind") != "point":
+            continue
+        name = rec.get("name")
+        line = rec.get("_line", 0)
+        attrs = rec.get("attrs") or {}
+        if name == DISPATCH_PHASE_POINT:
+            phase = attrs.get("phase")
+            if phase not in DISPATCH_PHASES:
+                errors.append((line, f"dispatch_phase record names unknown "
+                                     f"phase {phase!r}; known: "
+                                     f"{DISPATCH_PHASES}"))
+            total = attrs.get("total_s")
+            if not isinstance(total, (int, float)) \
+                    or isinstance(total, bool) or total < 0:
+                errors.append((line, f"dispatch_phase total_s must be a "
+                                     f"non-negative number; got {total!r}"))
+            step = attrs.get("step")
+            if not isinstance(step, int) or isinstance(step, bool) \
+                    or step < 0:
+                errors.append((line, f"dispatch_phase step must be a "
+                                     f"non-negative int index; got "
+                                     f"{step!r}"))
+        elif name == DISPATCH_WINDOW_POINT:
+            for fld in ("window_s", "attributed_s"):
+                v = attrs.get(fld)
+                if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                        or v < 0:
+                    errors.append((line, f"dispatch_window {fld} must be a "
+                                         f"non-negative number; got "
+                                         f"{v!r}"))
     return errors
 
 
@@ -1109,4 +1179,225 @@ def format_compare(diff: dict) -> str:
     n = len(diff["regressions"])
     verdict = f"FAIL — {n} phase stat(s) past threshold" if n else "PASS"
     lines.append(f"regression gate: {verdict}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-overhead attribution (trace report --overhead)
+# ---------------------------------------------------------------------------
+
+def _overhead_row(program: str, phases_s: dict, *, window_s: float,
+                  steps: int, coverage: float,
+                  overhead_share: Optional[float] = None,
+                  note: Optional[str] = None) -> dict:
+    """One report row: per-phase totals + shares of the window, coverage,
+    and the worst HOST phase (device_idle is the device-side view of the
+    same interval python_prestep + dispatch occupy — never 'worst')."""
+    phases = {}
+    for phase in DISPATCH_PHASES:
+        total = phases_s.get(phase)
+        if not isinstance(total, (int, float)):
+            continue
+        phases[phase] = {
+            "total_s": float(total),
+            "share": (float(total) / window_s) if window_s > 0 else 0.0,
+        }
+    host = [(p, phases[p]["total_s"]) for p in DISPATCH_COVERAGE_PHASES
+            if p in phases]
+    worst = max(host, key=lambda it: it[1]) if host else (None, 0.0)
+    row = {
+        "program": program,
+        "window_s": window_s,
+        "steps": int(steps),
+        "phases": phases,
+        "attributed_s": sum(s for _p, s in host),
+        "coverage": coverage,
+        "worst_phase": worst[0],
+        "worst_share": ((worst[1] / window_s) if window_s > 0 else 0.0),
+    }
+    if overhead_share is not None:
+        row["overhead_share"] = overhead_share
+    if note:
+        row["note"] = note
+    return row
+
+
+def overhead_report(paths: List[str]) -> dict:
+    """The dispatch-overhead decomposition from one or many `--telemetry
+    --profile_dispatch` traces: per-phase totals pooled across epochs and
+    processes, shares of the profiled step-boundary window, coverage =
+    attributed / window (what share of the window the named phases
+    explain — falls below OVERHEAD_COVERAGE_MIN when someone grows
+    unprofiled loop work), and the worst host phase. One row per
+    process's trace (label `train` / `train@rankN`)."""
+    records, errors = load_traces(paths)
+    by_file: dict = {}
+    for rec in records:
+        by_file.setdefault(rec["_file"], []).append(rec)
+    rows = []
+    for fname in sorted(by_file):
+        phases_s: dict = {}
+        window_s = attributed_s = 0.0
+        steps = 0
+        proc = 0
+        seen = False
+        for rec in by_file[fname]:
+            if rec.get("kind") != "point":
+                continue
+            attrs = rec.get("attrs") or {}
+            if rec.get("name") == DISPATCH_PHASE_POINT:
+                phase, total = attrs.get("phase"), attrs.get("total_s")
+                if phase in DISPATCH_PHASES \
+                        and isinstance(total, (int, float)):
+                    phases_s[phase] = phases_s.get(phase, 0.0) \
+                        + float(total)
+                    seen = True
+            elif rec.get("name") == DISPATCH_WINDOW_POINT:
+                window_s += float(attrs.get("window_s") or 0.0)
+                attributed_s += float(attrs.get("attributed_s") or 0.0)
+                steps += int(attrs.get("steps") or 0)
+                proc = int(rec.get("proc", 0))
+                seen = True
+        if not seen:
+            continue
+        coverage = (attributed_s / window_s) if window_s > 0 else 1.0
+        label = "train" if proc == 0 else f"train@rank{proc}"
+        rows.append(_overhead_row(label, phases_s, window_s=window_s,
+                                  steps=steps, coverage=coverage))
+    return {"report": OVERHEAD_REPORT_TAG, "v": 1,
+            "files": sorted(by_file), "load_errors": errors, "rows": rows}
+
+
+def overhead_from_artifact(artifact: dict,
+                           path: str = "<artifact>") -> dict:
+    """The same report shape from a DDP bench artifact (the
+    `MULTICHIP_r0X.json` shape) whose rows carry the `overhead_phases`
+    stamp (`bench.py --mode ddp` measures a streaming-step dispatch probe
+    per strategy). The window is the probe's full step-boundary wall
+    (host phases sum to it by construction); `coverage` is the stamped
+    share of the roofline's O = T - bound that the host phases explain,
+    clamped at 1.0 when the streaming probe's host cost exceeds the
+    fused program's O (an upper-bound attribution — docs/PERF.md).
+    Legacy rows without the stamp degrade to a named note, never a
+    silent skip."""
+    rows = []
+    for row in artifact.get("strategies") or []:
+        if not isinstance(row, dict):
+            continue
+        label = str(row.get("strategy", "?"))
+        if row.get("overlap"):
+            label += "+overlap"
+        phases_s = row.get("overhead_phases")
+        if not isinstance(phases_s, dict):
+            rows.append({"program": label, "window_s": 0.0, "steps": 0,
+                         "phases": {}, "attributed_s": 0.0,
+                         "coverage": None, "worst_phase": None,
+                         "worst_share": 0.0,
+                         "note": "no overhead_phases stamp (artifact "
+                                 "predates the dispatch probe)"})
+            continue
+        window_s = sum(float(phases_s.get(p) or 0.0)
+                       for p in DISPATCH_COVERAGE_PHASES)
+        cov = row.get("overhead_coverage")
+        out = _overhead_row(
+            label, phases_s, window_s=window_s,
+            steps=int(row.get("overhead_probe_steps") or 0),
+            coverage=(float(cov) if isinstance(cov, (int, float))
+                      else None),
+            overhead_share=row.get("overhead_share"))
+        # bench computes worst over the O constituents only (the probe's
+        # sync_wait is mostly the device computing, not overhead) —
+        # prefer its stamp over the generic recomputation
+        if row.get("overhead_worst_phase") in DISPATCH_PHASES:
+            out["worst_phase"] = row["overhead_worst_phase"]
+            if isinstance(row.get("overhead_worst_share"), (int, float)):
+                out["worst_share"] = float(row["overhead_worst_share"])
+        rows.append(out)
+    return {"report": OVERHEAD_REPORT_TAG, "v": 1, "files": [path],
+            "load_errors": [], "rows": rows}
+
+
+def compare_overhead(new: dict, baseline: dict,
+                     threshold: float = 1.5) -> dict:
+    """The phase-SHARE regression gate (`trace report --overhead
+    --baseline OLD`): one row per (program, phase) present in both
+    reports. Every dispatch phase is overhead ROADMAP item 3 exists to
+    shrink — better-smaller, so the ratio is new_share/old_share (the
+    data/serve share-gate convention) and a regression is a ratio past
+    `threshold`, UNLESS the new run's absolute phase total is
+    sub-millisecond (`OVERHEAD_SUBMS_EXEMPT_S`: at that scale the
+    numerator is scheduler noise). Returns the {"threshold", "rows",
+    "regressions"} shape every other gate shares; cli/trace.py turns
+    regressions into exit 3."""
+    new_rows = {r["program"]: r for r in new.get("rows") or []
+                if r.get("phases")}
+    old_rows = {r["program"]: r for r in baseline.get("rows") or []
+                if r.get("phases")}
+    rows, regressions = [], []
+    for program in sorted(set(new_rows) & set(old_rows)):
+        np_, op = new_rows[program]["phases"], old_rows[program]["phases"]
+        for phase in DISPATCH_PHASES:
+            if phase not in np_ or phase not in op:
+                continue
+            old_v, new_v = op[phase]["share"], np_[phase]["share"]
+            if old_v > 0:
+                ratio = new_v / old_v
+            else:
+                ratio = math.inf if new_v > 0 else 1.0
+            exempt = np_[phase]["total_s"] < OVERHEAD_SUBMS_EXEMPT_S
+            row = {"program": program, "phase": phase,
+                   "baseline_share": old_v, "new_share": new_v,
+                   "ratio": ratio,
+                   "regressed": ratio > threshold and not exempt}
+            rows.append(row)
+            if row["regressed"]:
+                regressions.append(row)
+    return {"threshold": threshold, "rows": rows,
+            "regressions": regressions}
+
+
+def format_overhead_report(report: dict) -> str:
+    lines = [f"dispatch overhead report: {len(report['rows'])} program(s)"]
+    for row in report["rows"]:
+        if row.get("note"):
+            lines.append(f"  {row['program']:<16} {row['note']}")
+            continue
+        cov = row.get("coverage")
+        cov_txt = f"{cov:.0%}" if isinstance(cov, (int, float)) else "n/a"
+        share_txt = ""
+        if isinstance(row.get("overhead_share"), (int, float)):
+            share_txt = f"  overhead_share={row['overhead_share']:.0%}"
+        lines.append(f"  {row['program']:<16} window {row['window_s']:.4f}s"
+                     f" over {row['steps']} step(s), coverage {cov_txt}"
+                     f"{share_txt}")
+        for phase in DISPATCH_PHASES:
+            st = row["phases"].get(phase)
+            if st:
+                lines.append(f"    {phase:<16} {st['total_s']:>10.4f}s  "
+                             f"{st['share']:>7.1%}")
+        if row.get("worst_phase"):
+            lines.append(f"    worst phase: {row['worst_phase']} "
+                         f"({row['worst_share']:.1%} of window)")
+    if not report["rows"]:
+        lines.append("  (no dispatch records — not a --profile_dispatch "
+                     "run or stamped artifact?)")
+    return "\n".join(lines)
+
+
+def format_compare_overhead(diff: dict) -> str:
+    lines = [f"overhead baseline comparison (gate: share ratio > "
+             f"{diff['threshold']:g}x):"]
+    for row in diff["rows"]:
+        verdict = "REGRESSION" if row["regressed"] else "ok"
+        ratio = ("inf" if math.isinf(row["ratio"])
+                 else f"{row['ratio']:.2f}x")
+        lines.append(f"  {row['program']:<16} {row['phase']:<16} "
+                     f"{row['baseline_share']:.1%} -> "
+                     f"{row['new_share']:.1%}  ({ratio})  {verdict}")
+    if not diff["rows"]:
+        lines.append("  (no program/phase overlaps baseline — "
+                     "nothing gated)")
+    n = len(diff["regressions"])
+    verdict = f"FAIL — {n} phase share(s) past threshold" if n else "PASS"
+    lines.append(f"phase-share gate: {verdict}")
     return "\n".join(lines)

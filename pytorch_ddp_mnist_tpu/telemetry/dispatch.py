@@ -1,0 +1,247 @@
+"""Dispatch forensics: the per-step host-timeline profiler that decomposes
+PR 12's overhead O = T - bound into NAMED phases.
+
+The roofline attribution (telemetry/costs.py, MULTICHIP_r07) proved the
+arXiv:1810.11112 finding at this scale: 38-69% of every DDP strategy's
+step is neither compute nor comm — it is the host. But "overhead" is not
+actionable until it has names. This module splits the step boundary the
+way analysis.py's stage report split serve e2e latency:
+
+  * ``python_prestep`` — loop bookkeeping between the previous jitted
+    call returning and the next one being entered (batch fetch handoff,
+    journal stamps, python glue);
+  * ``dispatch``      — inside the jitted call until it returns the
+    async arrays (argument flattening, executable lookup, enqueue);
+  * ``device_idle``   — the DEVICE's view of the same boundary: how long
+    the queue sits empty between consecutive executions. Probing this
+    needs a drain, so it is sampled 1-in-K (``sample_every``) via a
+    ``jax.block_until_ready`` bracket on the PREVIOUS step's outputs —
+    steady-state steps stay sync-free, and the bracket is re-stamped so
+    the drain itself pollutes neither ``python_prestep`` nor
+    ``dispatch``;
+  * ``sync_wait``     — the per-epoch loss/health fetch (the one
+    deliberate sync the loop already performs).
+
+Write side: per-step samples land in ``dispatch.<phase>`` registry
+histograms plus the flight ring (constant memory, nothing on disk on the
+happy path); per-epoch totals flush as two trace ``point`` kinds —
+``dispatch_phase`` (one per phase) and ``dispatch_window`` (window vs
+attributed seconds, the coverage numerator/denominator). The read side
+(`trace report --overhead`, analysis.overhead_report) asserts the named
+phases explain >= analysis.OVERHEAD_COVERAGE_MIN of the window.
+
+The default is ``NullProfiler``: every hook a no-op, zero host syncs,
+pinned bitwise-identical by tests/test_telemetry.py — instrumented call
+sites never branch, exactly the NullTracer/NullJournal contract.
+
+``measure_dispatch_phases`` is the bench-side probe: given a closure
+that runs ONE streaming step and returns its async outputs, it measures
+the same decomposition synchronously (block every step) so
+``bench.py --mode ddp`` can stamp per-strategy phase attributions into
+MULTICHIP artifacts without a live profiler.
+
+Imports jax lazily (only on the sampled drain path): the module stays
+importable on jax-less hosts, like the rest of telemetry/.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from . import flight
+from .analysis import (DISPATCH_COVERAGE_PHASES, DISPATCH_PHASE_POINT,
+                       DISPATCH_PHASES, DISPATCH_WINDOW_POINT)
+from .events import get_tracer
+from .registry import get_registry
+
+# sampled-drain default: probe the device-idle gap on 1-in-16 steps
+DEFAULT_SAMPLE_EVERY = 16
+
+
+class NullProfiler:
+    """The zero-overhead default: every hook a no-op. Call sites in
+    train/loop.py and train/scan.py hold one of these unless
+    ``--profile_dispatch`` armed a real DispatchProfiler, so the
+    profiler-off path performs zero host syncs and stays bitwise
+    identical (pinned by tests)."""
+
+    armed = False
+
+    def mark_prestep(self) -> None:
+        pass
+
+    def begin_dispatch(self, sync_tree: Any = None) -> None:
+        pass
+
+    def end_dispatch(self, step: int) -> None:
+        pass
+
+    def note_sync_wait(self, seconds: float) -> None:
+        pass
+
+    def flush_epoch(self, epoch: int, *, steps: int,
+                    step_total_s: Optional[float] = None) -> None:
+        pass
+
+
+class DispatchProfiler(NullProfiler):
+    """Per-step host-timeline profiler. Hook protocol (the loop calls, in
+    step order)::
+
+        prof.mark_prestep()              # top of the loop body
+        prof.begin_dispatch(prev_out)    # just before the jitted call
+        out = step(...)                  # the async dispatch
+        prof.end_dispatch(step_idx)      # just after it returns
+        ...
+        prof.note_sync_wait(fetch_s)     # the per-epoch loss fetch
+        prof.flush_epoch(epoch, steps=n, step_total_s=loop_timer_total)
+
+    ``begin_dispatch``'s ``sync_tree`` is the previous step's OUTPUT tree
+    (a live array — donated inputs are dead buffers); on a sampled
+    1-in-K step it is drained so the device-idle bracket starts from an
+    empty queue. ``step_total_s`` at flush lets the loop hand over its
+    own step-timer total as the window denominator, so coverage checks
+    the profiler against an independent clock instead of against itself.
+    """
+
+    armed = True
+
+    def __init__(self, registry=None, tracer=None,
+                 sample_every: int = DEFAULT_SAMPLE_EVERY):
+        self._registry = registry
+        self._tracer = tracer
+        self.sample_every = max(0, int(sample_every))
+        self._hists: Dict[str, Any] = {}
+        self._t_pre: Optional[float] = None
+        self._t_d0: Optional[float] = None
+        self._t_idle0: Optional[float] = None
+        self._n_steps = 0          # lifetime step counter (sampling phase)
+        self._reset_epoch()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _reset_epoch(self) -> None:
+        self._totals = {phase: 0.0 for phase in DISPATCH_PHASES}
+        self._counts = {phase: 0 for phase in DISPATCH_PHASES}
+
+    def _record(self, phase: str, seconds: float) -> None:
+        seconds = max(0.0, seconds)
+        self._totals[phase] += seconds
+        self._counts[phase] += 1
+        hist = self._hists.get(phase)
+        if hist is None:
+            reg = self._registry if self._registry is not None \
+                else get_registry()
+            hist = reg.histogram(f"dispatch.{phase}")
+            self._hists[phase] = hist
+        hist.record(seconds)
+
+    # -- the hooks ---------------------------------------------------------
+
+    def mark_prestep(self) -> None:
+        self._t_pre = time.perf_counter()
+
+    def begin_dispatch(self, sync_tree: Any = None) -> None:
+        now = time.perf_counter()
+        if self._t_pre is not None:
+            self._record("python_prestep", now - self._t_pre)
+            self._t_pre = None
+        self._t_idle0 = None
+        if (self.sample_every > 0 and sync_tree is not None
+                and self._n_steps % self.sample_every == 0):
+            import jax
+            # drain the queue THROUGH the jax module attribute so
+            # sanitize.no_host_sync counts the probe honestly
+            jax.block_until_ready(sync_tree)
+            self._t_idle0 = time.perf_counter()
+        # (re-)stamp dispatch-begin AFTER any drain: the bracket must
+        # pollute neither python_prestep nor dispatch
+        self._t_d0 = time.perf_counter()
+
+    def end_dispatch(self, step: int) -> None:
+        now = time.perf_counter()
+        dispatch_s = idle_s = None
+        if self._t_d0 is not None:
+            dispatch_s = now - self._t_d0
+            self._record("dispatch", dispatch_s)
+            self._t_d0 = None
+        if self._t_idle0 is not None:
+            # queue-empty -> enqueue-complete: the device's view of the
+            # host boundary (a lower bound on the true idle gap — the
+            # device may have drained before the bracket even started)
+            idle_s = now - self._t_idle0
+            self._record("device_idle", idle_s)
+            self._t_idle0 = None
+        self._n_steps += 1
+        fields = {"step": int(step)}
+        if dispatch_s is not None:
+            fields["dispatch_s"] = round(dispatch_s, 9)
+        if idle_s is not None:
+            fields["idle_s"] = round(idle_s, 9)
+        flight.record("dispatch", **fields)
+
+    def note_sync_wait(self, seconds: float) -> None:
+        self._record("sync_wait", float(seconds))
+
+    def flush_epoch(self, epoch: int, *, steps: int,
+                    step_total_s: Optional[float] = None) -> None:
+        tracer = self._tracer if self._tracer is not None else get_tracer()
+        for phase in DISPATCH_PHASES:
+            if self._counts[phase] == 0:
+                continue
+            tracer.point(DISPATCH_PHASE_POINT, phase=phase,
+                         total_s=round(self._totals[phase], 9),
+                         n=self._counts[phase], epoch=int(epoch),
+                         step=self._n_steps)
+        attributed = sum(self._totals[p] for p in DISPATCH_COVERAGE_PHASES)
+        # the window: the loop's own step-timer total when offered (an
+        # independent clock), else the profiler's dispatch total
+        in_call = step_total_s if step_total_s is not None \
+            else self._totals["dispatch"]
+        window = (self._totals["python_prestep"] + max(0.0, in_call)
+                  + self._totals["sync_wait"])
+        tracer.point(DISPATCH_WINDOW_POINT, window_s=round(window, 9),
+                     attributed_s=round(attributed, 9),
+                     coverage=round(attributed / window, 6)
+                     if window > 0 else 1.0,
+                     epoch=int(epoch), steps=int(steps))
+        self._reset_epoch()
+
+
+def measure_dispatch_phases(step_once: Callable[[], Any], *,
+                            steps: int = 8) -> Dict[str, float]:
+    """Bench-side probe: run ``step_once`` (one streaming training step
+    returning its async output tree) ``steps`` times, blocking every
+    iteration, and return the MEAN per-step phase decomposition::
+
+        {"python_prestep": s, "dispatch": s, "sync_wait": s,
+         "device_idle": s, "probe_step_s": s, "steps": n}
+
+    ``python_prestep`` is the inter-call gap (previous block returning ->
+    next call entered), ``dispatch`` the call itself, ``sync_wait`` the
+    drain, ``device_idle`` the drain-to-enqueue-complete interval (the
+    device-side view of prestep+dispatch). ``probe_step_s`` is the full
+    per-step wall so shares sum to 1 by construction. One warmup
+    iteration runs first (compile + cache fill, excluded)."""
+    import jax
+    steps = max(1, int(steps))
+    jax.block_until_ready(step_once())    # warmup, excluded
+    totals = {phase: 0.0 for phase in DISPATCH_PHASES}
+    t_begin = prev_end = time.perf_counter()
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        out = step_once()
+        t1 = time.perf_counter()
+        jax.block_until_ready(out)
+        t2 = time.perf_counter()
+        totals["python_prestep"] += t0 - prev_end
+        totals["dispatch"] += t1 - t0
+        totals["sync_wait"] += t2 - t1
+        totals["device_idle"] += t1 - prev_end
+        prev_end = t2
+    wall = prev_end - t_begin
+    out = {phase: totals[phase] / steps for phase in DISPATCH_PHASES}
+    out["probe_step_s"] = wall / steps
+    out["steps"] = steps
+    return out

@@ -9,7 +9,10 @@ module renders a merged multi-process trace as one Chrome trace-event file:
     true start stamps; aggregate spans (`complete_span`: per-epoch
     data_wait / step_compute totals measured elsewhere) land on a separate
     `aggregates` thread so they cannot visually shadow the real timeline;
-  * `point` records become instant `i` events;
+  * `point` records become instant `i` events — except `dispatch_phase`
+    totals (--profile_dispatch runs), which render as slices on paired
+    `host dispatch` / `device idle` lanes so the device's idle gaps are
+    visible against the host work that causes them;
   * registry `snapshot` records become counter `C` tracks (counters and
     numeric gauges — e.g. `xla.compiles`, `host.rss_bytes` over time);
   * processes are aligned on WALL clock: every record carries t_wall and
@@ -34,7 +37,8 @@ import contextlib
 import json
 from typing import List, Optional
 
-from .analysis import (SERVE_BATCH_SPAN, SERVE_BATCH_STAGE_ORDER,
+from .analysis import (DISPATCH_PHASE_POINT, DISPATCH_PHASES,
+                       SERVE_BATCH_SPAN, SERVE_BATCH_STAGE_ORDER,
                        SERVE_REQUEST_SPAN, clock_offset, load_traces,
                        split_segments, _span_interval)
 
@@ -52,6 +56,12 @@ _TID_AGGREGATES = 1
 _TID_REQUESTS = 2
 _TID_BATCHES = 3
 _TID_COLLECTIVES = 4
+# Dispatch forensics (--profile_dispatch runs): the per-epoch
+# dispatch_phase points render as slices on a HOST lane (python_prestep /
+# dispatch / sync_wait) and a DEVICE lane (device_idle) so the idle gaps
+# are visible as slices against the host work that causes them.
+_TID_HOST_LANE = 5
+_TID_DEVICE_LANE = 6
 _SERVE_BATCH_TRACK = (SERVE_BATCH_SPAN,) + SERVE_BATCH_STAGE_ORDER
 # seq-aligned cross-rank arrows are capped (a long run journals thousands
 # of collectives; Perfetto renders arrows per flow id, and the first few
@@ -154,6 +164,7 @@ def chrome_trace(paths: List[str],
 
     events: List[dict] = []
     named_pids = set()
+    dispatch_lanes_named = set()  # pids with host/device lane names out
     flow_seq = 0
     for start, rec in sorted(aligned, key=lambda it: it[0]):
         pid = int(rec.get("proc", 0))
@@ -226,6 +237,34 @@ def chrome_trace(paths: List[str],
                                        "tid": _TID_SPANS,
                                        "args": {"value": value}})
                 continue
+            if name == DISPATCH_PHASE_POINT:
+                # per-epoch phase totals (telemetry/dispatch.py flush):
+                # render as slices ending at their emission point (the
+                # aggregates idiom) — host phases on the host lane, the
+                # sampled device_idle total on its own device lane, so
+                # Perfetto shows the idle gap AGAINST the host work that
+                # causes it
+                attrs = rec.get("attrs") or {}
+                phase, total = attrs.get("phase"), attrs.get("total_s")
+                if phase in DISPATCH_PHASES \
+                        and isinstance(total, (int, float)):
+                    if pid not in dispatch_lanes_named:
+                        dispatch_lanes_named.add(pid)
+                        events.append({"ph": "M", "name": "thread_name",
+                                       "pid": pid, "tid": _TID_HOST_LANE,
+                                       "args": {"name": "host dispatch"}})
+                        events.append({"ph": "M", "name": "thread_name",
+                                       "pid": pid, "tid": _TID_DEVICE_LANE,
+                                       "args": {"name": "device idle"}})
+                    tid = (_TID_DEVICE_LANE if phase == "device_idle"
+                           else _TID_HOST_LANE)
+                    events.append({
+                        "ph": "X", "name": str(phase), "cat": "dispatch",
+                        "ts": _scale_us(start - float(total) - t_base),
+                        "dur": _scale_us(float(total)),
+                        "pid": pid, "tid": tid, "args": attrs,
+                    })
+                    continue
             events.append({"ph": "i", "name": name,
                            "cat": "point", "ts": ts, "pid": pid,
                            "tid": _TID_SPANS, "s": "t",

@@ -252,6 +252,21 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
                         "--parallel on the streaming XLA path; zero device "
                         "syncs, bitwise-identical training. See "
                         "docs/OBSERVABILITY.md §Cluster forensics")
+    t.add_argument("--profile_dispatch", type=int, nargs="?", const=16,
+                   default=0, metavar="K",
+                   help="decompose the per-step host boundary into the "
+                        "named overhead phases (telemetry/dispatch.py: "
+                        "python_prestep / dispatch / device_idle / "
+                        "sync_wait) as dispatch.* histograms, flight-ring "
+                        "samples and per-epoch trace points; read back "
+                        "with `trace report --overhead DIR`. K is the "
+                        "device-idle sampling period — the idle probe "
+                        "drains the device on 1-in-K steps (default 16; "
+                        "steady-state steps stay sync-free). Needs "
+                        "--telemetry; incompatible with --fused (no "
+                        "per-step host boundary). Off by default — the "
+                        "NullProfiler path adds zero host syncs. See "
+                        "docs/OBSERVABILITY.md §Dispatch forensics")
     t.add_argument("--health", choices=("off", "warn", "checkpoint-and-warn",
                                         "abort"),
                    default="off",
@@ -390,6 +405,7 @@ def configure(argv=None) -> Dict[str, Dict[str, Any]]:
             "cached": a.cached, "fused": a.fused,
             "profile": a.profile, "kernel": a.kernel,
             "telemetry": a.telemetry, "journal": a.journal,
+            "profile_dispatch": a.profile_dispatch,
             "health": a.health, "metrics_port": a.metrics_port,
             "elastic": a.elastic, "reshape": a.reshape,
         },

@@ -39,6 +39,7 @@ from ..data.loader import BatchLoader
 from ..pipeline import feed as pipeline_feed
 from ..utils.logging import progress
 from ..utils.profiling import CumulativeTimer
+from ..telemetry.dispatch import NullProfiler
 from ..telemetry.events import get_tracer
 from ..telemetry.runtime import record_memory_point
 
@@ -400,7 +401,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
         eval_perm: Callable | None = None,
         watchdog=None, model_apply: Callable | None = None,
         input_workers: int = 0, prefetch_depth: int = 1,
-        journal=None) -> TrainState:
+        journal=None, dispatch_profiler=None) -> TrainState:
     """Run the reference training loop for `epochs` epochs.
 
     Exactly one of `lr` / `train_step` must be given: `lr` builds the serial
@@ -459,6 +460,18 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     watchdog can age. Pure host clock reads + JSONL writes: journaled
     training stays bitwise identical to unjournaled and adds zero host
     syncs (pinned by tests/test_cluster.py under sanitize.no_host_sync).
+
+    `dispatch_profiler` (telemetry.dispatch.DispatchProfiler) decomposes
+    the step boundary into the named overhead phases — python_prestep /
+    dispatch / device_idle / sync_wait (docs/OBSERVABILITY.md §Dispatch
+    forensics). Its hooks bracket sites the loop already times: prestep
+    opens after the batch arrives, dispatch wraps the jitted call, the
+    end-of-epoch fetch feeds sync_wait, and the flush hands over
+    step_timer.total so coverage is checked against the loop's own
+    clock. Only the sampled 1-in-K device-idle bracket drains the device
+    (on the PREVIOUS step's live outputs); the NullProfiler default adds
+    zero syncs and stays bitwise identical (pinned by
+    tests/test_telemetry.py).
     """
     from ..utils import faultpoints
 
@@ -491,6 +504,10 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
     x_test_dev, y_test_dev = jnp.asarray(x_test), jnp.asarray(y_test)
     params, key = state.params, state.key
     tracer = get_tracer()  # NullTracer unless --telemetry enabled it
+    # NullProfiler unless --profile_dispatch armed one: the hooks below
+    # are unconditional no-ops on the default path
+    prof = (dispatch_profiler if dispatch_profiler is not None
+            else NullProfiler())
     # DP steps carry their comm strategy as metadata (parallel/ddp.py):
     # wire up the ddp.* metrics without the loop knowing about meshes.
     ddp_record = None
@@ -550,6 +567,9 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                 if batch is None:
                     break
                 x, y = batch
+                # python_prestep opens here: batch in hand, everything
+                # until the jitted call is host bookkeeping
+                prof.mark_prestep()
                 # journal stamps bracket the DISPATCH (clock reads only,
                 # and only when journaling): the step's collectives share
                 # this window; completion is observed at the bracketed
@@ -560,6 +580,10 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                     jt0, jt0w = time.perf_counter(), time.time()
                 else:
                     jt0 = jt0w = 0.0
+                # sync_tree = the PREVIOUS step's params output: a live
+                # array (donated inputs are dead buffers) the sampled
+                # device-idle bracket can drain on
+                prof.begin_dispatch(params)
                 with step_timer:
                     if step_comm:
                         out = step(params, key, x, y, resid)
@@ -572,6 +596,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                         aux_list.append(aux)
                     else:
                         params, key, loss = step(params, key, x, y)
+                prof.end_dispatch(epoch * nsteps + i)
                 if journal is not None:
                     journal.record_step(epoch * nsteps + i,
                                         jt0, time.perf_counter(), jt0w)
@@ -601,6 +626,7 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
             if journal is not None:
                 journal.exit(fseq)
             fetch_s = time.perf_counter() - t_fetch
+            prof.note_sync_wait(fetch_s)
             # batches = STEPS this epoch (step_timer.count): io_timer also
             # wraps the end-of-epoch sentinel next() that returns None, so
             # its count is one high — the report must agree with the
@@ -609,6 +635,11 @@ def fit(state: TrainState, train_loader: BatchLoader, x_test, y_test, *,
                                  batches=step_timer.count)
             tracer.complete_span("step_compute", step_timer.total + fetch_s,
                                  steps=step_timer.count, fetch_s=fetch_s)
+            # the window denominator is step_timer.total — the loop's OWN
+            # clock over the jitted calls — so the coverage check holds
+            # the profiler to an independent measurement
+            prof.flush_epoch(epoch, steps=step_timer.count,
+                             step_total_s=step_timer.total)
             t_eval = time.perf_counter()
             val = evaluate(eval_step, params, x_test_dev, y_test_dev,
                            batch_size,

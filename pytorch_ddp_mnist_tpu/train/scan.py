@@ -44,6 +44,7 @@ from ..ops.sgd import sgd_step
 from ..parallel.ddp import _pvary
 from ..parallel.mesh import DATA_AXIS
 from ..pipeline.prefetch import prefetch as pipeline_prefetch
+from ..telemetry.dispatch import NullProfiler
 from ..telemetry.events import get_tracer
 from .loop import (TrainState, _fire_step_hook, epoch_summary, evaluate,
                    make_ddp_comm_recorder, make_eval_step,
@@ -713,6 +714,9 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
             return jitted_ef(params, key, x_all, y_all, idxs, resid)
 
         run_ef.comm_state = True
+        # declared donation contract, cross-checked against the traced
+        # program by statics/jaxpr_audit.py's donation-aliasing contract
+        run_ef.donates = ("params", "key", "resid")
         return run_ef
 
     jitted = jax.jit(sharded, donate_argnums=(0, 1))
@@ -721,6 +725,7 @@ def make_dp_run_fn(mesh: Mesh, lr: float, *, dtype: str = "float32",
         return jitted(params, key, x_all, y_all, idxs)
 
     run.comm_state = False
+    run.donates = ("params", "key")
     return run
 
 
@@ -739,7 +744,8 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                ckpt_every_steps: int = 0,
                step_hook: Callable | None = None,
                eval_perm: Callable | None = None,
-               watchdog=None, prefetch_depth: int = 1) -> TrainState:
+               watchdog=None, prefetch_depth: int = 1,
+               dispatch_profiler=None) -> TrainState:
     """The `fit` loop with the dataset cached in HBM and epochs scanned.
 
     `batch_size` is the GLOBAL batch (sampler shards rows per process; with a
@@ -788,6 +794,13 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
     placed values are identical at any depth — chunking math, per-step RNG
     chain, and the epoch-granular fetch budget are all untouched (bitwise,
     pinned by tests/test_pipeline.py).
+
+    `dispatch_profiler` (telemetry.dispatch.DispatchProfiler) attributes
+    the CHUNK boundary here — prestep is the chunk bookkeeping, dispatch
+    the epoch/chunk program call, sync_wait the per-chunk loss fetch;
+    `fused=True` rejects it by name (one whole-run device program has no
+    per-step host boundary to decompose). NullProfiler default adds zero
+    syncs (docs/OBSERVABILITY.md §Dispatch forensics).
     """
     import time
 
@@ -815,6 +828,11 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             "live health monitoring (watchdog) observes at chunk/epoch "
             "boundaries the host controls; fused=True runs all epochs as "
             "ONE device program with no live host — use plain cached mode")
+    if fused and getattr(dispatch_profiler, "armed", False):
+        raise ValueError(
+            "dispatch profiling decomposes the per-step/per-chunk host "
+            "boundary; fused=True runs all epochs as ONE device program "
+            "with no such boundary — use plain cached or streaming mode")
     if kernel == "pallas_epoch" and (ckpt_every_steps or start_offset):
         raise ValueError(
             "step-granular checkpointing chunks the epoch scan, but kernel "
@@ -927,6 +945,9 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
         return TrainState(params, key, resid)
 
     tracer = get_tracer()
+    # NullProfiler unless --profile_dispatch armed one (zero-sync default)
+    prof = (dispatch_profiler if dispatch_profiler is not None
+            else NullProfiler())
     eval_step = make_eval_step(model_apply)
     for epoch in range(start_epoch, epochs):
         with tracer.span("epoch", epoch=epoch):
@@ -968,14 +989,23 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
                 depth=prefetch_depth, put=_place)
             loss_parts = []
             for (c0, c1), part in zip(bounds, placed):
+                # the chunk boundary IS this trainer's step boundary:
+                # prestep opens with the placed chunk in hand
+                prof.mark_prestep()
                 t_chunk = time.perf_counter()
+                # sampled device-idle bracket drains the previous
+                # chunk's live params output (same contract as loop.fit)
+                prof.begin_dispatch(params)
                 if stateful:
                     params, key, part_losses, resid = epoch_fn(
                         params, key, x_all, y_all, part, resid)
                 else:
                     params, key, part_losses = epoch_fn(params, key,
                                                         x_all, y_all, part)
+                prof.end_dispatch(epoch * nb + c0)
+                t_sync = time.perf_counter()
                 part_np = np.asarray(part_losses)           # chunk sync
+                prof.note_sync_wait(time.perf_counter() - t_sync)
                 # the nan value-fault point, chunk form: poisons only the
                 # fetched loss curve (params untouched) — the watchdog's
                 # deterministic chaos input
@@ -1009,6 +1039,10 @@ def fit_cached(state: TrainState, x_train, y_train, sampler, x_test, y_test, *,
             # data wait (the dataset lives in HBM)
             tracer.complete_span("step_compute", time.perf_counter() - t0,
                                  steps=int(losses.size))
+            # no independent per-call timer here (the chunk sync is part
+            # of the same host interval) — the window defaults to the
+            # profiler's own dispatch total
+            prof.flush_epoch(epoch, steps=len(bounds))
             t_eval = time.perf_counter()
             val = evaluate(eval_step, params, x_test_dev, y_test_dev,
                            batch_size,

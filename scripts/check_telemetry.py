@@ -36,7 +36,13 @@ the orphaned-parent check with a stderr note. `program_cost` point records
 contract: a non-empty string `program` label and non-negative byte/flop
 fields — `--require xla.` / `--require mem.` gate the compile metrics and
 HBM watermark gauges being present (the cost-smoke pattern), with the same
-named degrade when analysis.py predates `cost_record_errors`. Pure stdlib,
+named degrade when analysis.py predates `cost_record_errors`.
+`dispatch_phase` / `dispatch_window` point records (telemetry/dispatch.py
+epoch flushes, the `trace report --overhead` input) get the dispatch
+record contract the same way: a known phase name, non-negative durations,
+int step/epoch indices — `--require dispatch.` gates the profiler's
+`dispatch.*` histograms being present (the overhead-smoke pattern), with
+the same named degrade on an older analysis.py. Pure stdlib,
 no jax import: the checker must run anywhere the trace lands, including
 hosts without the framework installed.
 """
@@ -119,6 +125,8 @@ _SERVE_SKIP = ("the serve span contract (serve.request request_id, batch "
                "links resolving, pipeline-ordered batch stages)")
 _COST_SKIP = ("the program_cost record contract (non-empty program label, "
               "non-negative byte/flop fields)")
+_DISPATCH_SKIP = ("the dispatch record contract (known phase name, "
+                  "non-negative durations, int step/epoch indices)")
 
 
 def span_structure_errors(segment):
@@ -141,12 +149,22 @@ def span_structure_errors(segment):
         else:
             _note_degraded("analysis.py predates cost_record_errors",
                            _COST_SKIP)
+        # the dispatch-forensics record contract (telemetry/dispatch.py
+        # epoch flushes, read by `trace report --overhead`) — same
+        # file-load sharing, same named degrade
+        if hasattr(_analysis, "dispatch_record_errors"):
+            errors.extend(_analysis.dispatch_record_errors(segment))
+        else:
+            _note_degraded("analysis.py predates dispatch_record_errors",
+                           _DISPATCH_SKIP)
         errors.sort(key=lambda e: e[0])
         return errors
     _note_degraded("analysis.py not found beside this script (span "
                    "structure degrades to orphaned-parent detection)",
                    _SERVE_SKIP)
     _note_degraded("analysis.py not found beside this script", _COST_SKIP)
+    _note_degraded("analysis.py not found beside this script",
+                   _DISPATCH_SKIP)
     return _fallback_structure_errors(segment)
 
 
@@ -221,10 +239,12 @@ def check_file(path: str, errors: list) -> int:
                         errors.append(f"{where}: unknown health severity "
                                       f"{attrs['severity']!r}; known: "
                                       f"{HEALTH_SEVERITIES}")
-            if rec["kind"] == "point" and rec["name"] == "program_cost":
-                # cost records ride the segment so the shared validator
-                # (analysis.cost_record_errors) sees them; the span-tree
-                # checks skip non-span kinds by construction
+            if rec["kind"] == "point" and rec["name"] in (
+                    "program_cost", "dispatch_phase", "dispatch_window"):
+                # cost and dispatch records ride the segment so the shared
+                # validators (analysis.cost_record_errors /
+                # dispatch_record_errors) see them; the span-tree checks
+                # skip non-span kinds by construction
                 rec["_line"] = line_no
                 segment.append(rec)
             if rec["kind"] == "span":

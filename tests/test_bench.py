@@ -167,6 +167,19 @@ def test_ddp_mode_contract_8_fake_devices():
         # — the in-artifact half of the zero-overhead claim
         assert r["collectives_per_step"] >= 1
         assert 0 <= r["journal_overhead_share"] < 0.5
+        # the dispatch-forensics stamps (telemetry/dispatch.py probe):
+        # the overhead decomposition next to analytic_efficiency, the
+        # `trace report --overhead <artifact>` input
+        assert 0 <= r["overhead_share"] < 1
+        assert 0 <= r["overhead_coverage"] <= 1
+        assert set(r["overhead_phases"]) == {"python_prestep", "dispatch",
+                                             "device_idle", "sync_wait"}
+        assert all(v >= 0 for v in r["overhead_phases"].values())
+        # worst is an O constituent, never the probe's device-dominated
+        # sync_wait
+        assert r["overhead_worst_phase"] in ("python_prestep", "dispatch")
+        assert 0 <= r["overhead_worst_share"] <= 1
+        assert r["overhead_probe_steps"] >= 1
     assert by["pmean"]["parity_max_abs_diff_vs_pmean"] == 0.0
     assert by["sharded"]["parity_max_rel_diff_vs_pmean"] < 1e-6
     # the compressed wire is half the f32 wire, exactly

@@ -226,6 +226,38 @@ def test_health_cli_guards(tmp_path):
         main(["--metrics_port", "-1", "--n_epochs", "1"])
 
 
+def test_profile_dispatch_cli_guards(tmp_path):
+    """--profile_dispatch guard rails fail by name: a profile nobody
+    records is a silent no-op (needs --telemetry), and a fused run has no
+    per-step host boundary to decompose."""
+    with pytest.raises(SystemExit, match="--telemetry"):
+        main(["--profile_dispatch", "4", "--n_epochs", "1"])
+    with pytest.raises(SystemExit, match="--fused"):
+        main(["--profile_dispatch", "4", "--cached", "--fused",
+              "--telemetry", str(tmp_path / "obs"), "--n_epochs", "1"])
+
+
+def test_profile_dispatch_end_to_end(tmp_path, capsys):
+    """A profiled serial run emits dispatch_phase/dispatch_window points
+    and the dispatch.* registry histograms (the overhead-smoke write
+    side, in-process)."""
+    import json as _json
+
+    obs = tmp_path / "obs"
+    main(["--n_epochs", "1", "--limit", "128", "--batch_size", "32",
+          "--checkpoint", "", "--telemetry", str(obs),
+          "--profile_dispatch", "2"])
+    capsys.readouterr()
+    recs = [_json.loads(ln) for f in sorted(obs.glob("events*.jsonl"))
+            for ln in open(f).read().splitlines()]
+    names = {r["name"] for r in recs}
+    assert {"dispatch_phase", "dispatch_window"} <= names
+    snaps = [r for r in recs if r["kind"] == "snapshot"]
+    hists = {n for s in snaps
+             for n in (s["attrs"].get("histograms") or {})}
+    assert any(n.startswith("dispatch.") for n in hists)
+
+
 def test_health_warn_end_to_end_with_injected_nan(tmp_path, capsys):
     """--health warn + --fault nan:step=K: the run finishes (rc 0), the
     epoch line shows the poisoned loss curve, and the health event landed

@@ -783,6 +783,66 @@ def test_synthetic_contracts_f64_callback_axis():
     assert exc.value.contract == "collective-axis"
 
 
+def test_audit_donation_labels_stamped():
+    # the donation-aliasing contract rides the matrix entry points: the
+    # donated set lands on the report (and its JSON), resid only for the
+    # stateful strategy
+    rep = jaxpr_audit.audit_step_program("pmean")
+    assert rep.donated_labels == ["key", "params"]
+    assert rep.to_json()["donated"] == ["key", "params"]
+    rep = jaxpr_audit.audit_run_program("int8")
+    assert rep.donated_labels == ["key", "params", "resid"]
+
+
+def test_broken_program_fails_donation_aliasing():
+    # the acceptance pin: re-jit the step WITHOUT donate_argnums (the
+    # silently-dropped-donation failure mode) — fails by name, naming the
+    # first undonated declared input
+    import jax
+    step, args = jaxpr_audit.build_jit_step("int8", False)
+    naked = jax.jit(lambda *a: step(*a))
+    naked.donates = step.donates
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_donation(naked, args, "int8", False, "step")
+    assert exc.value.contract == "donation-aliasing"
+    assert "declared donated" in str(exc.value)
+
+
+def test_missing_donates_declaration_fails():
+    import jax
+    step, args = jaxpr_audit.build_jit_step("pmean", False)
+    bare = jax.jit(lambda *a: step(*a))   # no .donates at all
+    with pytest.raises(jaxpr_audit.AuditViolation) as exc:
+        jaxpr_audit.audit_donation(bare, args, "pmean", False, "step")
+    assert exc.value.contract == "donation-aliasing"
+    assert ".donates" in str(exc.value)
+
+
+def test_donation_cli_exit3(capsys, monkeypatch):
+    # a dropped donation surfaces through the standard audit-program CLI
+    # contract: exit 3 naming [donation-aliasing]
+    import jax
+    real = jaxpr_audit.build_jit_step
+
+    def dropped(comm, overlap=False, **kw):
+        step, args = real(comm, overlap, **kw)
+        naked = jax.jit(lambda *a: step(*a))
+        naked.donates = step.donates
+        return naked, args
+
+    monkeypatch.setattr(jaxpr_audit, "build_jit_step", dropped)
+    rc = jaxpr_audit.main(["--comm", "pmean", "--form", "step"])
+    err = capsys.readouterr().err
+    assert rc == 3 and "[donation-aliasing]" in err
+
+
+def test_donation_one_device_degrade():
+    # world=1 (deviceless AbstractMesh, no collectives worth donating
+    # around) still audits: same donation set, no violation
+    rep = jaxpr_audit.audit_step_program("pmean", n_dev=1)
+    assert rep.ok and rep.donated_labels == ["key", "params"]
+
+
 def test_audit_cli_exit_codes(capsys, monkeypatch):
     rc = jaxpr_audit.main(["--comm", "int8", "--form", "step"])
     out = capsys.readouterr()

@@ -956,3 +956,199 @@ def test_real_streaming_run_feeds_data_report(tmp_path):
     assert rep["epochs"] == 2
     assert not rep["span_errors"]
     assert check_main(["--require", "data.", str(out_dir)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch forensics: the overhead report, the record contract, the gate
+# ---------------------------------------------------------------------------
+
+def _emit_dispatch_trace(path, proc=0, *, dispatch_s=0.6, prestep_s=0.01,
+                         sync_s=0.03, idle_s=0.55, window_s=0.7):
+    tr = telemetry.EventTrace(str(path), process_index=proc)
+    for phase, total in (("python_prestep", prestep_s),
+                         ("dispatch", dispatch_s),
+                         ("device_idle", idle_s),
+                         ("sync_wait", sync_s)):
+        tr.point("dispatch_phase", phase=phase, total_s=total, n=8,
+                 epoch=0, step=8)
+    tr.point("dispatch_window", window_s=window_s,
+             attributed_s=prestep_s + dispatch_s + sync_s, coverage=1.0,
+             epoch=0, steps=8)
+    tr.close()
+    return str(path)
+
+
+def test_overhead_report_from_trace(tmp_path):
+    f = _emit_dispatch_trace(tmp_path / "events.jsonl")
+    rep = analysis.overhead_report([f])
+    assert rep["report"] == analysis.OVERHEAD_REPORT_TAG
+    (row,) = rep["rows"]
+    assert row["program"] == "train"
+    assert row["steps"] == 8
+    # coverage = attributed / the loop's own window clock
+    assert row["coverage"] == pytest.approx(0.64 / 0.7, rel=1e-6)
+    # worst is a HOST phase (device_idle observes the same interval and
+    # sync_wait can never win against dispatch here)
+    assert row["worst_phase"] == "dispatch"
+    assert row["phases"]["dispatch"]["share"] == pytest.approx(0.6 / 0.7,
+                                                               rel=1e-6)
+
+
+def test_dispatch_record_errors_contract():
+    def rec(name, **attrs):
+        return {"kind": "point", "name": name, "_line": 1, "attrs": attrs}
+
+    good = [rec("dispatch_phase", phase="dispatch", total_s=0.5, step=8),
+            rec("dispatch_window", window_s=1.0, attributed_s=0.9)]
+    assert analysis.dispatch_record_errors(good) == []
+    bad = [rec("dispatch_phase", phase="gpu_think", total_s=0.5, step=8),
+           rec("dispatch_phase", phase="dispatch", total_s=-1, step=8),
+           rec("dispatch_phase", phase="dispatch", total_s=0.5, step=1.5),
+           rec("dispatch_window", window_s=-0.1, attributed_s=0.9)]
+    errs = analysis.dispatch_record_errors(bad)
+    assert len(errs) == 4
+    assert "unknown phase 'gpu_think'" in errs[0][1]
+
+
+def test_checker_rejects_bad_dispatch_records(tmp_path, capsys):
+    d = _write(tmp_path, [
+        _rec(name="dispatch_phase",
+             attrs={"phase": "warp_drive", "total_s": 0.1, "step": 0}),
+    ])
+    assert check_main([d]) == 1
+    assert "unknown phase" in capsys.readouterr().err
+
+
+def test_overhead_from_artifact_rows_and_legacy_note():
+    art = {"n_devices": 8, "strategies": [
+        {"strategy": "pmean", "overlap": False,
+         "overhead_share": 0.5, "overhead_coverage": 1.0,
+         "overhead_worst_phase": "dispatch", "overhead_worst_share": 0.9,
+         "overhead_probe_steps": 8,
+         "overhead_phases": {"python_prestep": 0.001, "dispatch": 0.01,
+                             "device_idle": 0.01, "sync_wait": 0.002}},
+        {"strategy": "bf16", "overlap": True},     # legacy: no stamp
+    ]}
+    rep = analysis.overhead_from_artifact(art)
+    assert [r["program"] for r in rep["rows"]] == ["pmean",
+                                                   "bf16+overlap"]
+    stamped, legacy = rep["rows"]
+    assert stamped["coverage"] == 1.0
+    assert stamped["overhead_share"] == 0.5
+    # the stamped worst wins over recomputation (the probe's sync_wait is
+    # device compute, not overhead)
+    assert stamped["worst_phase"] == "dispatch"
+    assert stamped["worst_share"] == 0.9
+    assert "predates the dispatch probe" in legacy["note"]
+
+
+def _overhead_rows(shares, total_s=0.1):
+    return {"rows": [{"program": "train",
+                      "phases": {p: {"share": s, "total_s": total_s}
+                                 for p, s in shares.items()}}]}
+
+
+def test_compare_overhead_gates_share_growth():
+    old = _overhead_rows({"python_prestep": 0.1, "dispatch": 0.5})
+    new = _overhead_rows({"python_prestep": 0.2, "dispatch": 0.5})
+    diff = analysis.compare_overhead(new, old, threshold=1.5)
+    (reg,) = diff["regressions"]
+    assert reg["phase"] == "python_prestep"
+    assert reg["ratio"] == pytest.approx(2.0)
+    # a run against itself never regresses
+    assert not analysis.compare_overhead(new, new)["regressions"]
+
+
+def test_compare_overhead_sub_ms_exempt():
+    # a 3x share ratio whose absolute new total is sub-ms: scheduler noise
+    old = _overhead_rows({"dispatch": 0.01}, total_s=0.0002)
+    new = _overhead_rows({"dispatch": 0.03}, total_s=0.0005)
+    diff = analysis.compare_overhead(new, old, threshold=1.5)
+    assert diff["rows"] and not diff["regressions"]
+
+
+def test_trace_cli_overhead_round_trip(tmp_path, capsys):
+    d = tmp_path / "obs"
+    d.mkdir()
+    _emit_dispatch_trace(d / "events.jsonl")
+    assert trace_cli.main(["report", "--overhead", str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "dispatch overhead report" in out and "worst phase" in out
+    # --json round-trips through the saved-baseline path
+    assert trace_cli.main(["report", "--overhead", "--json",
+                           str(d)]) == 0
+    saved = tmp_path / "self.json"
+    saved.write_text(capsys.readouterr().out)
+    assert trace_cli.main(["report", "--overhead", str(d),
+                           "--baseline", str(saved)]) == 0
+    capsys.readouterr()
+
+
+def test_trace_cli_overhead_gate_exit3_on_regression(tmp_path, capsys):
+    base_dir, slow_dir = tmp_path / "base", tmp_path / "slow"
+    base_dir.mkdir(), slow_dir.mkdir()
+    _emit_dispatch_trace(base_dir / "events.jsonl", prestep_s=0.01)
+    # python_prestep share grows ~10x: the injected regression
+    _emit_dispatch_trace(slow_dir / "events.jsonl", prestep_s=0.1)
+    rc = trace_cli.main(["report", "--overhead", str(slow_dir),
+                         "--baseline", str(base_dir)])
+    assert rc == 3
+    out = capsys.readouterr().out
+    assert "python_prestep" in out and "REGRESSION" in out
+
+
+def test_trace_cli_overhead_coverage_floor_exit1(tmp_path, capsys):
+    d = tmp_path / "obs"
+    d.mkdir()
+    # phases explain only half the loop's window: unprofiled host work
+    _emit_dispatch_trace(d / "events.jsonl", window_s=1.4)
+    rc = trace_cli.main(["report", "--overhead", str(d)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "phases explain only" in err and "floor 90%" in err
+
+
+def test_trace_cli_overhead_from_artifact(tmp_path, capsys):
+    art = {"n_devices": 8, "strategies": [
+        {"strategy": "pmean", "overlap": False, "overhead_share": 0.5,
+         "overhead_coverage": 0.97, "overhead_worst_phase": "dispatch",
+         "overhead_worst_share": 0.9, "overhead_probe_steps": 8,
+         "overhead_phases": {"python_prestep": 0.001, "dispatch": 0.01,
+                             "device_idle": 0.01, "sync_wait": 0.002}}]}
+    p = tmp_path / "MULTICHIP_rXX.json"
+    p.write_text(json.dumps(art))
+    assert trace_cli.main(["report", "--overhead", str(p)]) == 0
+    assert "pmean" in capsys.readouterr().out
+
+
+def test_committed_r08_artifact_decomposes_overhead(capsys):
+    """The committed DDP artifact carries the dispatch stamps and its
+    overhead report clears the 90% coverage floor (exit 0)."""
+    art = pathlib.Path(__file__).resolve().parents[1] / "MULTICHIP_r08.json"
+    rows = json.loads(art.read_text())["strategies"]
+    assert len(rows) == 8
+    for r in rows:
+        assert set(r["overhead_phases"]) == set(analysis.DISPATCH_PHASES)
+        assert r["overhead_coverage"] >= analysis.OVERHEAD_COVERAGE_MIN
+    assert trace_cli.main(["report", "--overhead", str(art)]) == 0
+    out = capsys.readouterr().out
+    assert "coverage" in out and "worst phase" in out
+
+
+def test_export_renders_dispatch_lanes(tmp_path):
+    f = _emit_dispatch_trace(tmp_path / "events.jsonl")
+    doc = export.chrome_trace([f])
+    evs = doc["traceEvents"]
+    slices = {ev["name"]: ev for ev in evs if ev["ph"] == "X"}
+    assert {"python_prestep", "dispatch", "device_idle",
+            "sync_wait"} <= set(slices)
+    # host phases on the host lane, device_idle on its own lane
+    assert slices["dispatch"]["tid"] == slices["python_prestep"]["tid"]
+    assert slices["device_idle"]["tid"] != slices["dispatch"]["tid"]
+    lanes = {ev["args"]["name"] for ev in evs
+             if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    assert {"host dispatch", "device idle"} <= lanes
+    # slices end at their emission stamp: start = emission - total_s
+    d = slices["dispatch"]
+    assert d["dur"] == pytest.approx(600000.0)   # 0.6s in us
+    assert d["ts"] + d["dur"] <= 60.0            # ends near emission
