@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke overhead-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke overhead-smoke ledger-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -249,14 +249,26 @@ overhead-smoke:
 		assert {'host dispatch', 'device idle'} <= lanes, \
 		'missing dispatch lanes: got %r' % sorted(lanes)"
 
+# Performance-ledger smoke (docs/OBSERVABILITY.md §Performance ledger):
+# the committed-artifact history must ingest (every schema generation),
+# cover the six acceptance metric families in the trajectory report,
+# self-gate exit 0, catch an injected direction-aware regression with
+# exit 3 naming the series and the offending run (both the ledger CLI
+# and the pairwise gates' --ledger mode), refuse unknown future
+# schema_versions by name, and render one Perfetto counter track per
+# series.
+ledger-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/ledger_smoke.py
+
 # The committed pre-merge gate: static contracts first (seconds), then the
 # runtime sanitizers on the live paths (incl. the input pipeline), then
 # the serve request-tracing round trip (also seconds), then the program
 # cost/memory harvest round trip, then the dispatch-forensics round trip
 # (host overhead decomposition + phase-share gate), then the
 # cluster-forensics round trip (collective journal + hang attribution),
-# then the fast test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke overhead-smoke cluster-smoke elastic-smoke test-fast
+# then the performance-ledger round trip (the multi-run trend gate over
+# the committed artifact history), then the fast test tier.
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke overhead-smoke cluster-smoke elastic-smoke ledger-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

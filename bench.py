@@ -151,6 +151,23 @@ def statics_stamp_fields() -> "dict | None":
     return dict(statics_stamp())
 
 
+def ledger_stamp_fields() -> dict:
+    """The performance-ledger stamp every artifact line carries from
+    schema v2 on (telemetry/ledger.py ingests these directly instead of
+    re-deriving them): the schema generation, and the run ordinal the
+    row's series sorts under — the driver's round number via PDMT_RUN_ORD
+    when set, else the wall-clock second (monotone across rounds, which
+    is all an ordinal needs to be)."""
+    import time
+
+    from pytorch_ddp_mnist_tpu.telemetry.ledger import SCHEMA_VERSION
+    try:
+        run_ord = int(os.environ.get("PDMT_RUN_ORD", ""))
+    except ValueError:
+        run_ord = int(time.time())
+    return {"schema_version": SCHEMA_VERSION, "run_ord": run_ord}
+
+
 def registry_stamp(registry=None) -> dict:
     """Compile-count and memory fields for a bench JSON line, read from the
     telemetry registry (main() arms the jax.monitoring compile listener
@@ -185,6 +202,7 @@ def registry_stamp(registry=None) -> dict:
     statics = statics_stamp_fields()
     if statics is not None:
         out["statics"] = statics
+    out.update(ledger_stamp_fields())
     return out
 
 
@@ -288,6 +306,7 @@ def _stream_bench(a) -> None:
             "unit": "images/sec",
             "vs_baseline": round(
                 (n / best) / NOMINAL_BASELINE_STREAM_IMGS_PER_SEC, 4),
+            **ledger_stamp_fields(),
         }))
 
 
@@ -933,6 +952,7 @@ def _accuracy_bench(a, on_tpu: bool) -> None:
         # perf variant stack preserved the training outcome)
         "mean_val_loss": round(loss_auto, 6),
         "ref_mean_val_loss": round(loss_ref, 6),
+        **ledger_stamp_fields(),
     }))
 
 
@@ -961,6 +981,7 @@ def _emit_backend_error(e: Exception, tag: str = "backend_unavailable") -> None:
         # death (empty when nothing fired / no watchdog ran) — the
         # BENCH_r02-r05 tails were opaque precisely for lack of this
         "health_summary": health_summary(),
+        **ledger_stamp_fields(),
     }))
 
 

@@ -7,6 +7,10 @@ framework's executables (each also runs standalone as its own module):
                (cli/serve.py; TCP JSON-lines server or --selftest)
     trace      analyze / regression-gate / Perfetto-export the JSONL
                telemetry traces a --telemetry run emits (cli/trace.py)
+    ledger     the performance ledger: ingest every committed artifact
+               generation into one direction-aware metric history, render
+               the trajectory report, trend-gate the newest run
+               (cli/ledger.py; exit 3 names the regressed series)
     convert    IDX -> NetCDF converter (data/convert.py; the
                mnist_to_netcdf.ipynb workflow)
     download   mirrored, checksum-verified MNIST IDX fetch (data/download.py)
@@ -31,6 +35,9 @@ _COMMANDS = {
               "micro-batching inference service"),
     "trace": ("pytorch_ddp_mnist_tpu.cli.trace",
               "telemetry trace report / regression gate / Perfetto export"),
+    "ledger": ("pytorch_ddp_mnist_tpu.cli.ledger",
+               "performance ledger: artifact history, trajectory report, "
+               "trend gate"),
     "convert": ("pytorch_ddp_mnist_tpu.data.convert",
                 "IDX -> NetCDF converter"),
     "download": ("pytorch_ddp_mnist_tpu.data.download", "MNIST IDX fetch"),

@@ -220,8 +220,80 @@ def _load_overhead_report(target: str):
         "--telemetry DIR --profile_dispatch to emit them)")
 
 
+def _cmd_ledger_gate(a) -> int:
+    """`trace report TARGET --ledger DIR`: the pairwise gates' multi-run
+    mode. TARGET (an ingestible artifact — the newest run) gates against
+    the ledger HISTORY under DIR instead of one --baseline artifact: the
+    median+MAD band of each series' last --window runs. The report-family
+    flag narrows which series gate (--serve: serve.*, --data: input.*,
+    --cost: cost.*, --overhead: the ddp overhead shares); exit semantics
+    match the pairwise gates — 1 when nothing overlapped (the gate
+    checked nothing), 3 naming series + runs on regression."""
+    import os
+
+    from ..telemetry import ledger as ledger_mod
+
+    try:
+        target_rows, _skips = ledger_mod.load_artifact(a.target)
+    except ledger_mod.LedgerError as e:
+        print(f"trace report: {e}", file=sys.stderr)
+        return 1
+    prefixes = None
+    if a.serve:
+        prefixes = ("serve.",)
+    elif a.data:
+        prefixes = ("input.",)
+    elif a.cost:
+        prefixes = ("cost.",)
+    elif a.overhead:
+        prefixes = ("ddp.overhead", "ddp.journal_overhead_share")
+    if prefixes:
+        target_rows = [r for r in target_rows
+                       if r["metric"].startswith(prefixes)]
+    if not target_rows:
+        print(f"trace report: {a.target}: no gateable ledger rows"
+              + (f" for the selected family ({'/'.join(prefixes)}*)"
+                 if prefixes else ""), file=sys.stderr)
+        return 1
+    target_abs = os.path.abspath(a.target)
+    history_paths = [p for p in ledger_mod.discover(a.ledger)
+                     if os.path.abspath(p) != target_abs]
+    try:
+        hist = ledger_mod.ingest(history_paths)
+    except ledger_mod.LedgerError as e:
+        print(f"trace report: --ledger {e}", file=sys.stderr)
+        return 1
+    target_series = {r["series"] for r in target_rows}
+    rows = [r for r in hist["rows"] if r["series"] in target_series]
+    rows += target_rows
+    rep = ledger_mod.gate(rows, window=a.window, threshold=a.threshold)
+    if a.json:
+        print(json.dumps(rep, indent=2 if sys.stdout.isatty() else None))
+    checked = [s for s in rep["series"] if s["n"] >= 2]
+    if not checked:
+        print(f"trace report: no series of {a.target} overlaps the "
+              f"ledger history under {a.ledger} — the gate checked "
+              f"nothing (different workload/backend stamps?)",
+              file=sys.stderr)
+        return 1
+    if rep["failures"]:
+        for line in rep["failures"]:
+            print(f"trace report: LEDGER REGRESSION {line}",
+                  file=sys.stderr)
+        return 3
+    if not a.json:
+        print(f"trace report: ledger gate OK — {len(checked)} series of "
+              f"{os.path.basename(a.target)} checked against "
+              f"{len(history_paths)} historical artifact(s) (window "
+              f"{a.window}, threshold {a.threshold:g}), 0 regressions")
+    return 0
+
+
 def _cmd_report(a) -> int:
     from ..telemetry import analysis
+
+    if a.ledger:
+        return _cmd_ledger_gate(a)
 
     if a.cluster:
         # cluster forensics (docs/OBSERVABILITY.md §Cluster forensics):
@@ -455,21 +527,41 @@ def _cmd_export(a) -> int:
     from ..telemetry import analysis, cluster, export
 
     paths = analysis.trace_files(a.target)
-    if not paths:
+    ledger_series = None
+    if a.ledger:
+        # the multi-run performance-ledger counter tracks (one per
+        # series, own pid) — a ledger-only export is valid: the artifact
+        # history exists independently of any single run's events files
+        from ..telemetry import ledger as ledger_mod
+        artifact_paths = ledger_mod.discover(a.ledger)
+        if not artifact_paths:
+            print(f"trace export: --ledger {a.ledger}: no artifacts "
+                  f"found", file=sys.stderr)
+            return 1
+        try:
+            ledger_series = ledger_mod.histories(
+                ledger_mod.ingest(artifact_paths)["rows"])
+        except ledger_mod.LedgerError as e:
+            print(f"trace export: --ledger {e}", file=sys.stderr)
+            return 1
+    if not paths and not ledger_series:
         print(f"trace export: {a.target}: no events*.jsonl found",
               file=sys.stderr)
         return 1
     # per-rank collective journals beside the trace (a --journal run)
     # render as per-rank collective tracks with seq-aligned flow arrows
-    journal_paths = cluster.journal_files(a.target)
+    journal_paths = cluster.journal_files(a.target) if paths else []
     n = export.write_chrome_trace(paths, a.out,
-                                  journal_paths=journal_paths)
+                                  journal_paths=journal_paths,
+                                  ledger_series=ledger_series)
     if n == 0:
         print(f"trace export: {a.target}: no timeline records",
               file=sys.stderr)
         return 1
     extra = (f" (+ {len(journal_paths)} collective journal(s))"
              if journal_paths else "")
+    if ledger_series:
+        extra += f" (+ {len(ledger_series)} ledger series)"
     print(f"trace export: wrote {n} event(s) from {len(paths)} file(s)"
           f"{extra} to {a.out} (load in Perfetto or chrome://tracing)")
     return 0
@@ -544,6 +636,16 @@ def main(argv=None) -> int:
                    help="diff against another run (trace dir/file or saved "
                         "--json report); exit 3 when any phase p50/p95 "
                         "ratio exceeds --threshold")
+    r.add_argument("--ledger", metavar="DIR", default=None,
+                   help="gate TARGET (an ingestible artifact) against the "
+                        "performance-ledger HISTORY under DIR instead of "
+                        "one --baseline: the median+MAD band of each "
+                        "series' last --window runs (telemetry/ledger.py; "
+                        "the report-family flag narrows which series "
+                        "gate). Exit 3 names series + offending runs")
+    r.add_argument("--window", type=int, default=5,
+                   help="with --ledger: history runs the band is computed "
+                        "over (default %(default)s)")
     r.add_argument("--threshold", type=float, default=1.5,
                    help="regression gate ratio (default 1.5; the injected-"
                         "2x acceptance trips it with margin)")
@@ -558,6 +660,12 @@ def main(argv=None) -> int:
     e.add_argument("target", help="a --telemetry dir or one trace file")
     e.add_argument("-o", "--out", default="trace.chrome.json",
                    help="output path (default ./trace.chrome.json)")
+    e.add_argument("--ledger", metavar="DIR", default=None,
+                   help="also render the performance-ledger history under "
+                        "DIR as one Perfetto counter track per series "
+                        "(own pid; runs spaced 1s apart). Works without "
+                        "events files — the repo history is a timeline of "
+                        "its own")
     e.set_defaults(run=_cmd_export)
 
     c = sub.add_parser(
@@ -619,6 +727,14 @@ def main(argv=None) -> int:
         if a.cluster and a.baseline:
             p.error("--cluster compares ranks against each other, not "
                     "runs against a baseline; drop --baseline")
+        if a.ledger and a.baseline:
+            p.error("--ledger gates against the whole history band; "
+                    "--baseline is the one-step pairwise mode — pass one")
+        if a.ledger and a.cluster:
+            p.error("--cluster reads per-rank journals, not ledger "
+                    "artifacts; drop --ledger")
+        if a.window < 1:
+            p.error("--window must be >= 1")
         if a.batch is not None and not a.cost:
             p.error("--batch only applies to the --cost report")
         if a.batch is not None and a.batch < 1:

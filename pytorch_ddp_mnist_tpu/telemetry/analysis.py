@@ -95,6 +95,100 @@ OVERHEAD_COVERAGE_MIN = 0.90
 # numerator is scheduler noise (the data/serve sub-ms convention)
 OVERHEAD_SUBMS_EXEMPT_S = 1e-3
 
+# -- the performance-ledger record contract (telemetry/ledger.py emits
+# `ledger_row` points when the ledger CLI runs with --telemetry; literals
+# here so the file-loading checker stays framework-free — tests pin them
+# against ledger.py's catalog). Every ledger row is direction-aware: the
+# trend gate must know whether bigger is better before it can call a move
+# a regression. --
+LEDGER_ROW_POINT = "ledger_row"
+LEDGER_DIRECTIONS = ("higher_better", "lower_better")
+
+# The ONE workload normalizer (docs/OBSERVABILITY.md §Performance ledger):
+# strategy rows that predate the --model/--param_scale stamps (the
+# MULTICHIP_r06-generation artifacts) are the default 118k mlp at scale 1,
+# and a row-less n_devices falls back to the artifact's. Both the PR 7
+# efficiency-gate labels (`efficiency_report` below) and the ledger's
+# series keys (telemetry/ledger.py) normalize through THIS function, so
+# the two can never disagree about which legacy rows are comparable.
+WORKLOAD_DEFAULTS = {"model": "mlp", "param_scale": 1}
+
+
+def normalize_workload(row: dict, artifact: Optional[dict] = None) -> dict:
+    """Canonical {model, param_scale, n_devices, per_chip_batch} for one
+    strategy/bench row: absent model/param_scale pin to the documented
+    defaults (mlp, x1 — un-stamped rows predate models/zoo.py); n_devices
+    falls back row -> artifact -> None; per_chip_batch stays None when the
+    row predates its stamp (r08 introduced it)."""
+    art = artifact or {}
+    model = row.get("model")
+    if not isinstance(model, str) or not model:
+        model = art.get("model")
+    if not isinstance(model, str) or not model:
+        model = WORKLOAD_DEFAULTS["model"]
+    scale = row.get("param_scale", art.get("param_scale"))
+    if not isinstance(scale, (int, float)) or isinstance(scale, bool):
+        scale = WORKLOAD_DEFAULTS["param_scale"]
+    ndev = row.get("n_devices", art.get("n_devices"))
+    if isinstance(ndev, bool) or not isinstance(ndev, (int, float)):
+        ndev = None
+    pcb = row.get("per_chip_batch")
+    if isinstance(pcb, bool) or not isinstance(pcb, (int, float)):
+        pcb = None
+    return {"model": model, "param_scale": int(scale),
+            "n_devices": int(ndev) if ndev is not None else None,
+            "per_chip_batch": int(pcb) if pcb is not None else None}
+
+
+def strategy_row_label(row: dict, artifact: Optional[dict] = None) -> str:
+    """The efficiency-gate row label: strategy, `+overlap` for
+    bucket-pipelined rows, `@model xN` for non-default workloads and
+    `@Ndev` for the device count — the key under which two artifacts'
+    rows pair up for gating. Built on `normalize_workload`, the shared
+    legacy-default rule."""
+    wl = normalize_workload(row, artifact)
+    label = str(row.get("strategy", "?"))
+    if row.get("overlap"):
+        label += "+overlap"
+    if (wl["model"], wl["param_scale"]) != (WORKLOAD_DEFAULTS["model"],
+                                            WORKLOAD_DEFAULTS["param_scale"]):
+        label += f"@{wl['model']} x{wl['param_scale']}"
+    if wl["n_devices"] is not None:
+        label += f"@{wl['n_devices']}dev"
+    return label
+
+
+def ledger_row_errors(segment: List[dict]) -> List[Tuple[int, str]]:
+    """Violations of the `ledger_row` point-record contract
+    (telemetry/ledger.py emits these when the ledger CLI runs with
+    --telemetry) within ONE segment, as (line_no, message) pairs — shared
+    with the file-loading checker like `cost_record_errors`. A ledger row
+    must carry a NON-EMPTY string `series` (the key the whole trend
+    history joins on), a KNOWN direction (the gate is meaningless without
+    one), and a FINITE numeric value (NaN/inf in a committed history would
+    poison every later median)."""
+    errors: List[Tuple[int, str]] = []
+    for rec in segment:
+        if rec.get("kind") != "point" or rec.get("name") != LEDGER_ROW_POINT:
+            continue
+        line = rec.get("_line", 0)
+        attrs = rec.get("attrs") or {}
+        series = attrs.get("series")
+        if not (isinstance(series, str) and series):
+            errors.append((line, f"ledger_row record missing a non-empty "
+                                 f"series key (got {series!r})"))
+        direction = attrs.get("direction")
+        if direction not in LEDGER_DIRECTIONS:
+            errors.append((line, f"ledger_row names unknown direction "
+                                 f"{direction!r}; known: "
+                                 f"{LEDGER_DIRECTIONS}"))
+        value = attrs.get("value")
+        if not isinstance(value, (int, float)) or isinstance(value, bool) \
+                or not math.isfinite(value):
+            errors.append((line, f"ledger_row value must be a finite "
+                                 f"number; got {value!r}"))
+    return errors
+
 
 def skew(values) -> Tuple[float, float]:
     """(spread, spread as % of mean) of a set of durations — THE straggler
@@ -1038,7 +1132,10 @@ def efficiency_report(artifact: dict, path: str = "<artifact>") -> dict:
     AND device count (per-chip efficiency always falls as devices grow),
     so rows from different `--model`/`--param_scale`/pool-size runs
     must never gate against each other (legacy artifacts without the
-    workload fields are the default 118k mlp at scale 1)."""
+    workload fields are the default 118k mlp at scale 1 — the shared
+    `normalize_workload` rule, which the performance ledger's series
+    keys also use, so gate labels and ledger series can never
+    disagree)."""
     eff = {}
     for row in artifact.get("strategies") or []:
         if not isinstance(row, dict):
@@ -1046,17 +1143,7 @@ def efficiency_report(artifact: dict, path: str = "<artifact>") -> dict:
         v = row.get(EFFICIENCY_STAT)
         if not isinstance(v, (int, float)):
             continue
-        label = str(row.get("strategy", "?"))
-        if row.get("overlap"):
-            label += "+overlap"
-        model = row.get("model", "mlp")
-        scale = row.get("param_scale", 1)
-        if (model, scale) != ("mlp", 1):
-            label += f"@{model} x{scale}"
-        ndev = row.get("n_devices", artifact.get("n_devices"))
-        if ndev is not None:
-            label += f"@{int(ndev)}dev"
-        eff[label] = float(v)
+        eff[strategy_row_label(row, artifact)] = float(v)
     return {
         "report": "trace_phase_stats", "v": 1,
         "files": [path], "processes": [], "n_processes": 0,

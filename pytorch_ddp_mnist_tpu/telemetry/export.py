@@ -68,6 +68,12 @@ _SERVE_BATCH_TRACK = (SERVE_BATCH_SPAN,) + SERVE_BATCH_STAGE_ORDER
 # hundred seqs carry the alignment story) — the cap is stamped into
 # otherData so a truncated arrow set never reads as complete
 COLLECTIVE_ARROW_CAP = 512
+# The performance-ledger track (`trace export --ledger DIR`): one counter
+# per series, on its own pid so the repo's MULTI-RUN history renders as a
+# scrubbable timeline besides (not inside) any single run's trace. Run
+# ordinals are not wall stamps — each run renders one second apart.
+LEDGER_PID = 999
+LEDGER_RUN_SPACING_S = 1.0
 
 
 def _scale_us(seconds: float) -> float:
@@ -104,13 +110,39 @@ def _journal_slices(journal_paths: List[str]) -> List[tuple]:
     return out
 
 
+def _ledger_events(ledger_series: dict) -> List[dict]:
+    """One Perfetto counter track per ledger series (`ledger.histories`
+    shape: series key -> run-ordered rows). Successive runs render
+    LEDGER_RUN_SPACING_S apart — the x axis is run order, not wall time —
+    so scrubbing the ledger pid walks the whole committed history."""
+    events: List[dict] = []
+    if not ledger_series:
+        return events
+    events.append({"ph": "M", "name": "process_name", "pid": LEDGER_PID,
+                   "tid": _TID_SPANS,
+                   "args": {"name": "performance ledger"}})
+    events.append({"ph": "M", "name": "thread_name", "pid": LEDGER_PID,
+                   "tid": _TID_SPANS, "args": {"name": "ledger series"}})
+    for series in sorted(ledger_series):
+        for i, row in enumerate(ledger_series[series]):
+            events.append({
+                "ph": "C", "name": series, "cat": "ledger",
+                "ts": _scale_us(i * LEDGER_RUN_SPACING_S),
+                "pid": LEDGER_PID, "tid": _TID_SPANS,
+                "args": {"value": row["value"]},
+            })
+    return events
+
+
 def chrome_trace(paths: List[str],
-                 journal_paths: Optional[List[str]] = None) -> dict:
+                 journal_paths: Optional[List[str]] = None,
+                 ledger_series: Optional[dict] = None) -> dict:
     """Merge per-process JSONL trace files into one Chrome trace-event
     object: `{"traceEvents": [...], "displayTimeUnit": "ms"}`.
     `journal_paths` (per-rank collective journals from a --journal run)
     add one `collectives` track per rank plus seq-aligned cross-rank flow
-    arrows."""
+    arrows; `ledger_series` (ledger.histories) adds the multi-run
+    performance-ledger counter tracks on their own pid."""
     records, _errors = load_traces(paths)
     by_file: dict = {}
     for rec in records:
@@ -145,8 +177,16 @@ def chrome_trace(paths: List[str],
                     continue
                 aligned.append((start, rec))
     jslices = _journal_slices(journal_paths or [])
+    lev = _ledger_events(ledger_series or {})
     if not aligned and not jslices:
-        return {"traceEvents": [], "displayTimeUnit": "ms"}
+        # a ledger-only export is a valid timeline (the committed-artifact
+        # history exists independently of any single run's events files)
+        out = {"traceEvents": lev, "displayTimeUnit": "ms"}
+        if lev:
+            out["otherData"] = {
+                "source": "pytorch_ddp_mnist_tpu telemetry schema v1",
+                "ledger_series": len(ledger_series or {})}
+        return out
     t_base = min([start for start, _rec in aligned]
                  + [start for start, _r, _rec in jslices])
 
@@ -328,21 +368,27 @@ def chrome_trace(paths: List[str],
                 events.append({"ph": "f", "bp": "e", "ts": ts_n,
                                "pid": pid_n, "tid": _TID_COLLECTIVES,
                                **flow})
+    events.extend(lev)
     other = {"source": "pytorch_ddp_mnist_tpu telemetry schema v1",
              "files": sorted(by_file)}
     if journal_paths:
         other["journals"] = sorted(journal_paths)
         if arrows_capped:
             other["collective_arrow_cap"] = COLLECTIVE_ARROW_CAP
+    if ledger_series:
+        other["ledger_series"] = len(ledger_series)
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": other}
 
 
 def write_chrome_trace(paths: List[str], out_path: str,
-                       journal_paths: Optional[List[str]] = None) -> int:
-    """Render `paths` (+ optional per-rank collective journals) and write
-    the trace-event JSON to `out_path`; returns the event count."""
-    trace = chrome_trace(paths, journal_paths=journal_paths)
+                       journal_paths: Optional[List[str]] = None,
+                       ledger_series: Optional[dict] = None) -> int:
+    """Render `paths` (+ optional per-rank collective journals + optional
+    performance-ledger histories) and write the trace-event JSON to
+    `out_path`; returns the event count."""
+    trace = chrome_trace(paths, journal_paths=journal_paths,
+                         ledger_series=ledger_series)
     with open(out_path, "w") as f:
         json.dump(trace, f)
         f.write("\n")

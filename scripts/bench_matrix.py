@@ -324,11 +324,13 @@ def main(argv=None) -> int:
         if info.get("backend"):
             from bench import statics_stamp_fields
             statics = statics_stamp_fields()
+        from bench import ledger_stamp_fields
         artifact = {"timestamp": datetime.datetime.now(
                         datetime.timezone.utc).isoformat(timespec="seconds"),
                     "epochs_per_window": epochs,
                     **info,
                     **({"statics": statics} if statics is not None else {}),
+                    **ledger_stamp_fields(),
                     "variants": rows}
         with open(a.out, "w") as f:
             json.dump(artifact, f, indent=1)

@@ -42,7 +42,14 @@ epoch flushes, the `trace report --overhead` input) get the dispatch
 record contract the same way: a known phase name, non-negative durations,
 int step/epoch indices — `--require dispatch.` gates the profiler's
 `dispatch.*` histograms being present (the overhead-smoke pattern), with
-the same named degrade on an older analysis.py. Pure stdlib,
+the same named degrade on an older analysis.py. `ledger_row` point
+records (the performance ledger re-emitting its canonical rows,
+`python -m pytorch_ddp_mnist_tpu ledger ... --telemetry DIR`) get the
+ledger record contract the same way: a non-empty series key, a KNOWN
+direction (higher_better/lower_better — the trend gate is meaningless
+without one), a finite value — `--require ledger.` gates the
+`ledger.series`/`ledger.regressions` registry metrics being present (the
+ledger-smoke pattern). Pure stdlib,
 no jax import: the checker must run anywhere the trace lands, including
 hosts without the framework installed.
 """
@@ -127,6 +134,8 @@ _COST_SKIP = ("the program_cost record contract (non-empty program label, "
               "non-negative byte/flop fields)")
 _DISPATCH_SKIP = ("the dispatch record contract (known phase name, "
                   "non-negative durations, int step/epoch indices)")
+_LEDGER_SKIP = ("the ledger_row record contract (non-empty series key, "
+                "known direction, finite value)")
 
 
 def span_structure_errors(segment):
@@ -157,6 +166,14 @@ def span_structure_errors(segment):
         else:
             _note_degraded("analysis.py predates dispatch_record_errors",
                            _DISPATCH_SKIP)
+        # the performance-ledger record contract (telemetry/ledger.py
+        # rows re-emitted by `ledger --telemetry`, cli/ledger.py) — same
+        # file-load sharing, same named degrade
+        if hasattr(_analysis, "ledger_row_errors"):
+            errors.extend(_analysis.ledger_row_errors(segment))
+        else:
+            _note_degraded("analysis.py predates ledger_row_errors",
+                           _LEDGER_SKIP)
         errors.sort(key=lambda e: e[0])
         return errors
     _note_degraded("analysis.py not found beside this script (span "
@@ -165,6 +182,8 @@ def span_structure_errors(segment):
     _note_degraded("analysis.py not found beside this script", _COST_SKIP)
     _note_degraded("analysis.py not found beside this script",
                    _DISPATCH_SKIP)
+    _note_degraded("analysis.py not found beside this script",
+                   _LEDGER_SKIP)
     return _fallback_structure_errors(segment)
 
 
@@ -240,11 +259,12 @@ def check_file(path: str, errors: list) -> int:
                                       f"{attrs['severity']!r}; known: "
                                       f"{HEALTH_SEVERITIES}")
             if rec["kind"] == "point" and rec["name"] in (
-                    "program_cost", "dispatch_phase", "dispatch_window"):
-                # cost and dispatch records ride the segment so the shared
-                # validators (analysis.cost_record_errors /
-                # dispatch_record_errors) see them; the span-tree checks
-                # skip non-span kinds by construction
+                    "program_cost", "dispatch_phase", "dispatch_window",
+                    "ledger_row"):
+                # cost, dispatch, and ledger records ride the segment so
+                # the shared validators (analysis.cost_record_errors /
+                # dispatch_record_errors / ledger_row_errors) see them;
+                # the span-tree checks skip non-span kinds by construction
                 rec["_line"] = line_no
                 segment.append(rec)
             if rec["kind"] == "span":

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Performance-ledger smoke: prove the committed-artifact history ingests,
+reports, self-gates clean, catches an injected regression BY NAME, and
+renders as Perfetto counter tracks.
+
+    python scripts/ledger_smoke.py [--workdir DIR]
+
+The front door of docs/OBSERVABILITY.md §Performance ledger
+(`make ledger-smoke`). Five legs, all over the artifacts actually
+committed in-repo (zero hand-edits to them):
+
+  1. REPORT  — `ledger report --json` must cover the six acceptance
+     metric families (images/sec, scaling efficiency, peak HBM, serve
+     p50/p99, data-wait share, overhead share) and the markdown
+     rendering must table every series.
+  2. SELF-GATE — `ledger gate . --telemetry DIR` exits 0 on the real
+     trajectory, and `check_telemetry --require ledger.` validates the
+     emitted ledger_row records + registry census.
+  3. REGRESSION — a scratch copy of the history plus an injected
+     MULTICHIP_r09 (ok bit dropped, throughput halved) must exit 3
+     NAMING the regressed series and the offending run/source; the
+     pairwise CLI's `trace report ... --ledger DIR` multi-run mode must
+     agree.
+  4. REFUSAL  — an artifact stamped with a FUTURE schema_version must be
+     refused by name, never silently dropped.
+  5. PERFETTO — `trace export --ledger` must render one counter track
+     per series on the ledger pid, one point per run.
+
+Exit codes: 0 = every leg held; 1 = any leg failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The acceptance floor (ISSUE 18): one representative metric per family
+# the report must cover from the committed artifacts alone.
+REQUIRED_METRICS = (
+    "bench.train_images_per_sec_per_chip",   # images/sec
+    "ddp.scaling_efficiency_vs_1dev",        # scaling efficiency
+    "cost.peak_hbm_bytes",                   # peak HBM
+    "serve.p50_ms",                          # serve p50
+    "serve.p99_ms",                          # serve p99
+    "input.data_wait_share_p95",             # data-wait share
+    "ddp.overhead_share",                    # overhead share
+)
+
+
+def _run(argv, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(kw.pop("extra_env", {}))
+    return subprocess.run([sys.executable] + argv, cwd=REPO, env=env,
+                          capture_output=True, text=True, **kw)
+
+
+def _fail(leg: str, why: str, proc=None) -> int:
+    print(f"ledger_smoke: FAIL [{leg}] {why}", file=sys.stderr)
+    if proc is not None:
+        sys.stderr.write(proc.stdout[-2000:])
+        sys.stderr.write(proc.stderr[-2000:])
+    return 1
+
+
+def leg_report() -> int:
+    p = _run(["-m", "pytorch_ddp_mnist_tpu", "ledger", "report", ".",
+              "--json"])
+    if p.returncode != 0:
+        return _fail("report", f"exit {p.returncode}", p)
+    rep = json.loads(p.stdout)
+    metrics = {s["metric"] for s in rep["series"]}
+    missing = [m for m in REQUIRED_METRICS if m not in metrics]
+    if missing:
+        return _fail("report", f"acceptance metrics missing from the "
+                               f"committed history: {missing}")
+    if len(rep["families"]) < 6:
+        return _fail("report", f"only {len(rep['families'])} metric "
+                               f"families ({rep['families']}); need >= 6")
+    md = _run(["-m", "pytorch_ddp_mnist_tpu", "ledger", "report", "."])
+    if md.returncode != 0:
+        return _fail("report", f"markdown exit {md.returncode}", md)
+    table_rows = [ln for ln in md.stdout.splitlines()
+                  if ln.startswith("| ") and not ln.startswith("| series")]
+    if len(table_rows) != rep["n_series"]:
+        return _fail("report", f"markdown tables {len(table_rows)} rows "
+                               f"for {rep['n_series']} series")
+    print(f"ledger_smoke: report OK — {rep['n_series']} series, "
+          f"{rep['n_rows']} rows, families {rep['families']}")
+    return 0
+
+
+def leg_self_gate(workdir: str) -> int:
+    tdir = os.path.join(workdir, "telemetry")
+    p = _run(["-m", "pytorch_ddp_mnist_tpu", "ledger", "gate", ".",
+              "--telemetry", tdir])
+    if p.returncode != 0:
+        return _fail("self-gate", f"the committed trajectory must gate "
+                                  f"clean; exit {p.returncode}", p)
+    c = _run(["scripts/check_telemetry.py", "--require", "ledger.", tdir])
+    if c.returncode != 0:
+        return _fail("self-gate", f"check_telemetry --require ledger. "
+                                  f"exit {c.returncode}", c)
+    print("ledger_smoke: self-gate OK — exit 0 + ledger_row records "
+          "validated")
+    return 0
+
+
+def _build_fixture(workdir: str) -> str:
+    """A scratch history: every committed artifact, plus an injected
+    MULTICHIP_r09 whose ok bit dropped and whose throughput rows halved —
+    a direction-aware regression on several series at once."""
+    fixture = os.path.join(workdir, "fixture")
+    os.makedirs(fixture, exist_ok=True)
+    sys.path.insert(0, REPO)
+    from pytorch_ddp_mnist_tpu.telemetry.ledger import discover
+    for path in discover(REPO):
+        shutil.copy(path, fixture)
+    with open(os.path.join(REPO, "MULTICHIP_r08.json")) as f:
+        bad = json.load(f)
+    bad["ok"] = False
+    bad["rc"] = 1
+    bad["schema_version"] = 2
+    bad["run_ord"] = 9
+    for row in bad.get("strategies") or []:
+        for field in ("images_per_sec", "per_chip_images_per_sec",
+                      "scaling_efficiency_vs_1dev"):
+            if isinstance(row.get(field), (int, float)):
+                row[field] = row[field] / 2.0
+    with open(os.path.join(fixture, "MULTICHIP_r09.json"), "w") as f:
+        json.dump(bad, f, indent=2)
+    return fixture
+
+
+def leg_regression(workdir: str) -> int:
+    fixture = _build_fixture(workdir)
+    p = _run(["-m", "pytorch_ddp_mnist_tpu", "ledger", "gate", fixture])
+    if p.returncode != 3:
+        return _fail("regression", f"injected regression must exit 3; "
+                                   f"got {p.returncode}", p)
+    for needle in ("multichip.ok", "ddp.images_per_sec",
+                   "MULTICHIP_r09.json"):
+        if needle not in p.stderr:
+            return _fail("regression", f"exit-3 output must name "
+                                       f"{needle!r}", p)
+    # the pairwise CLI's multi-run mode must reach the same verdict
+    target = os.path.join(fixture, "MULTICHIP_r09.json")
+    t = _run(["-m", "pytorch_ddp_mnist_tpu", "trace", "report", target,
+              "--ledger", fixture])
+    if t.returncode != 3:
+        return _fail("regression", f"trace report --ledger must exit 3; "
+                                   f"got {t.returncode}", t)
+    if "MULTICHIP_r09.json" not in t.stderr:
+        return _fail("regression", "trace report --ledger exit-3 output "
+                                   "must name the offending artifact", t)
+    print("ledger_smoke: regression OK — exit 3 naming series + run, "
+          "both front doors")
+    return 0
+
+
+def leg_refusal(workdir: str) -> int:
+    alien = os.path.join(workdir, "alien")
+    os.makedirs(alien, exist_ok=True)
+    with open(os.path.join(alien, "BENCH_r99.json"), "w") as f:
+        json.dump({"schema_version": 99, "metric": "x", "value": 1.0}, f)
+    p = _run(["-m", "pytorch_ddp_mnist_tpu", "ledger", "gate", alien])
+    if p.returncode != 1:
+        return _fail("refusal", f"future schema_version must exit 1; got "
+                                f"{p.returncode}", p)
+    if "BENCH_r99.json" not in p.stderr or "schema_version 99" \
+            not in p.stderr:
+        return _fail("refusal", "refusal must name the file and the "
+                                "unknown version", p)
+    print("ledger_smoke: refusal OK — future schema_version refused by "
+          "name")
+    return 0
+
+
+def leg_perfetto(workdir: str) -> int:
+    out = os.path.join(workdir, "ledger.chrome.json")
+    p = _run(["-m", "pytorch_ddp_mnist_tpu", "trace", "export",
+              os.path.join(workdir, "noevents"), "--ledger", ".",
+              "-o", out])
+    if p.returncode != 0:
+        return _fail("perfetto", f"exit {p.returncode}", p)
+    with open(out) as f:
+        trace = json.load(f)
+    counters = [e for e in trace["traceEvents"]
+                if e.get("ph") == "C" and e.get("cat") == "ledger"]
+    if not counters:
+        return _fail("perfetto", "no ledger counter events in the export")
+    rep = json.loads(_run(["-m", "pytorch_ddp_mnist_tpu", "ledger",
+                           "report", ".", "--json"]).stdout)
+    if len(counters) != rep["n_rows"]:
+        return _fail("perfetto", f"{len(counters)} counter points for "
+                                 f"{rep['n_rows']} ledger rows")
+    multi = [s for s in rep["series"] if s["n"] > 1]
+    for s in multi:
+        pts = [e for e in counters if e["name"] == s["series"]]
+        if len(pts) != s["n"] or len({e["ts"] for e in pts}) != s["n"]:
+            return _fail("perfetto", f"series {s['series']} must render "
+                                     f"{s['n']} distinct-ts points")
+    print(f"ledger_smoke: perfetto OK — {len(counters)} counter points "
+          f"across {rep['n_series']} series "
+          f"({len(multi)} multi-run series scrubbable)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", default="/tmp/pdmt_ledger_smoke",
+                    help="scratch dir (default %(default)s; wiped)")
+    a = ap.parse_args(argv)
+    shutil.rmtree(a.workdir, ignore_errors=True)
+    os.makedirs(a.workdir, exist_ok=True)
+    for leg in (leg_report,
+                lambda: leg_self_gate(a.workdir),
+                lambda: leg_regression(a.workdir),
+                lambda: leg_refusal(a.workdir),
+                lambda: leg_perfetto(a.workdir)):
+        rc = leg()
+        if rc:
+            return rc
+    print("ledger_smoke: OK — all five legs held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,392 @@
+"""Performance ledger (telemetry/ledger.py + cli/ledger.py): the
+ingestion pin matrix over every artifact actually committed in-repo, the
+direction-aware trend gate, the shared workload normalizer, and the
+ledger stamps.
+
+The pin matrix is the schema-drift tripwire ISSUE 18 asks for: any future
+change to bench.py's artifact shapes fails HERE by name before an
+artifact lands — exact per-generation row counts and one golden row per
+generation, against the real committed files (zero fixtures)."""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import pytest
+
+from pytorch_ddp_mnist_tpu.telemetry import analysis, export
+from pytorch_ddp_mnist_tpu.telemetry import ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Every committed artifact generation, with its exact canonical-row count.
+# A new artifact lands => add its line; a count drift => bench.py (or a
+# loader) changed schema without teaching the ledger.
+COMMITTED_ROW_COUNTS = {
+    "BENCH_r01.json": 1,       # driver-wrapped bench line
+    "COST_r01.json": 11,       # compile/HBM summary + 8 program rows
+    "INPUT_r01.json": 10,      # headline + legacy/pipeline + compiles
+    "MULTICHIP_r01.json": 1,   # legacy ok bit
+    "MULTICHIP_r02.json": 1,
+    "MULTICHIP_r03.json": 1,
+    "MULTICHIP_r04.json": 1,
+    "MULTICHIP_r05.json": 1,
+    "MULTICHIP_r06.json": 22,  # ok + 3 strategy rows x 7 metrics
+    "MULTICHIP_r07.json": 57,  # ok + 8 rows x 7 metrics
+    "MULTICHIP_r08.json": 97,  # ok + 8 rows x 12 metrics
+    "SERVE_r01.json": 9,       # 2 paths x 4 knee metrics + qps_gain
+    "bench_matrix_r03.json": 8,
+    "bench_matrix_r05.json": 9,    # 12 variants, 3 null (probe hang)
+}
+# Driver-wrapped rounds whose backend never came up: SKIPPED with their
+# recorded reason, never ingested as zeros and never silently dropped.
+COMMITTED_SKIPS = {"BENCH_r02.json": 1, "BENCH_r03.json": 1,
+                   "BENCH_r04.json": 1, "BENCH_r05.json": 1,
+                   "bench_matrix_r05.json": 3}
+
+
+@pytest.fixture(scope="module")
+def committed():
+    paths = ledger.discover(REPO)
+    return ledger.ingest(paths)
+
+
+def _rows(committed, **kw):
+    return [r for r in committed["rows"]
+            if all(r[k] == v for k, v in kw.items())]
+
+
+# ---------------------------------------------------------------- ingest
+
+def test_pin_matrix_counts(committed):
+    assert committed["artifacts"] == 18
+    by_source: dict = {}
+    for r in committed["rows"]:
+        by_source[r["source"]] = by_source.get(r["source"], 0) + 1
+    assert by_source == COMMITTED_ROW_COUNTS
+    skips: dict = {}
+    for s in committed["skipped"]:
+        skips[s["source"]] = skips.get(s["source"], 0) + 1
+    assert skips == COMMITTED_SKIPS
+    assert len(committed["rows"]) == 229
+
+
+def test_pin_matrix_series_and_families(committed):
+    rep = ledger.report(committed["rows"])
+    assert rep["n_series"] == 223
+    assert rep["families"] == ["bench", "cost", "ddp", "input", "matrix",
+                               "multichip", "serve"]
+
+
+def test_golden_row_bench_wrapped(committed):
+    (row,) = _rows(committed, source="BENCH_r01.json")
+    assert row == {
+        "series": "bench.train_images_per_sec_per_chip/mlp x1/?",
+        "metric": "bench.train_images_per_sec_per_chip", "variant": None,
+        "model": "mlp", "param_scale": 1, "n_devices": None,
+        "per_chip_batch": None, "backend": None, "value": 7545951.8,
+        "direction": "higher_better", "run_ord": 1,
+        "source": "BENCH_r01.json", "unit": "images/sec/chip"}
+
+
+def test_golden_row_multichip_legacy(committed):
+    (row,) = _rows(committed, source="MULTICHIP_r01.json")
+    assert row["series"] == "multichip.ok/mlp x1/8dev/?"
+    assert row["value"] == 1.0
+    assert row["direction"] == "higher_better"
+    assert row["run_ord"] == 1
+
+
+def test_golden_row_multichip_strategies(committed):
+    row = _rows(committed, source="MULTICHIP_r08.json",
+                metric="ddp.images_per_sec", variant="bf16+overlap")[0]
+    assert row["series"] == \
+        "ddp.images_per_sec/bf16+overlap/mlp x8/8dev/b4/cpu"
+    assert row["value"] == 383.0
+    assert (row["model"], row["param_scale"], row["n_devices"],
+            row["per_chip_batch"], row["backend"]) == ("mlp", 8, 8, 4,
+                                                       "cpu")
+
+
+def test_golden_row_cost(committed):
+    (row,) = _rows(committed, source="COST_r01.json",
+                   metric="cost.peak_hbm_bytes")
+    assert row["series"] == "cost.peak_hbm_bytes/mlp x16/8dev/b4/cpu"
+    assert row["value"] == 130073956.0
+    assert row["direction"] == "lower_better"
+    effs = _rows(committed, source="COST_r01.json",
+                 metric="cost.analytic_efficiency")
+    assert len(effs) == 8 and all(e["variant"].startswith("ddp.step.")
+                                  for e in effs)
+
+
+def test_golden_row_serve(committed):
+    (row,) = _rows(committed, metric="serve.max_sustained_qps",
+                   variant="legacy")
+    assert row["series"] == "serve.max_sustained_qps/legacy/mlp x1/cpu"
+    assert row["value"] == 19772.84
+    (p99,) = _rows(committed, metric="serve.p99_ms", variant="fast")
+    assert p99["value"] == 2.423 and p99["direction"] == "lower_better"
+
+
+def test_golden_row_input(committed):
+    (row,) = _rows(committed, metric="input.data_wait_share_p95",
+                   variant="pipeline")
+    assert row["series"] == "input.data_wait_share_p95/pipeline/mlp x1/?"
+    assert row["value"] == 0.3061
+    assert row["direction"] == "lower_better"
+
+
+def test_golden_row_bench_matrix(committed):
+    row = _rows(committed, source="bench_matrix_r03.json",
+                variant="bf16 / XLA / rbg")[0]
+    assert row["series"] == \
+        "matrix.images_per_sec_per_chip/bf16 / XLA / rbg/mlp x1/tpu"
+    assert row["value"] == 14709051.8
+    assert row["run_ord"] == 3
+    # STRICT backend matching: r05's backend-null rerun of the same label
+    # must NOT join r03's tpu series
+    r05 = _rows(committed, source="bench_matrix_r05.json")
+    assert all(r["backend"] is None for r in r05)
+
+
+def test_multichip_ok_forms_multi_run_series(committed):
+    hist = ledger.histories(committed["rows"])
+    legacy = hist["multichip.ok/mlp x1/8dev/?"]
+    assert [r["run_ord"] for r in legacy] == [1, 2, 3, 4, 5]
+    modern = hist["multichip.ok/mlp x1/8dev/cpu"]
+    assert [r["run_ord"] for r in modern] == [6, 7, 8]
+    assert all(r["value"] == 1.0 for r in legacy + modern)
+
+
+# ------------------------------------------------- generation detection
+
+def test_detect_generation_refuses_unknown(tmp_path):
+    p = tmp_path / "MULTICHIP_r99.json"
+    p.write_text(json.dumps({"something": 1, "else": 2}))
+    with pytest.raises(ledger.LedgerError) as ei:
+        ledger.load_artifact(str(p))
+    assert "MULTICHIP_r99.json" in str(ei.value)
+    assert "generation" in str(ei.value)
+
+
+def test_unknown_bench_metric_fails_by_name(tmp_path):
+    p = tmp_path / "BENCH_r42.json"
+    p.write_text(json.dumps({"metric": "mnist_new_hotness", "value": 1.0}))
+    with pytest.raises(ledger.LedgerError) as ei:
+        ledger.load_artifact(str(p))
+    assert "mnist_new_hotness" in str(ei.value)
+    assert "direction" in str(ei.value)
+
+
+def test_schema_version_grandfather_and_refusal(tmp_path):
+    assert ledger.check_schema_version({}, "x") == 1
+    assert ledger.check_schema_version({"schema_version": 2}, "x") == 2
+    with pytest.raises(ledger.LedgerError) as ei:
+        ledger.check_schema_version({"schema_version": 3}, "FUT.json")
+    assert "FUT.json" in str(ei.value) and "3" in str(ei.value)
+
+
+def test_run_ordinal_precedence(tmp_path):
+    assert ledger.run_ordinal({"run_ord": 12, "n": 3}, "A_r01.json") == 12
+    assert ledger.run_ordinal({"n": 3}, "A_r01.json") == 3
+    assert ledger.run_ordinal({}, "A_r07.json") == 7
+    assert ledger.run_ordinal({}, "whatever.json") == 0
+
+
+def test_discover_ignores_non_artifacts(tmp_path):
+    (tmp_path / "BASELINE.json").write_text("{}")
+    (tmp_path / "BENCH_r01.json").write_text("{}")
+    found = ledger.discover(str(tmp_path))
+    assert [os.path.basename(p) for p in found] == ["BENCH_r01.json"]
+
+
+# ------------------------------------------------------- trend and gate
+
+def _mk(series_values, direction="higher_better"):
+    return [{"series": "s", "metric": "m.x", "variant": None,
+             "model": "mlp", "param_scale": 1, "n_devices": None,
+             "per_chip_batch": None, "backend": None, "value": v,
+             "direction": direction, "run_ord": i + 1,
+             "source": f"r{i + 1:02d}", "unit": None}
+            for i, v in enumerate(series_values)]
+
+
+def test_gate_pairwise_degenerate_case():
+    # ONE prior point: MAD 0, the band collapses — exactly the old
+    # pairwise ratio gate
+    stats = ledger.trend(_mk([100.0, 40.0]))
+    assert stats["regressed"] and stats["ratio"] == pytest.approx(2.5)
+    assert not ledger.trend(_mk([100.0, 90.0]))["regressed"]
+
+
+def test_gate_mad_band_tolerates_noisy_series():
+    # history median 14, MAD 2 -> band 6: a dip to 9 clears the ratio
+    # threshold but sits INSIDE the band (jitter), 7 falls outside (real)
+    base = [10.0, 12.0, 14.0, 16.0, 18.0]
+    inside = ledger.trend(_mk(base + [9.0]))
+    assert inside["ratio"] > 1.5 and not inside["regressed"]
+    outside = ledger.trend(_mk(base + [7.0]))
+    assert outside["regressed"]
+
+
+def test_gate_lower_better_direction():
+    stats = ledger.trend(_mk([2.0, 2.0, 2.0, 4.1], "lower_better"))
+    assert stats["regressed"] and stats["ratio"] == pytest.approx(2.05)
+    # improvement in a lower_better series never regresses
+    assert not ledger.trend(_mk([2.0, 2.0, 1.0],
+                                "lower_better"))["regressed"]
+
+
+def test_gate_collapse_to_zero_is_infinitely_worse():
+    stats = ledger.trend(_mk([1.0, 1.0, 1.0, 0.0]))
+    assert stats["regressed"] and math.isinf(stats["ratio"])
+
+
+def test_streak_counts_consecutive_worse():
+    assert ledger.trend(_mk([5.0, 4.0, 3.0, 2.9]))["streak"] == 3
+    assert ledger.trend(_mk([5.0, 4.0, 6.0]))["streak"] == 0
+    assert ledger.trend(_mk([1.0, 2.0, 3.0],
+                            "lower_better"))["streak"] == 2
+
+
+def test_gate_window_bounds_history():
+    # ancient good runs outside the window must not mask a slow rot
+    values = [100.0] * 3 + [10.0] * 5 + [4.0]
+    stats = ledger.trend(_mk(values), window=5)
+    assert stats["center"] == 10.0 and stats["regressed"]
+
+
+def test_gate_names_series_and_run(committed):
+    rows = committed["rows"] + _mk([1.0])  # disjoint single-point series
+    rep = ledger.gate(rows)
+    assert rep["ok"] and rep["failures"] == []
+    bad = dict(rows[-1], value=0.25, run_ord=99, source="MULTICHIP_r99")
+    good = dict(rows[-1], value=1.0, run_ord=98, source="MULTICHIP_r98")
+    rep = ledger.gate(rows + [good, bad])
+    assert not rep["ok"]
+    assert any("MULTICHIP_r99" in f and f.startswith("s:")
+               and "r99" in f for f in rep["failures"])
+
+
+def test_report_markdown_renders_every_series(committed):
+    rep = ledger.report(committed["rows"])
+    md = ledger.render_markdown(rep)
+    body = [ln for ln in md.splitlines()
+            if ln.startswith("| ") and not ln.startswith("| series")]
+    assert len(body) == rep["n_series"]
+    assert "223 series" in md
+
+
+# --------------------------------------- shared normalizer + validators
+
+def test_normalize_workload_legacy_defaults():
+    wl = analysis.normalize_workload({})
+    assert wl == {"model": "mlp", "param_scale": 1, "n_devices": None,
+                  "per_chip_batch": None}
+    wl = analysis.normalize_workload({"n_devices": 4},
+                                     {"model": "tf", "param_scale": 2})
+    assert wl == {"model": "tf", "param_scale": 2, "n_devices": 4,
+                  "per_chip_batch": None}
+
+
+def test_strategy_row_label_matches_efficiency_report():
+    # the ONE shared rule: efficiency_report's gate labels must be built
+    # from the same normalizer the ledger keys series with
+    art = {"n_devices": 8}
+    row = {"strategy": "pmean", "overlap": True, "model": "mlp",
+           "param_scale": 16, "scaling_efficiency_vs_1dev": 0.5}
+    assert analysis.strategy_row_label(row, art) == \
+        "pmean+overlap@mlp x16@8dev"
+    rep = analysis.efficiency_report({"n_devices": 8,
+                                      "strategies": [row]})
+    assert list(rep["efficiency"]) == ["pmean+overlap@mlp x16@8dev"]
+    legacy = {"strategy": "allreduce", "scaling_efficiency_vs_1dev": 0.9}
+    assert analysis.strategy_row_label(legacy, art) == "allreduce@8dev"
+
+
+def test_ledger_row_errors_contract():
+    ok = {"kind": "point", "name": "ledger_row", "_line": 1,
+          "attrs": {"series": "s", "direction": "higher_better",
+                    "value": 1.0}}
+    assert analysis.ledger_row_errors([ok]) == []
+    bad = [
+        {"kind": "point", "name": "ledger_row", "_line": 2,
+         "attrs": {"series": "", "direction": "higher_better",
+                   "value": 1.0}},
+        {"kind": "point", "name": "ledger_row", "_line": 3,
+         "attrs": {"series": "s", "direction": "sideways", "value": 1.0}},
+        {"kind": "point", "name": "ledger_row", "_line": 4,
+         "attrs": {"series": "s", "direction": "lower_better",
+                   "value": float("nan")}},
+    ]
+    errors = analysis.ledger_row_errors([ok] + bad)
+    assert [line for line, _ in errors] == [2, 3, 4]
+    assert "series" in errors[0][1]
+    assert "sideways" in errors[1][1]
+    assert "finite" in errors[2][1]
+    # other point kinds pass through untouched
+    assert analysis.ledger_row_errors(
+        [{"kind": "point", "name": "health", "attrs": {}}]) == []
+
+
+def test_directions_registry_is_total(committed):
+    directions = ledger.metric_directions()
+    for row in committed["rows"]:
+        assert directions[row["metric"]] == row["direction"]
+
+
+# -------------------------------------------------- stamps + round trip
+
+def test_ledger_stamp_fields_contract(monkeypatch):
+    from bench import ledger_stamp_fields
+    monkeypatch.setenv("PDMT_RUN_ORD", "17")
+    stamp = ledger_stamp_fields()
+    assert stamp == {"schema_version": ledger.SCHEMA_VERSION,
+                     "run_ord": 17}
+    monkeypatch.delenv("PDMT_RUN_ORD")
+    stamp = ledger_stamp_fields()
+    assert stamp["schema_version"] == ledger.SCHEMA_VERSION
+    assert isinstance(stamp["run_ord"], int) and stamp["run_ord"] > 0
+
+
+def test_multichip_smoke_inline_stamp_pinned():
+    # multichip_smoke inlines the stamp (its failed-backend path must not
+    # import jax); the inline constant must track ledger.SCHEMA_VERSION
+    path = os.path.join(REPO, "scripts", "multichip_smoke.py")
+    with open(path) as f:
+        src = f.read()
+    assert f'artifact["schema_version"] = {ledger.SCHEMA_VERSION}' in src
+    assert ledger.SCHEMA_VERSION == 2
+
+
+def test_stamped_artifact_round_trips(tmp_path, committed):
+    # a v2-stamped line ingests with its explicit run_ord winning over
+    # the filename convention
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({
+        "metric": "mnist_train_images_per_sec_per_chip", "value": 5.0,
+        "unit": "images/sec/chip", "schema_version": 2, "run_ord": 42}))
+    rows, skipped = ledger.load_artifact(str(p))
+    assert not skipped
+    assert rows[0]["run_ord"] == 42
+    assert rows[0]["series"] == \
+        committed["rows"][0]["series"].replace("x1/?", "x1/?")  # same key
+    assert rows[0]["metric"] == "bench.train_images_per_sec_per_chip"
+
+
+def test_export_ledger_counter_tracks(committed):
+    hist = ledger.histories(committed["rows"])
+    trace = export.chrome_trace([], ledger_series=hist)
+    counters = [e for e in trace["traceEvents"]
+                if e.get("ph") == "C" and e.get("cat") == "ledger"]
+    assert len(counters) == len(committed["rows"])
+    assert all(e["pid"] == export.LEDGER_PID for e in counters)
+    legacy_ok = [e for e in counters
+                 if e["name"] == "multichip.ok/mlp x1/8dev/?"]
+    assert [e["ts"] for e in legacy_ok] == [0.0, 1e6, 2e6, 3e6, 4e6]
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert "performance ledger" in names
