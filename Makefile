@@ -2,7 +2,7 @@
 # the optional C++ reader core (ctypes loads it on demand otherwise).
 PY ?= python
 
-.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke overhead-smoke ledger-smoke check native clean convert
+.PHONY: test test-fast test-integration bench serve-smoke serve-trace-smoke serve-fast-smoke obs-smoke trace-smoke ddp-smoke chaos-smoke serve-chaos-smoke cluster-smoke elastic-smoke health-smoke lint audit-program static-smoke sanitize-smoke input-smoke cost-smoke overhead-smoke ledger-smoke check native clean convert
 
 # BOTH tiers — the committed way to run everything (-m "" overrides the
 # fast-tier default addopts in pyproject.toml).
@@ -147,6 +147,18 @@ chaos-smoke:
 		{ rc=$$?; [ $$rc -eq 75 ] && \
 		JAX_PLATFORMS=cpu $(PY) scripts/chaos_smoke.py --world 1; }
 
+# Serving chaos (docs/ROBUSTNESS.md §Serving chaos): a 2-replica fleet
+# under open-loop load survives an injected engine crash mid-spike-burst
+# and a wedged (hung, never erroring) replica — measured availability
+# must be 1.0 with bitwise-identical predictions — then a hot-reload
+# cycle promotes good checkpoints behind per-replica drains while an
+# injected validation fault and a NaN checkpoint are refused by name and
+# a torn newest falls back to the newest intact step; the whole trace is
+# gated by `check_telemetry --require serve.fleet.,serve.reload.`
+# (known event names, outstanding_at_swap == 0 on every swap).
+serve-chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_chaos_smoke.py
+
 # Elastic smoke (docs/ROBUSTNESS.md §Elastic training): SIGKILL one rank
 # of a seeded 2-process `--elastic` run; the survivor must
 # rescue-checkpoint, re-wire into the world-1 membership under the next
@@ -268,7 +280,7 @@ ledger-smoke:
 # cluster-forensics round trip (collective journal + hang attribution),
 # then the performance-ledger round trip (the multi-run trend gate over
 # the committed artifact history), then the fast test tier.
-check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke overhead-smoke cluster-smoke elastic-smoke ledger-smoke test-fast
+check: static-smoke sanitize-smoke input-smoke serve-trace-smoke serve-fast-smoke cost-smoke overhead-smoke cluster-smoke elastic-smoke ledger-smoke serve-chaos-smoke test-fast
 
 # Live-health smoke (docs/OBSERVABILITY.md §Live health): inject
 # nan:step=K into a short CPU run under --health checkpoint-and-warn and

@@ -395,25 +395,34 @@ def _serve_bench(a) -> None:
     engine precompiles its bucket ladder on whatever backend is up."""
     from pytorch_ddp_mnist_tpu import telemetry
     from pytorch_ddp_mnist_tpu.models import init_mlp
-    from pytorch_ddp_mnist_tpu.serve import InferenceEngine, ServeService
+    from pytorch_ddp_mnist_tpu.serve import (FleetService, InferenceEngine,
+                                             ServeService)
     from pytorch_ddp_mnist_tpu.serve.loadgen import run_loadgen
 
     # A fresh registry per bench (not the process-wide one): the artifact
     # must report THIS run's serve counters, not whatever else the process
     # accumulated.
     reg = telemetry.MetricsRegistry()
-    engine = InferenceEngine(init_mlp(jax.random.key(0)),
-                             max_batch=a.max_batch)
+    params = init_mlp(jax.random.key(0))
+    if a.replicas > 1:
+        service = FleetService(
+            lambda p_: InferenceEngine(p_, max_batch=a.max_batch), params,
+            n_replicas=a.replicas, max_batch=a.max_batch,
+            max_delay_ms=a.max_delay_ms, max_depth=a.queue_depth,
+            registry=reg, fast=a.serve_fast)
+        engine = service.engine
+    else:
+        engine = InferenceEngine(params, max_batch=a.max_batch)
+        service = ServeService(engine, max_delay_ms=a.max_delay_ms,
+                               max_depth=a.queue_depth, registry=reg,
+                               fast=a.serve_fast)
     # Bucket executables compiled at construction; one dispatch per bucket
     # seats runtime first-call overhead outside the measured percentiles.
     for b in engine.buckets:
         engine.predict(np.zeros((b, 784), np.float32))
     telemetry.record_engine_compiles(reg, engine.compile_count)
-    service = ServeService(engine, max_delay_ms=a.max_delay_ms,
-                           max_depth=a.queue_depth, registry=reg,
-                           fast=a.serve_fast)
     out = run_loadgen(service, offered_rps=a.offered_rps,
-                      n_requests=a.requests, seed=0)
+                      n_requests=a.requests, seed=0, shape=a.shape)
     lat = out["latency_ms"]
     rps = out["achieved_rps"]
     counters = reg.snapshot()["counters"]
@@ -429,7 +438,20 @@ def _serve_bench(a) -> None:
         "vs_baseline": (round(rps / NOMINAL_BASELINE_SERVE_RPS, 4)
                         if rps else None),
         "offered_rps": out["offered_rps"],
+        "shape": out["shape"],
         "p50_ms": lat["p50"], "p95_ms": lat["p95"], "p99_ms": lat["p99"],
+        # robustness stamps (always present so the ledger trends them
+        # across single-engine AND fleet rounds): availability is the
+        # fraction of ADMITTED requests answered — rejects are honest
+        # backpressure, failures are broken promises; retried_requests
+        # counts fleet failovers (0 without --replicas); reloads counts
+        # hot swaps (0 in a bench — the chaos smoke drives those)
+        "availability": (round(out["completed"]
+                               / (out["completed"] + out["failed"]), 6)
+                         if out["completed"] + out["failed"] else None),
+        "replicas": a.replicas,
+        "retried_requests": counters.get("serve.fleet.retried_requests", 0),
+        "reloads": counters.get("serve.reload.reloads", 0),
         # client-perceived minus server-side e2e at matched percentiles:
         # the front-door (event-loop scheduling / transport) overhead the
         # server histogram cannot see (serve/loadgen.py)
@@ -1127,6 +1149,16 @@ def main(argv=None) -> None:
                         "staging + off-loop reply) — the A/B knob the "
                         "SERVE_r01 before/after artifact rides "
                         "(docs/SERVING.md §Fast path)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve mode: engine replicas behind the shared "
+                        "admission layer (>1 = FleetService with SLO-aware "
+                        "routing + failover — docs/SERVING.md §Replica "
+                        "fleet & hot reload)")
+    p.add_argument("--shape", choices=("poisson", "ramp", "spike"),
+                   default="poisson",
+                   help="serve mode: offered-load arrival shape — "
+                        "homogeneous poisson, a 0.2x->1.8x linear ramp, or "
+                        "a 3x mid-run burst (serve/loadgen.py)")
     from pytorch_ddp_mnist_tpu.parallel.wireup import backend_wait_env
     p.add_argument("--backend_wait", type=float,
                    default=backend_wait_env(3600.0),
@@ -1151,11 +1183,14 @@ def main(argv=None) -> None:
             p.error("--max_delay_ms must be >= 0")
         if a.queue_depth < 1:
             p.error("--queue_depth must be >= 1")
+        if a.replicas < 1:
+            p.error("--replicas must be >= 1")
     else:
         # serve-mode knobs rejected by name elsewhere (same mislabeled-
         # measurement rule as the train knobs below)
         for dest in ("offered_rps", "requests", "max_batch",
-                     "max_delay_ms", "queue_depth", "serve_fast"):
+                     "max_delay_ms", "queue_depth", "serve_fast",
+                     "replicas", "shape"):
             if getattr(a, dest) != p.get_default(dest):
                 flag = "no_fast" if dest == "serve_fast" else dest
                 p.error(f"--{flag} is a serve-mode "

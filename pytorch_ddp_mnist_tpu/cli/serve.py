@@ -45,10 +45,23 @@ import sys
 import numpy as np
 
 
-def build_engine(a):
+def initial_params(a):
+    """The weights generation 0 serves: the --checkpoint file (msgpack or
+    the reference's torch .pt) or a fresh --seed init."""
     import jax
 
     from ..models import init_mlp
+    from ..train.checkpoint import load_checkpoint
+
+    if a.checkpoint:
+        return load_checkpoint(a.checkpoint, init_mlp(jax.random.key(0)))
+    return init_mlp(jax.random.key(a.seed))
+
+
+def engine_builder(a):
+    """`build(params) -> InferenceEngine` with the CLI's geometry baked
+    in — called once for a single-engine service, N times (plus per
+    restart / per reload generation) by `FleetService`."""
     from ..parallel import data_parallel_mesh
     from ..serve import InferenceEngine
 
@@ -57,13 +70,15 @@ def build_engine(a):
         mesh = data_parallel_mesh()
         if mesh.devices.size == 1:
             mesh = None  # 1-device mesh is the serial engine
-    if a.checkpoint:
-        return InferenceEngine.from_checkpoint(
-            a.checkpoint, max_batch=a.max_batch, mesh=mesh,
-            input_dtype=a.input_dtype)
-    return InferenceEngine(init_mlp(jax.random.key(a.seed)),
-                           max_batch=a.max_batch, mesh=mesh,
-                           input_dtype=a.input_dtype)
+
+    def build(params):
+        return InferenceEngine(params, max_batch=a.max_batch, mesh=mesh,
+                               input_dtype=a.input_dtype)
+    return build
+
+
+def build_engine(a):
+    return engine_builder(a)(initial_params(a))
 
 
 async def handle_request(service, req: dict) -> dict:
@@ -100,13 +115,18 @@ async def handle_request(service, req: dict) -> dict:
                 "serve": service.metrics.snapshot()}
     if op == "health":
         pred = service.metrics.predicted_p99()
-        return {"ok": True,
-                "health": {**service.metrics.slo.snapshot(),
-                           "predicted_p99_ms": (round(pred * 1e3, 3)
-                                                if pred is not None
-                                                else None),
-                           "queue_depth": service.admission.depth,
-                           "draining": service.admission.draining}}
+        health = {**service.metrics.slo.snapshot(),
+                  "predicted_p99_ms": (round(pred * 1e3, 3)
+                                       if pred is not None
+                                       else None),
+                  "queue_depth": service.admission.depth,
+                  "draining": service.admission.draining}
+        # a fleet front door also answers replica states, degradation and
+        # the failover/restart/reload counters (--replicas / --reload_dir)
+        fleet_snap = getattr(service, "fleet_snapshot", None)
+        if fleet_snap is not None:
+            health["fleet"] = fleet_snap()
+        return {"ok": True, "health": health}
     pixels = np.asarray(req["pixels"])
     return {"ok": True, "pred": await service.handle(pixels)}
 
@@ -129,9 +149,20 @@ async def _handle_conn(service, reader, writer):
     writer.close()
 
 
-async def _serve_tcp(service, host: str, port: int) -> None:
+async def _serve_tcp(service, host: str, port: int,
+                     reload_dir: str | None = None,
+                     poll_interval_s: float = 0.25) -> None:
     import signal
 
+    watcher = None
+    if reload_dir:
+        from ..serve import ReloadWatcher
+        watcher = ReloadWatcher(service, reload_dir,
+                                poll_interval_s=poll_interval_s)
+        watcher.start()
+        print(f"reload watcher: polling {reload_dir} every "
+              f"{poll_interval_s}s (serving step "
+              f"{service.serving_step})", file=sys.stderr, flush=True)
     server = await asyncio.start_server(
         lambda r, w: _handle_conn(service, r, w), host, port)
     bound = server.sockets[0].getsockname()
@@ -146,6 +177,8 @@ async def _serve_tcp(service, host: str, port: int) -> None:
     await stop.wait()
     print("drain: refusing new requests, finishing in-flight ones",
           file=sys.stderr, flush=True)
+    if watcher is not None:   # no swap may start once the drain begins
+        await watcher.stop()
     await service.shutdown()
     server.close()
     await server.wait_closed()
@@ -206,6 +239,25 @@ def main(argv=None) -> int:
                         "of the staged fast path (persistent staging "
                         "buffers + off-loop reply scatter) — an A/B and "
                         "escape hatch (docs/SERVING.md §Fast path)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="engine replicas behind the shared admission "
+                        "layer; >1 enables SLO-aware routing, the wedge "
+                        "watchdog and bounded request failover "
+                        "(docs/SERVING.md §Replica fleet & hot reload)")
+    p.add_argument("--reload_dir", default=None, metavar="DIR",
+                   help="watch this checkpoint directory (train/"
+                        "ckpt_manager layout) and hot-swap replicas to "
+                        "newly committed steps behind per-replica drains; "
+                        "torn/non-finite candidates are refused by name "
+                        "while the incumbent keeps serving (TCP mode only)")
+    p.add_argument("--wedge_timeout_ms", type=float, default=250.0,
+                   help="fleet watchdog: a replica whose oldest in-flight "
+                        "batch ages past this is quarantined, its requests "
+                        "failed over to a survivor, and it is restarted")
+    p.add_argument("--retry_budget", type=int, default=2,
+                   help="failover attempts per admitted request before it "
+                        "errors out (bounds the work one poisoned request "
+                        "can burn across the fleet)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0,
                    help="TCP port (0 = ephemeral; the bound port prints "
@@ -215,14 +267,26 @@ def main(argv=None) -> int:
                         "and print the metrics snapshot (no socket)")
     p.add_argument("--offered_rps", type=float, default=500.0,
                    help="--selftest arrival rate")
+    p.add_argument("--shape", choices=("poisson", "ramp", "spike"),
+                   default="poisson",
+                   help="--selftest arrival shape: homogeneous poisson, a "
+                        "0.2x->1.8x linear ramp, or a 3x mid-run burst "
+                        "(docs/SERVING.md §Load generator)")
     a = p.parse_args(argv)
-    for name in ("max_batch", "queue_depth"):
+    for name in ("max_batch", "queue_depth", "replicas"):
         if getattr(a, name) < 1:
             p.error(f"--{name} must be >= 1")
     if a.max_delay_ms < 0:
         p.error("--max_delay_ms must be >= 0")
     if a.admit == "predicted_p99" and a.slo_p99_ms <= 0:
         p.error("--slo_p99_ms must be > 0 under --admit predicted_p99")
+    if a.wedge_timeout_ms <= 0:
+        p.error("--wedge_timeout_ms must be > 0")
+    if a.retry_budget < 0:
+        p.error("--retry_budget must be >= 0")
+    if a.reload_dir and a.selftest is not None:
+        p.error("--reload_dir needs the TCP server (the watcher lives on "
+                "its event loop); drop --selftest")
 
     from ..serve import ServeService
     from .. import telemetry
@@ -243,16 +307,30 @@ def main(argv=None) -> int:
         # flight recorder's drain dump lands beside the trace
         telemetry.enable(a.telemetry)
         flight.set_dump_dir(a.telemetry)
-    engine = build_engine(a)
+    common = dict(max_delay_ms=a.max_delay_ms, max_depth=a.queue_depth,
+                  registry=reg, admit_mode=a.admit,
+                  slo_p99_s=(a.slo_p99_ms / 1e3
+                             if a.admit == "predicted_p99" else None),
+                  fast=a.fast)
+    fleet_mode = a.replicas > 1 or a.reload_dir
+    if fleet_mode:
+        # N replicas (or 1 + hot reload, which still needs the fleet's
+        # drain-and-swap machinery) behind the same admission layer
+        from ..serve import FleetService
+        service = FleetService(
+            engine_builder(a), initial_params(a), n_replicas=a.replicas,
+            max_batch=a.max_batch,
+            wedge_timeout_s=a.wedge_timeout_ms / 1e3,
+            retry_budget=a.retry_budget, **common)
+        engine = service.engine
+    else:
+        engine = build_engine(a)
+        service = ServeService(engine, **common)
     telemetry.record_engine_compiles(reg, engine.compile_count)
-    service = ServeService(
-        engine, max_delay_ms=a.max_delay_ms, max_depth=a.queue_depth,
-        registry=reg, admit_mode=a.admit,
-        slo_p99_s=(a.slo_p99_ms / 1e3 if a.admit == "predicted_p99"
-                   else None), fast=a.fast)
     print(f"engine warm: buckets={list(engine.buckets)} "
           f"compiles={engine.compile_count} "
           f"input_dtype={engine.input_dtype} admit={a.admit} "
+          f"replicas={a.replicas} "
           f"fast={'on' if service.batcher.fast_path else 'off'}",
           file=sys.stderr, flush=True)
 
@@ -276,13 +354,15 @@ def main(argv=None) -> int:
             p.error("--selftest must be >= 1")
         from ..serve.loadgen import run_loadgen
         out = run_loadgen(service, offered_rps=a.offered_rps,
-                          n_requests=a.selftest, seed=a.seed)
+                          n_requests=a.selftest, seed=a.seed,
+                          shape=a.shape)
         out.pop("predictions")          # counters, not payloads
         _close_telemetry("serve selftest")
         print(json.dumps(out))
         return 0
 
-    asyncio.run(_serve_tcp(service, a.host, a.port))
+    asyncio.run(_serve_tcp(service, a.host, a.port,
+                           reload_dir=a.reload_dir))
     _close_telemetry("serve drain", dump=False)  # _serve_tcp just dumped
     print(json.dumps(service.metrics.snapshot()))
     return 0

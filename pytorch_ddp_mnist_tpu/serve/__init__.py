@@ -10,9 +10,13 @@ predicted-p99 SLO boundary (`admission.py`), latency-percentile metrics
 with per-stage attribution (`metrics.py`), request-scoped stage tracing —
 request_id at the front door, a telescoped admission/queue/batch_form/
 pad_h2d/compute/reply breakdown at the back (`tracing.py`) — and an
-open-loop Poisson load generator (`loadgen.py`). `ServeService` wires them
-into the one request path every front door (cli/serve.py TCP server,
-bench.py --mode serve, tests) shares.
+open-loop load generator with poisson/ramp/spike arrival shapes
+(`loadgen.py`). `ServeService` wires them into the one request path every
+front door (cli/serve.py TCP server, bench.py --mode serve, tests) shares;
+`FleetService` (fleet.py) replicates the engine N ways behind the same
+admission layer with SLO-aware routing, a wedge watchdog, bounded request
+failover, and supervised restarts, and `ReloadWatcher` (reload.py) hot-swaps
+the fleet to newly committed checkpoints behind per-replica drains.
 
 Everything runs identically under JAX_PLATFORMS=cpu — the full request path
 is exercised by tier-1 tests without hardware.
@@ -25,7 +29,10 @@ import asyncio
 from .admission import ADMIT_MODES, AdmissionController, Rejected  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
 from .engine import InferenceEngine, bucket_ladder  # noqa: F401
+from .fleet import (FleetService, FleetUnavailable, ReplicaCrashed,  # noqa: F401
+                    ReplicaFailure, ReplicaWedged)
 from .metrics import LatencyHistogram, ServeMetrics, SLOWindow  # noqa: F401
+from .reload import ReloadWatcher  # noqa: F401
 from .tracing import ServeTracer  # noqa: F401
 
 

@@ -133,6 +133,14 @@ class MicroBatcher:
         self._outstanding: "set[asyncio.Future]" = set()
         self._reply_q: "queue.Queue" = queue.Queue()
         self._reply_thread: Optional[threading.Thread] = None
+        # In-flight journal for the fleet supervisor (serve/fleet.py):
+        # id(batch) -> (t_dispatch, batch) for every fast-path flush
+        # dispatched but not yet scattered. Loop-side only (opened in
+        # _flush_fast, closed in _scatter) — the supervisor ages the
+        # oldest entry exactly like the PR 14 collective watchdog ages
+        # open journal entries, and `fail_all` is the failover that
+        # releases the waiters of a replica declared dead or wedged.
+        self._inflight_meta: "dict[int, tuple]" = {}
         if self.fast_path:
             # spawn eagerly: thread startup is construction-time cost,
             # never first-request latency (close() stops it; a later
@@ -265,6 +273,7 @@ class MicroBatcher:
                     fut.set_exception(e)
             return len(batch)
         self.flushes += 1
+        self._inflight_meta[id(batch)] = (self.clock(), batch)
         for _, fut, _, _ in batch:
             self._outstanding.add(fut)
             fut.add_done_callback(self._outstanding.discard)
@@ -289,8 +298,12 @@ class MicroBatcher:
         """
         handle, batch, bctx = item
         ewma = self._fetch_ewma.get(handle.bucket)
+        # inline_ok is False only for a deliberately wedged handle
+        # (fault injection): EWMA history must never vouch a hung fetch
+        # onto the loop — it would blind the fleet watchdog under test
         if handle.ready() or (ewma is not None
-                              and ewma <= self._inline_budget_s):
+                              and ewma <= self._inline_budget_s
+                              and getattr(handle, "inline_ok", True)):
             self.inline_replies += 1
             self._scatter(self._fetch_payload(handle, batch, bctx))
         else:
@@ -359,10 +372,21 @@ class MicroBatcher:
         legacy flush tail does, minus the fetch that already happened
         off-loop)."""
         batch, bctx, bucket, preds, err = payload
+        # a journal entry missing here means `fail_all` already failed
+        # this flush over (a quarantined replica's late fetch finally
+        # landing): end the batch span honestly — the device DID finish —
+        # but record no batch and fill no future; the requests were
+        # retried elsewhere and a retry batch accounts for them
+        abandoned = (self._inflight_meta.pop(id(batch), None) is None
+                     and self.fast_path)
         if err is not None:
             for _, fut, _, _ in batch:
                 if not fut.done():
                     fut.set_exception(err)
+            return
+        if abandoned:
+            if bctx is not None:
+                self.tracer.batch_end(bctx, n_real=len(batch))
             return
         if self.metrics is not None:
             self.metrics.record_batch(len(batch), bucket)
@@ -374,6 +398,43 @@ class MicroBatcher:
             if not fut.done():
                 fut.set_result(int(pred))
 
+    # -- fleet supervision surface (serve/fleet.py) -------------------------
+
+    def oldest_inflight_age(self, now: float) -> float:
+        """Age (seconds) of the oldest dispatched-but-unscattered flush at
+        `now`, 0.0 when nothing is in flight — what the fleet supervisor
+        compares against its wedge timeout. Loop-side, like everything
+        else touching the journal."""
+        if not self._inflight_meta:
+            return 0.0
+        return now - min(t for t, _ in self._inflight_meta.values())
+
+    def fail_all(self, exc: BaseException) -> int:
+        """Failover: deliver `exc` to every in-flight AND pending request
+        of this batcher and forget them; returns how many waiters were
+        released. The fleet supervisor calls this on a replica declared
+        dead or wedged so its accepted-but-unanswered requests re-raise at
+        their `submit` await sites and can retry on a survivor — the
+        futures are completed loop-side (this must run on the loop), and
+        a wedged flush's eventual late `_scatter` finds its journal entry
+        gone and delivers nothing twice."""
+        n = 0
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        for _, batch in list(self._inflight_meta.values()):
+            for _, fut, _, _ in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+                    n += 1
+        self._inflight_meta.clear()
+        pending, self._pending = self._pending, []
+        for _, fut, _, _ in pending:
+            if not fut.done():
+                fut.set_exception(exc)
+                n += 1
+        return n
+
     async def drain(self) -> None:
         """Flush whatever is pending and return once it is served — on
         the fast path that means awaiting every outstanding future the
@@ -384,12 +445,20 @@ class MicroBatcher:
             await asyncio.gather(*list(self._outstanding),
                                  return_exceptions=True)
 
-    def close(self) -> None:
+    def close(self, wait: bool = True) -> None:
         """Stop the reply thread (sentinel + join). Call after `drain` —
         anything still queued is fetched and delivered first because the
         sentinel lands behind it. Idempotent; the next fast-path flush
-        would simply spawn a fresh thread."""
+        would simply spawn a fresh thread.
+
+        `wait=False` abandons instead of joining: the sentinel is queued
+        so a LIVE thread exits once it finishes what it is on, but a
+        thread blocked inside a wedged fetch is left behind (daemon — it
+        cannot hold the process). That is the fleet's retirement path for
+        a wedged replica, where joining would block the supervisor for
+        exactly the hang being escaped."""
         if self._reply_thread is not None and self._reply_thread.is_alive():
             self._reply_q.put(None)
-            self._reply_thread.join(timeout=10.0)
+            if wait:
+                self._reply_thread.join(timeout=10.0)
         self._reply_thread = None

@@ -41,6 +41,7 @@ growing the footprint per flush.
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Optional, Sequence
 
@@ -52,6 +53,7 @@ from ..models.mlp import MLP_DIMS, init_mlp, mlp_apply
 from ..parallel.mesh import DATA_AXIS
 from ..train.checkpoint import load_checkpoint
 from ..train.scan import device_normalize
+from ..utils import faultpoints
 
 IN_DIM = MLP_DIMS[0]
 
@@ -70,7 +72,8 @@ class InflightBatch:
     the input rows rode in on, returned to the engine's staging pool at
     fetch/teardown time."""
 
-    __slots__ = ("logits_d", "preds_d", "n", "bucket", "slab")
+    __slots__ = ("logits_d", "preds_d", "n", "bucket", "slab",
+                 "wedged_until")
 
     def __init__(self, logits_d, preds_d, n: int, bucket: int, slab=None):
         self.logits_d = logits_d
@@ -78,17 +81,32 @@ class InflightBatch:
         self.n = n
         self.bucket = bucket
         self.slab = slab
+        # injected-wedge deadline (utils/faultpoints `engine_wedge`):
+        # until this monotonic instant the batch reports not-ready and
+        # its fetch blocks — a device that stopped answering, in handle
+        # form. 0.0 (never) outside chaos runs.
+        self.wedged_until = 0.0
 
     def ready(self) -> bool:
         """Non-blocking: True when both outputs are on-device complete,
         so a fetch would return without waiting. The batcher uses this
         for its opportunistic inline reply (fetch on the loop ONLY when
         it cannot block it)."""
+        if self.wedged_until and time.monotonic() < self.wedged_until:
+            return False
         try:
             return bool(self.logits_d.is_ready()
                         and self.preds_d.is_ready())
         except AttributeError:   # a jax without is_ready: never inline
             return False
+
+    @property
+    def inline_ok(self) -> bool:
+        """False while an injected wedge holds this batch: the reply
+        router must never take a wedged fetch inline — blocking the loop
+        would blind the very watchdog the wedge exists to test."""
+        return not (self.wedged_until
+                    and time.monotonic() < self.wedged_until)
 
 
 def bucket_ladder(max_batch: int, multiple_of: int = 1) -> "tuple[int, ...]":
@@ -202,6 +220,12 @@ class InferenceEngine:
         # passes itself): two concurrent writers would silently corrupt
         # each other's batches, so the second one fails loudly instead
         self._staging_writer = None
+        # -- fleet plumbing: which replica slot this engine fills (None
+        # outside a fleet) and a per-call ordinal, so the serve fault
+        # points (`engine_crash:after=N:replica=R`, `engine_wedge`) can
+        # target one engine at a deterministic point in a burst
+        self.replica: Optional[int] = None
+        self._serve_calls = 0
 
     @classmethod
     def from_checkpoint(cls, path: str, **kw) -> "InferenceEngine":
@@ -249,9 +273,20 @@ class InferenceEngine:
         from ..telemetry.costs import record_oom_forensics
         record_oom_forensics(e, program=f"serve.bucket{bucket}")
 
+    def _fault_ctx(self) -> dict:
+        ctx = {"after": self._serve_calls}
+        if self.replica is not None:
+            ctx["replica"] = self.replica
+        return ctx
+
     def _execute(self, bucket: int, xd):
         """Dispatch the bucket's AOT executable (async under JAX dispatch;
-        the returned arrays are futures until fetched)."""
+        the returned arrays are futures until fetched). The `serve_engine`
+        fault point fires per call with the engine's call ordinal and
+        fleet replica index, so `engine_crash:after=N:replica=R` kills
+        exactly one replica at a deterministic point in a burst."""
+        self._serve_calls += 1
+        faultpoints.fire("serve_engine", **self._fault_ctx())
         try:
             return self._compiled[bucket](self._params, xd)
         except RuntimeError as e:
@@ -345,6 +380,13 @@ class InferenceEngine:
             bctx.mark_h2d(bucket)
         logits, preds = self._execute(bucket, xd)
         handle = InflightBatch(logits, preds, n, bucket, slab)
+        # injected wedge (`engine_wedge:delay_s=S:replica=R`): the batch
+        # reports not-ready and its fetch blocks until the deadline — the
+        # reply thread hangs off-loop exactly as on a dead device, and
+        # the fleet watchdog's in-flight aging is what must notice
+        spec = faultpoints.claim("serve_wedge", **self._fault_ctx())
+        if spec is not None:
+            handle.wedged_until = time.monotonic() + spec.delay_s
         with self._staging_lock:
             self._inflight[id(handle)] = handle
             if self._staging_pool:
@@ -366,7 +408,13 @@ class InferenceEngine:
         (a failed flush's device work is over either way — leaking the
         slab per failure would bleed the pool on a long-running server);
         an allocation failure surfacing here still gets its OOM
-        forensics entry."""
+        forensics entry. A wedged handle (injected `engine_wedge`)
+        blocks HERE until its deadline — this runs on the reply thread
+        (the router never inlines a wedged batch), hanging exactly as it
+        would on a device that never answers; the fleet watchdog's
+        in-flight aging is what notices."""
+        if handle.wedged_until:
+            time.sleep(max(0.0, handle.wedged_until - time.monotonic()))
         try:
             logits = np.asarray(handle.logits_d)[:handle.n]
             preds = np.asarray(handle.preds_d)[:handle.n]

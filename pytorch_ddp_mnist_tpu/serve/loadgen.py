@@ -38,6 +38,59 @@ from .metrics import nearest_rank
 
 IN_DIM = 784
 
+# Arrival shapes for `arrival_times` (cli/bench `--shape`). All three
+# offer the SAME total load (n requests, n/offered_rps nominal seconds);
+# they differ only in how that mass lands on the timeline.
+SHAPES = ("poisson", "ramp", "spike")
+
+
+def arrival_times(n: int, offered_rps: float, *, shape: str = "poisson",
+                  seed: int = 0) -> np.ndarray:
+    """Absolute arrival instants (seconds from start) for `n` requests at
+    nominal `offered_rps`, under one of three offered-load shapes:
+
+    - ``poisson``: homogeneous Poisson — exponential inter-arrival gaps.
+      This branch is bitwise-identical to the generator's original
+      timeline (same seed -> same floats), so every existing artifact
+      and pinned test keeps its exact arrivals.
+    - ``ramp``: linear rate ramp from 0.2x to 1.8x the nominal rate over
+      the run — the warm-up curve that exposes whether admission tuned
+      at steady state also holds while load is still climbing.
+    - ``spike``: 0.5x baseline with a 3x burst through the middle fifth
+      of the run — the flash-crowd shape that stresses failover + drain
+      (the chaos smoke kills a replica inside the burst).
+
+    The inhomogeneous shapes are exact thinning-free draws: simulate a
+    unit-rate Poisson process (cumsum of Exp(1)) and time-warp it through
+    the inverse cumulative intensity Lambda^-1 — for ramp a closed-form
+    quadratic root, for spike a piecewise-linear inversion whose tail
+    continues at the final segment's rate (random mass can overshoot the
+    nominal window; arrivals must stay monotone, never clip)."""
+    if shape not in SHAPES:
+        raise ValueError(f"unknown arrival shape {shape!r}; "
+                         f"choose from {SHAPES}")
+    rng = np.random.default_rng(seed)
+    if shape == "poisson":
+        return np.cumsum(rng.exponential(1.0 / offered_rps, size=n))
+    u = np.cumsum(rng.exponential(1.0, size=n))  # unit-rate arrivals
+    T = n / offered_rps                          # nominal duration
+    if shape == "ramp":
+        # lambda(t) = r*(0.2 + 1.6*t/T)  =>  Lambda(t) = r*(0.2t + 0.8t²/T)
+        # (integrates to exactly n over [0, T]); solve Lambda(t) = u
+        v = u / offered_rps
+        return (T / 1.6) * (np.sqrt(0.04 + 3.2 * v / T) - 0.2)
+    # spike: (fraction-of-T, rate-multiplier) segments; multipliers are
+    # mass-balanced (0.4*0.5 + 0.2*3.0 + 0.4*0.5 = 1.0) so nominal total
+    # stays n
+    segs = ((0.4, 0.5), (0.2, 3.0), (0.4, 0.5))
+    durs = np.array([f * T for f, _ in segs])
+    rates = np.array([m * offered_rps for _, m in segs])
+    mass_edges = np.concatenate([[0.0], np.cumsum(rates * durs)])
+    time_edges = np.concatenate([[0.0], np.cumsum(durs)])
+    seg = np.minimum(np.searchsorted(mass_edges[1:], u, side="left"),
+                     len(segs) - 1)
+    return time_edges[seg] + (u - mass_edges[seg]) / rates[seg]
+
 
 def request_rows(n: int, dtype: str = "float32",
                  seed: int = 0) -> np.ndarray:
@@ -53,21 +106,22 @@ def request_rows(n: int, dtype: str = "float32",
 
 async def run_open_loop(service: ServeService, *, offered_rps: float,
                         n_requests: int, seed: int = 0,
-                        rows: Optional[np.ndarray] = None) -> dict:
-    """Drive `n_requests` through the service at Poisson-`offered_rps`;
-    returns {offered_rps, duration_s, predictions, snapshot...}.
+                        rows: Optional[np.ndarray] = None,
+                        shape: str = "poisson") -> dict:
+    """Drive `n_requests` through the service at `offered_rps` under the
+    given arrival `shape` (see `arrival_times`); returns {offered_rps,
+    duration_s, predictions, snapshot...}.
 
-    Arrival times are precomputed (t_i = cumsum of Exp(1/rate) draws) and
-    each request fires as its own task at its absolute slot — a slow
-    response never delays later arrivals (open loop). Rejects count in the
-    metrics and leave a None prediction."""
+    Arrival times are precomputed and each request fires as its own task
+    at its absolute slot — a slow response never delays later arrivals
+    (open loop). Rejects count in the metrics and leave a None
+    prediction."""
     if offered_rps <= 0:
         raise ValueError(f"offered_rps must be > 0; got {offered_rps}")
     if n_requests < 1:
         raise ValueError(f"n_requests must be >= 1; got {n_requests}")
-    rng = np.random.default_rng(seed)
-    gaps = rng.exponential(1.0 / offered_rps, size=n_requests)
-    arrivals = np.cumsum(gaps)
+    arrivals = arrival_times(n_requests, offered_rps, shape=shape,
+                             seed=seed)
     if rows is None:
         rows = request_rows(n_requests, service.engine.input_dtype,
                             seed=seed + 1)
@@ -142,6 +196,7 @@ async def run_open_loop(service: ServeService, *, offered_rps: float,
                                   ("p99", 0.99))}
     return {
         "offered_rps": round(float(offered_rps), 2),
+        "shape": shape,
         "n_requests": int(n_requests),
         "duration_s": round(duration, 4),
         "predictions": preds,
@@ -152,10 +207,12 @@ async def run_open_loop(service: ServeService, *, offered_rps: float,
 
 
 def run_loadgen(service: ServeService, *, offered_rps: float,
-                n_requests: int, seed: int = 0) -> dict:
+                n_requests: int, seed: int = 0,
+                shape: str = "poisson") -> dict:
     """Synchronous wrapper: open-loop run + graceful drain on one fresh
     event loop (the bench / CLI-selftest entry)."""
     from . import run_until_drained
     return run_until_drained(
         service, run_open_loop(service, offered_rps=offered_rps,
-                               n_requests=n_requests, seed=seed))
+                               n_requests=n_requests, seed=seed,
+                               shape=shape))

@@ -82,6 +82,20 @@ COST_NUMERIC_FIELDS = (
 # loss/health fetch). --
 DISPATCH_PHASE_POINT = "dispatch_phase"
 DISPATCH_WINDOW_POINT = "dispatch_window"
+
+# -- the fleet/reload record contract (serve/fleet.py + serve/reload.py
+# emit these as `point` events at every replica state transition and
+# reload verdict; literals here so the file-loading checker stays
+# framework-free — tests pin them against the emitters). `swapped`
+# events carry the hot-reload invariant itself: `outstanding_at_swap`
+# must be 0 (a request that spanned a swap would have run half on the
+# old weights, half on the new). --
+FLEET_EVENT_POINT = "fleet_event"
+RELOAD_EVENT_POINT = "reload_event"
+FLEET_EVENTS = ("retry", "retry_exhausted", "quarantine", "dead",
+                "restart")
+RELOAD_EVENTS = ("swapped", "reloaded", "refused")
+QUARANTINE_CAUSES = ("wedge", "crash")
 DISPATCH_PHASES = ("python_prestep", "dispatch", "device_idle", "sync_wait")
 # device_idle observes the SAME wall interval python_prestep + dispatch
 # occupy on the host (queue empty until the next enqueue completes), so
@@ -510,6 +524,68 @@ def dispatch_record_errors(segment: List[dict]) -> List[Tuple[int, str]]:
                     errors.append((line, f"dispatch_window {fld} must be a "
                                          f"non-negative number; got "
                                          f"{v!r}"))
+    return errors
+
+
+def fleet_record_errors(segment: List[dict]) -> List[Tuple[int, str]]:
+    """Violations of the fleet/reload point-record contract
+    (serve/fleet.py state transitions, serve/reload.py verdicts) within
+    ONE segment, as (line_no, message) pairs — shared with the
+    file-loading checker like `dispatch_record_errors`.
+
+    A `fleet_event` must name a KNOWN event (writer/reader catalog
+    drift otherwise) and a non-negative int `replica`; a quarantine must
+    name a known cause. A `reload_event` must name a known event; a
+    `swapped` event must carry `outstanding_at_swap == 0` — THE
+    drain-before-swap invariant (any other value means a request's
+    batch was still in flight when the engine under it changed); a
+    `refused` event must carry a non-empty string `reason` (refusal
+    by name is the whole point)."""
+    errors: List[Tuple[int, str]] = []
+    for rec in segment:
+        if rec.get("kind") != "point":
+            continue
+        name = rec.get("name")
+        if name not in (FLEET_EVENT_POINT, RELOAD_EVENT_POINT):
+            continue
+        line = rec.get("_line", 0)
+        attrs = rec.get("attrs") or {}
+        event = attrs.get("event")
+        if name == FLEET_EVENT_POINT:
+            if event not in FLEET_EVENTS:
+                errors.append((line, f"fleet_event names unknown event "
+                                     f"{event!r}; known: {FLEET_EVENTS}"))
+                continue
+            rep = attrs.get("replica")
+            if not isinstance(rep, int) or isinstance(rep, bool) \
+                    or rep < 0:
+                errors.append((line, f"fleet_event {event} replica must "
+                                     f"be a non-negative int; got "
+                                     f"{rep!r}"))
+            if event == "quarantine" \
+                    and attrs.get("cause") not in QUARANTINE_CAUSES:
+                errors.append((line, f"fleet_event quarantine names "
+                                     f"unknown cause "
+                                     f"{attrs.get('cause')!r}; known: "
+                                     f"{QUARANTINE_CAUSES}"))
+            continue
+        if event not in RELOAD_EVENTS:
+            errors.append((line, f"reload_event names unknown event "
+                                 f"{event!r}; known: {RELOAD_EVENTS}"))
+            continue
+        if event == "swapped":
+            out = attrs.get("outstanding_at_swap")
+            if out != 0 or isinstance(out, bool):
+                errors.append((line, f"reload_event swapped violates the "
+                                     f"drain-before-swap invariant: "
+                                     f"outstanding_at_swap must be 0, "
+                                     f"got {out!r}"))
+        elif event == "refused":
+            reason = attrs.get("reason")
+            if not (isinstance(reason, str) and reason):
+                errors.append((line, f"reload_event refused must carry a "
+                                     f"non-empty string reason; got "
+                                     f"{reason!r}"))
     return errors
 
 

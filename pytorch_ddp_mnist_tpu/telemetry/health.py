@@ -436,7 +436,13 @@ def health_summary(registry: Optional[MetricsRegistry] = None) -> dict:
     `health.*` registry metrics — the shape bench.py stamps into artifact
     lines (a failed round then shows WHAT degraded, not just rc != 0).
     A process that never ran a watchdog reads as `{fired: {},
-    worst_severity: None}`."""
+    worst_severity: None}`.
+
+    When the process hosts a serve replica fleet (serve/fleet.py
+    publishes `serve.fleet.replicas` / `serve.fleet.healthy` gauges into
+    the same registry), the summary carries a `fleet` section —
+    `{replicas, healthy, degraded}` — so /healthz and the bench artifact
+    see a quarantined-replica fleet as degraded, not silently fine."""
     snap = (registry if registry is not None else get_registry()).snapshot()
     prefix = "health.fired."
     fired = {name[len(prefix):]: v for name, v in snap["counters"].items()
@@ -447,4 +453,10 @@ def health_summary(registry: Optional[MetricsRegistry] = None) -> dict:
         worst = {v: k for k, v in _SEVERITY_LEVEL.items()}.get(int(level))
         if int(level) == 0:
             worst = "ok"
-    return {"fired": fired, "worst_severity": worst}
+    out = {"fired": fired, "worst_severity": worst}
+    replicas = snap["gauges"].get("serve.fleet.replicas")
+    if replicas is not None:
+        healthy = int(snap["gauges"].get("serve.fleet.healthy") or 0)
+        out["fleet"] = {"replicas": int(replicas), "healthy": healthy,
+                        "degraded": healthy < int(replicas)}
+    return out

@@ -123,6 +123,20 @@ INPUT_VARIANT_METRICS = {
     "data_wait_share_p95": ("input.data_wait_share_p95", LOWER),
 }
 
+# Serve-bench robustness companions: bench.py --mode serve stamps these
+# ALONGSIDE the headline requests/sec line (PR 20); absent on pre-fleet
+# artifacts, so old rounds contribute no rows and the pinned ingest
+# counts hold. availability is the kept-promise fraction
+# (completed/(completed+failed) over ADMITTED requests); retried_requests
+# counts fleet failovers (lower is better — each one is a replica
+# failure a request had to ride out); reloads counts hot checkpoint
+# swaps served without downtime.
+SERVE_ROBUSTNESS_METRICS = {
+    "availability": ("serve.availability", HIGHER),
+    "retried_requests": ("serve.retried_requests", LOWER),
+    "reloads": ("serve.reloads", HIGHER),
+}
+
 # Fixed-name metrics the generation loaders emit directly.
 FIXED_METRICS = {
     "multichip.ok": HIGHER,
@@ -146,7 +160,7 @@ def metric_directions() -> Dict[str, str]:
     smoke's family-coverage assert read this)."""
     out = dict(FIXED_METRICS)
     for table in (BENCH_LINE_METRICS, STRATEGY_ROW_METRICS,
-                  INPUT_VARIANT_METRICS):
+                  INPUT_VARIANT_METRICS, SERVE_ROBUSTNESS_METRICS):
         for name, direction in table.values():
             out[name] = direction
     return out
@@ -244,6 +258,25 @@ def _bench_line_row(doc: dict, run_ord: int, source: str) -> dict:
                 unit=doc.get("unit"))
 
 
+def _serve_robustness_rows(doc: dict, run_ord: int,
+                           source: str) -> List[dict]:
+    """Companion rows off a serve bench line (SERVE_ROBUSTNESS_METRICS):
+    only the serve headline carries them, and only post-fleet artifacts
+    stamp them — both absences are silent, not skips, so pre-fleet
+    histories ingest unchanged."""
+    if doc.get("metric") != "mnist_serve_requests_per_sec":
+        return []
+    wl = normalize_workload(doc)
+    backend = doc.get("backend")
+    rows = []
+    for field, (metric, direction) in SERVE_ROBUSTNESS_METRICS.items():
+        v = doc.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            rows.append(_row(metric, direction, v, run_ord, source,
+                             wl, backend))
+    return rows
+
+
 # -- per-generation loaders: each returns (rows, skipped) ----------------
 
 def _load_bench_line(doc: dict, run_ord: int,
@@ -254,7 +287,8 @@ def _load_bench_line(doc: dict, run_ord: int,
     if doc.get("value") is None:
         return [], [{"source": source, "reason":
                      doc.get("error") or "null value"}]
-    return [_bench_line_row(doc, run_ord, source)], []
+    return ([_bench_line_row(doc, run_ord, source)]
+            + _serve_robustness_rows(doc, run_ord, source)), []
 
 
 def _load_bench_wrapped(doc: dict, run_ord: int,
@@ -271,7 +305,8 @@ def _load_bench_wrapped(doc: dict, run_ord: int,
                      reason or f"no parsed metric (rc={doc.get('rc')})"}]
     merged = dict(doc)
     merged.update(parsed)
-    return [_bench_line_row(merged, run_ord, source)], []
+    return ([_bench_line_row(merged, run_ord, source)]
+            + _serve_robustness_rows(merged, run_ord, source)), []
 
 
 def _load_multichip(doc: dict, run_ord: int,

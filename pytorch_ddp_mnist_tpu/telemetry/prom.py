@@ -22,7 +22,9 @@ absent beats lying), histograms as Prometheus `summary` quantile series
 plus `_sum`/`_count` and a `_max` gauge.
 
 Endpoints: `/metrics` (text/plain; version=0.0.4) and `/healthz` (JSON:
-the `health_summary` verdict — 200 while nothing fatal fired, 503 after).
+the `health_summary` verdict — 200 while nothing fatal fired, 503 after;
+a serve replica fleet with zero healthy replicas is also 503, while a
+degraded-but-serving fleet stays 200 with `fleet.degraded: true`).
 """
 
 from __future__ import annotations
@@ -96,7 +98,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             from .health import health_summary
             verdict = health_summary(self.registry)
-            status = 503 if verdict["worst_severity"] == "fatal" else 200
+            # fatal watchdog OR a replica fleet with nothing healthy left:
+            # both mean "stop sending traffic here" (a merely DEGRADED
+            # fleet stays 200 — it is still serving)
+            fleet = verdict.get("fleet")
+            dead_fleet = fleet is not None and fleet["healthy"] == 0
+            status = (503 if verdict["worst_severity"] == "fatal"
+                      or dead_fleet else 200)
             self._reply(status, (json.dumps(verdict) + "\n").encode(),
                         "application/json")
         else:

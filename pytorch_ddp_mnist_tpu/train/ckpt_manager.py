@@ -121,6 +121,19 @@ class StepCheckpoint:
                              # refuses a resume that contradicts them
 
 
+@dataclass
+class RestoreScan:
+    """Outcome of one newest-first restorability walk (`scan_restorable`):
+    the shared verdict on which checkpoint is promotable. `best` is the
+    newest intact AND finite candidate (None when none qualifies);
+    `newest_nonfinite` the newest intact-but-diverged one (the resume
+    path's last resort, the reload watcher's named refusal); `tried` the
+    named defect of every candidate rejected before `best`."""
+    best: "StepCheckpoint | None"
+    newest_nonfinite: "StepCheckpoint | None"
+    tried: List[str]
+
+
 def geometry_mismatch_message(manifest_meta: dict,
                               requested: dict) -> "str | None":
     """The run-geometry refusal, or None when every stamped field matches.
@@ -455,35 +468,34 @@ class CheckpointManager:
             epoch=int(rec["epoch"]), offset=int(rec["offset"]),
             path=manifest, resid=resid, meta=dict(rec.get("meta") or {}))
 
-    def restore_latest(self, template) -> StepCheckpoint:
-        """Newest INTACT + FINITE checkpoint, falling back past torn,
-        corrupt, and non-finite ones.
+    def scan_restorable(self, template,
+                        newer_than: "int | None" = None) -> "RestoreScan":
+        """The newest-intact-AND-finite preference itself, shared by
+        `restore_latest` (the trainer's `--resume`) and the serve
+        hot-reload watcher (`serve/reload.py`) — ONE walk, so the two
+        consumers can never drift on what "promotable" means.
 
-        The finiteness walk is new with the health watchdog: a run whose
-        params truly diverged keeps committing intact-by-CRC checkpoints
-        full of NaN — resuming from one trains garbage forever, so restore
-        prefers the newest checkpoint whose float leaves are all finite
-        (the watchdog's pinned rescue save, typically). When NO finite
-        candidate exists, the newest intact one is returned anyway with a
-        loud warning (behavior-preserving: refusing outright would strand
-        resumes that predate the watchdog).
+        Walks committed manifests newest-first and stops at the first
+        candidate that is both intact (`_load_intact`'s CRC/size/decode
+        contract) and finite, returning a `RestoreScan` with that
+        candidate (`best`), the newest intact-but-non-finite one seen
+        (`newest_nonfinite` — `restore_latest`'s last-resort fallback,
+        which a reload watcher must instead refuse), and the named defect
+        of every candidate rejected on the way (`tried`). Every rejection
+        lands in the flight recorder (kind `checkpoint_fallback`) and on
+        stderr exactly as the resume path always did.
 
-        Every rejected candidate lands in the flight recorder (kind
-        `checkpoint_fallback`, with the path and the named defect) and on
-        stderr; the restore that finally succeeds records
-        `checkpoint_restore`. Raises CheckpointError naming every tried
-        path when nothing intact remains."""
+        `newer_than` bounds the walk to steps strictly beyond it — the
+        reload watcher only considers checkpoints newer than what the
+        fleet already serves."""
         import sys
         from ..telemetry import flight
 
-        steps = self.steps()
-        if not steps:
-            raise CheckpointError(
-                f"{self.directory}: no committed step checkpoints "
-                f"(no step_*.json manifests)")
-        tried = []
+        tried: List[str] = []
         nonfinite_newest: StepCheckpoint | None = None
-        for step in reversed(steps):
+        for step in reversed(self.steps()):
+            if newer_than is not None and step <= newer_than:
+                break
             try:
                 ckpt = self._load_intact(step, template)
             except CheckpointError as e:
@@ -506,10 +518,47 @@ class CheckpointManager:
                 if nonfinite_newest is None:
                     nonfinite_newest = ckpt
                 continue
+            return RestoreScan(best=ckpt, newest_nonfinite=nonfinite_newest,
+                               tried=tried)
+        return RestoreScan(best=None, newest_nonfinite=nonfinite_newest,
+                           tried=tried)
+
+    def restore_latest(self, template) -> StepCheckpoint:
+        """Newest INTACT + FINITE checkpoint, falling back past torn,
+        corrupt, and non-finite ones.
+
+        The finiteness walk is new with the health watchdog: a run whose
+        params truly diverged keeps committing intact-by-CRC checkpoints
+        full of NaN — resuming from one trains garbage forever, so restore
+        prefers the newest checkpoint whose float leaves are all finite
+        (the watchdog's pinned rescue save, typically). When NO finite
+        candidate exists, the newest intact one is returned anyway with a
+        loud warning (behavior-preserving: refusing outright would strand
+        resumes that predate the watchdog).
+
+        Every rejected candidate lands in the flight recorder (kind
+        `checkpoint_fallback`, with the path and the named defect) and on
+        stderr; the restore that finally succeeds records
+        `checkpoint_restore`. Raises CheckpointError naming every tried
+        path when nothing intact remains. The walk itself lives in
+        `scan_restorable` — shared with the serve hot-reload watcher."""
+        import sys
+        from ..telemetry import flight
+
+        steps = self.steps()
+        if not steps:
+            raise CheckpointError(
+                f"{self.directory}: no committed step checkpoints "
+                f"(no step_*.json manifests)")
+        scan = self.scan_restorable(template)
+        tried = scan.tried
+        if scan.best is not None:
+            ckpt = scan.best
             flight.record("checkpoint_restore", step=ckpt.step,
                           epoch=ckpt.epoch, offset=ckpt.offset,
                           fallbacks=len(tried))
             return ckpt
+        nonfinite_newest = scan.newest_nonfinite
         if nonfinite_newest is not None:
             print(f"[ckpt] WARNING: every intact checkpoint holds "
                   f"non-finite params; restoring the newest anyway "

@@ -18,6 +18,9 @@ Spec syntax (comma-separated specs; `key=value` constraints after the kind):
     PDMT_FAULT="loader_stall:batch=3:delay_s=0.5"  # sleep in the loader
     PDMT_FAULT="collective_timeout:rank=1"       # DEADLINE_EXCEEDED barrier
     PDMT_FAULT="nan:step=5"                      # NaN the step-5 loss
+    PDMT_FAULT="engine_crash:after=40:replica=0" # serve engine dies mid-burst
+    PDMT_FAULT="engine_wedge:delay_s=2:replica=1"  # staged fetch hangs
+    PDMT_FAULT="reload_torn"                     # hot-reload sees a torn ckpt
 
 or `--fault SPEC` on the trainer CLI (env and flag merge). Each spec fires
 at its own fault point:
@@ -43,13 +46,45 @@ at its own fault point:
                                            through `poison`/`poison_array`,
                                            which RETURN the (possibly
                                            NaN'd) value instead of acting.
+    engine_crash        "serve_engine"     raise a RuntimeError from the
+                                           serve engine's bucket dispatch —
+                                           a replica dying mid-batch. Gate
+                                           with `after=N` (fires on the
+                                           engine's Nth executable call,
+                                           first-crossing >=) and
+                                           `replica=R` (a fleet replica
+                                           index) so chaos legs kill ONE
+                                           replica at a deterministic
+                                           point in the burst.
+    engine_wedge        "serve_wedge"      wedge the just-dispatched
+                                           in-flight batch (fired through
+                                           `claim`, which RETURNS the spec
+                                           for the engine to act on): its
+                                           results report not-ready for
+                                           delay_s and the staged fetch
+                                           blocks until then — the reply
+                                           thread hangs exactly as on a
+                                           device that stopped answering,
+                                           in-flight batches age, and the
+                                           fleet supervisor's batch
+                                           watchdog (serve/fleet.py) is
+                                           what must notice.
+    reload_torn         "reload_validate"  raise from the hot-reload
+                                           watcher's off-loop checkpoint
+                                           validation — a torn manifest
+                                           surfacing mid-swap. The watcher
+                                           must refuse BY NAME and keep
+                                           the incumbent serving
+                                           (serve/reload.py).
 
 Determinism contract: a spec with `step=K` fires at the FIRST fault-point
 crossing where the reported step is >= K (the epoch-scanned trainer only
 surfaces steps at checkpoint-chunk boundaries, so equality alone could
-never match); `epoch=`/`batch=` match exactly; `rank=` gates on the
-injecting process's rank (set by the CLI after wireup, seeded from $RANK
-before it). Every spec fires at most `times=` times (default 1). Every
+never match); `after=N` has the same first-crossing semantics over the
+serve engine's per-call ordinal; `epoch=`/`batch=`/`replica=` match
+exactly; `rank=` gates on the injecting process's rank (set by the CLI
+after wireup, seeded from $RANK before it). Every spec fires at most
+`times=` times (default 1). Every
 fired fault lands in the telemetry flight recorder as a `fault_injected`
 entry BEFORE the failure happens, so a post-mortem shows what was injected
 even when the action is SIGKILL.
@@ -77,11 +112,15 @@ POINTS = {
     "loader_stall": "loader_next",
     "collective_timeout": "barrier",
     "nan": "loss",
+    "engine_crash": "serve_engine",
+    "engine_wedge": "serve_wedge",
+    "reload_torn": "reload_validate",
 }
 
 # constraint keys with first-crossing (>=) semantics; all others match ==
-_THRESHOLD_KEYS = ("step",)
-_KNOWN_KEYS = ("step", "epoch", "batch", "rank", "delay_s", "times")
+_THRESHOLD_KEYS = ("step", "after")
+_KNOWN_KEYS = ("step", "epoch", "batch", "rank", "delay_s", "times",
+               "after", "replica")
 
 
 class FaultSpecError(ValueError):
@@ -191,6 +230,22 @@ class FaultInjector:
                       **{k: v for k, v in ctx.items()
                          if k not in ("fault", "point", "rank")})
 
+    def claim(self, point: str, **ctx) -> Optional[FaultSpec]:
+        """Caller-acted twin of `fire` (the control-flow analogue of
+        `poison`): match a due spec at `point`, mark it fired, land the
+        flight record, and RETURN the spec so the instrumented site can
+        perform a failure `fire` cannot express — the serve engine wedges
+        its just-dispatched in-flight handle with the spec's `delay_s`.
+        None when nothing is due (the common case)."""
+        for spec in self.specs:
+            if (spec.kind == "nan" or spec.point != point
+                    or not spec.matches(self.rank, ctx)):
+                continue
+            spec.fired += 1
+            self._record(spec, ctx)
+            return spec
+        return None
+
     def poison(self, point: str, value, **ctx):
         """Value-fault twin of `fire`: returns `value`, NaN-poisoned when a
         matching value spec (kind 'nan') is due at `point`. Works on jax
@@ -255,6 +310,16 @@ class FaultInjector:
             raise RuntimeError(
                 f"DEADLINE_EXCEEDED: injected fault: {spec.describe()} "
                 f"(simulated collective timeout)")
+        elif spec.kind == "engine_crash":
+            # a replica dying mid-batch: surfaces from the bucket dispatch
+            # exactly where a device reset / lost executable would, so the
+            # fleet's quarantine-and-retry path sees the real error shape
+            raise RuntimeError(f"injected fault: {spec.describe()} "
+                               f"(simulated serve engine crash)")
+        elif spec.kind == "reload_torn":
+            raise RuntimeError(f"injected fault: {spec.describe()} "
+                               f"(simulated torn checkpoint during reload "
+                               f"validation)")
 
 
 _INJECTOR: Optional[FaultInjector] = None
@@ -316,6 +381,20 @@ def fire(point: str, **ctx) -> None:
         inj = get_injector()
     if inj.specs:
         inj.fire(point, **ctx)
+
+
+def claim(point: str, **ctx) -> Optional[FaultSpec]:
+    """Caller-acted entry point: return the due spec at `point` (marked
+    fired + flight-recorded) for the call site to act on, or None. Same
+    few-ns no-fault fast path as `fire`."""
+    inj = _INJECTOR
+    if inj is None:
+        if FAULT_ENV not in os.environ:
+            return None
+        inj = get_injector()
+    if inj.specs:
+        return inj.claim(point, **ctx)
+    return None
 
 
 def poison(point: str, value, **ctx):

@@ -49,7 +49,14 @@ ledger record contract the same way: a non-empty series key, a KNOWN
 direction (higher_better/lower_better — the trend gate is meaningless
 without one), a finite value — `--require ledger.` gates the
 `ledger.series`/`ledger.regressions` registry metrics being present (the
-ledger-smoke pattern). Pure stdlib,
+ledger-smoke pattern). `fleet_event` / `reload_event` point records
+(serve/fleet.py replica state transitions, serve/reload.py hot-reload
+verdicts) get the fleet record contract the same way: known event names,
+non-negative int replica indices, known quarantine causes, a non-empty
+refusal reason, and the drain-before-swap invariant itself —
+`outstanding_at_swap == 0` on every swapped event — with
+`--require serve.fleet.,serve.reload.` gating the fleet counters and
+reload gauges being present (the chaos-smoke pattern). Pure stdlib,
 no jax import: the checker must run anywhere the trace lands, including
 hosts without the framework installed.
 """
@@ -136,6 +143,8 @@ _DISPATCH_SKIP = ("the dispatch record contract (known phase name, "
                   "non-negative durations, int step/epoch indices)")
 _LEDGER_SKIP = ("the ledger_row record contract (non-empty series key, "
                 "known direction, finite value)")
+_FLEET_SKIP = ("the fleet/reload record contract (known event names, "
+               "outstanding_at_swap == 0 on swaps, named refusals)")
 
 
 def span_structure_errors(segment):
@@ -174,6 +183,15 @@ def span_structure_errors(segment):
         else:
             _note_degraded("analysis.py predates ledger_row_errors",
                            _LEDGER_SKIP)
+        # the fleet/reload record contract (serve/fleet.py transitions,
+        # serve/reload.py verdicts — including the drain-before-swap
+        # invariant outstanding_at_swap == 0) — same file-load sharing,
+        # same named degrade
+        if hasattr(_analysis, "fleet_record_errors"):
+            errors.extend(_analysis.fleet_record_errors(segment))
+        else:
+            _note_degraded("analysis.py predates fleet_record_errors",
+                           _FLEET_SKIP)
         errors.sort(key=lambda e: e[0])
         return errors
     _note_degraded("analysis.py not found beside this script (span "
@@ -184,6 +202,8 @@ def span_structure_errors(segment):
                    _DISPATCH_SKIP)
     _note_degraded("analysis.py not found beside this script",
                    _LEDGER_SKIP)
+    _note_degraded("analysis.py not found beside this script",
+                   _FLEET_SKIP)
     return _fallback_structure_errors(segment)
 
 
@@ -260,7 +280,7 @@ def check_file(path: str, errors: list) -> int:
                                       f"{HEALTH_SEVERITIES}")
             if rec["kind"] == "point" and rec["name"] in (
                     "program_cost", "dispatch_phase", "dispatch_window",
-                    "ledger_row"):
+                    "ledger_row", "fleet_event", "reload_event"):
                 # cost, dispatch, and ledger records ride the segment so
                 # the shared validators (analysis.cost_record_errors /
                 # dispatch_record_errors / ledger_row_errors) see them;

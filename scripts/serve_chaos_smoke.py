@@ -1,0 +1,394 @@
+#!/usr/bin/env python
+"""Serve chaos smoke: crash, wedge, and torn-checkpoint-swap a replica
+fleet under open-loop load, and prove nothing admitted was ever lost.
+
+    python scripts/serve_chaos_smoke.py [--requests N] [--offered_rps R]
+
+The serving front door of docs/ROBUSTNESS.md (`make serve-chaos-smoke`).
+Three legs, all on CPU (the fleet behaves identically on any backend),
+all under a `--telemetry`-style trace whose fleet/reload records are
+schema-validated at the end:
+
+  1. KILL-MID-BURST — a 2-replica fleet serves a spike-shaped open-loop
+     burst (serve/loadgen.py `--shape spike`) while an injected
+     `engine_crash` kills one replica's engine mid-burst. The survivor
+     absorbs the failover; the verdict requires measured availability
+     1.0 (every admitted request answered), >= 1 crash quarantine, > 0
+     retried requests, and bitwise-identical predictions to a direct
+     single-engine pass over the same rows.
+  2. WEDGE-THEN-WATCHDOG — an injected `engine_wedge` hangs a dispatched
+     batch (the handle ages, never errors). The fleet's supervisor must
+     notice via `oldest_inflight_age`, quarantine the replica, fail the
+     wedged futures over to the survivor, and restart the wedged
+     replica. Same verdict: availability 1.0, >= 1 wedge, > 0 retried,
+     bitwise parity.
+  3. TORN-CHECKPOINT-SWAP — a `ReloadWatcher` polls a live checkpoint
+     directory while background traffic flows: a good commit hot-swaps
+     every replica behind a drain (each swap's `outstanding_at_swap`
+     must be 0 — validated from the trace by check_telemetry); an
+     injected `reload_torn` validation fault and an intact-but-NaN
+     checkpoint are REFUSED BY NAME with the incumbent still serving; an
+     actually-truncated newest payload makes the shared walk fall back
+     to the newest intact step instead (newest-promotable wins — a torn
+     commit costs only the step it tore); a final good commit promotes.
+     Verdict: 3 reloads (one of them the torn-fallback), 2 named
+     refusals, serving_step at the last good commit, zero failed
+     requests throughout.
+
+Then `scripts/check_telemetry.py --require serve.fleet.,serve.reload.`
+gates the whole trace: schema-valid records, the fleet/reload event
+contract (known event names, outstanding_at_swap == 0, non-empty
+refusal reasons), and the serve.fleet.* / serve.reload.* registry
+metrics present in the final snapshot.
+
+Exit codes: 0 = all legs held; 1 = any leg or the telemetry gate
+failed; 75 = skipped, no usable jax runtime (same convention as
+chaos_smoke.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_REPLICAS = 2
+MAX_BATCH = 16
+WEDGE_TIMEOUT_S = 0.15
+
+
+# the per-leg counters: the smoke shares ONE process registry across
+# legs (so the final snapshot carries serve.fleet.* for --require), which
+# makes every counter cumulative — each leg reads its own contribution
+# as an after-minus-before delta
+_LEG_COUNTERS = ("serve.completed", "serve.failed", "serve.rejected",
+                 "serve.fleet.crashes", "serve.fleet.wedges",
+                 "serve.fleet.retried_requests", "serve.fleet.restarts")
+
+
+def _counter_values(reg) -> dict:
+    snap = reg.snapshot()["counters"]
+    return {k: snap.get(k, 0) for k in _LEG_COUNTERS}
+
+
+def _direct_predictions(params, rows):
+    """The parity target: every row through one untouched engine."""
+    import numpy as np
+    from pytorch_ddp_mnist_tpu.serve import InferenceEngine
+    eng = InferenceEngine(params, max_batch=MAX_BATCH)
+    preds = [int(eng.predict(np.stack([r]))[0]) for r in rows]
+    eng.close()
+    return preds
+
+
+def _fleet(params, registry, **kw):
+    from pytorch_ddp_mnist_tpu.serve import FleetService, InferenceEngine
+    return FleetService(
+        lambda p: InferenceEngine(p, max_batch=MAX_BATCH), params,
+        n_replicas=N_REPLICAS, max_batch=MAX_BATCH, max_delay_ms=1.0,
+        registry=registry, wedge_timeout_s=WEDGE_TIMEOUT_S,
+        retry_budget=3, **kw)
+
+
+def _load_leg(params, registry, expect, *, fault: str, shape: str,
+              requests: int, offered_rps: float, expect_direct) -> dict:
+    """Legs 1 and 2 share this harness: inject `fault`, drive the
+    open-loop generator through a fresh fleet, compare predictions
+    bitwise against the direct pass, and require zero broken promises
+    plus the leg's expected failure counters."""
+    from pytorch_ddp_mnist_tpu.serve import run_until_drained
+    from pytorch_ddp_mnist_tpu.serve.loadgen import (request_rows,
+                                                     run_open_loop)
+    from pytorch_ddp_mnist_tpu.utils import faultpoints
+
+    before = _counter_values(registry)
+    faultpoints.install(fault)
+    try:
+        rows = request_rows(requests, "float32", seed=1)
+        fleet = _fleet(params, registry)
+        out = run_until_drained(
+            fleet, run_open_loop(fleet, offered_rps=offered_rps,
+                                 n_requests=requests, seed=0, rows=rows,
+                                 shape=shape))
+    finally:
+        faultpoints.install("")   # disarm before the next leg
+    d = {k: v - before[k]
+         for k, v in _counter_values(registry).items()}
+
+    completed, failed = d["serve.completed"], d["serve.failed"]
+    avail = (completed / (completed + failed)
+             if completed + failed else 0.0)
+    served = [p for p in out["predictions"] if p is not None]
+    # rejects leave None predictions and are honest backpressure; every
+    # SERVED prediction must match the direct engine bitwise
+    mismatches = sum(1 for p, e in zip(out["predictions"], expect_direct)
+                     if p is not None and p != e)
+    verdict = {
+        "fault": fault, "shape": shape,
+        "requests": requests, "served": len(served),
+        "rejected": d["serve.rejected"], "failed": failed,
+        "availability": round(avail, 6),
+        "crashes": d["serve.fleet.crashes"],
+        "wedges": d["serve.fleet.wedges"],
+        "retried_requests": d["serve.fleet.retried_requests"],
+        "restarts": d["serve.fleet.restarts"],
+        "bitwise_mismatches": mismatches,
+    }
+    problems = []
+    if failed:
+        problems.append(f"{failed} admitted requests failed")
+    if avail < 1.0:
+        problems.append(f"availability {avail:.6f} < 1.0")
+    if mismatches:
+        problems.append(f"{mismatches} served predictions diverged from "
+                        f"the direct engine")
+    for counter, floor in expect.items():
+        got = d[f"serve.fleet.{counter}"]
+        if got < floor:
+            problems.append(f"{counter}={got} < expected >= {floor}")
+    if d["serve.fleet.retried_requests"] < 1:
+        problems.append("no request was ever failed over (the fault "
+                        "never bit, or the failover path is dead)")
+    verdict["problems"] = problems
+    return verdict
+
+
+def _truncate(path: str, n: int) -> None:
+    with open(path, "r+b") as f:
+        f.truncate(n)
+
+
+async def _reload_leg(params, params_new, registry, ckpt_dir) -> dict:
+    """Leg 3: hot reload under traffic — good swap, injected-torn /
+    actually-torn / NaN refusals by name, then a final good swap."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from pytorch_ddp_mnist_tpu.serve.loadgen import request_rows
+    from pytorch_ddp_mnist_tpu.serve.reload import ReloadWatcher
+    from pytorch_ddp_mnist_tpu.train.ckpt_manager import CheckpointManager
+    from pytorch_ddp_mnist_tpu.utils import faultpoints
+
+    mgr = CheckpointManager(ckpt_dir)
+    key = np.zeros(2, np.uint32)
+    fleet = _fleet(params, registry, serving_step=0)
+    watcher = ReloadWatcher(fleet, ckpt_dir)
+    rows = request_rows(64, "float32", seed=2)
+
+    served = {"n": 0, "errors": 0}
+    stop = asyncio.Event()
+
+    async def traffic():
+        i = 0
+        while not stop.is_set():
+            try:
+                await fleet.handle(rows[i % len(rows)])
+                served["n"] += 1
+            except Exception:   # noqa: BLE001 — the verdict counts these
+                served["errors"] += 1
+            i += 1
+            await asyncio.sleep(0.002)
+
+    problems = []
+    t = asyncio.get_running_loop().create_task(traffic())
+    try:
+        # 1. good commit -> hot swap behind per-replica drains
+        mgr.save(params_new, key, "threefry2x32", step=1, epoch=0, offset=0)
+        if await watcher.poll_once() != "reloaded":
+            problems.append("good step 1 did not reload")
+
+        # 2. injected validation fault (the reload_torn fault point):
+        # refused by name, incumbent untouched
+        mgr.save(params_new, key, "threefry2x32", step=2, epoch=0, offset=0)
+        faultpoints.install("reload_torn:times=1")
+        try:
+            if await watcher.poll_once() != "refused":
+                problems.append("injected reload_torn was not refused")
+        finally:
+            faultpoints.install("")
+        # ...but step 2's file is intact: it must stay refused BY STEP
+        # (never re-validated), not get promoted on the next poll
+        if await watcher.poll_once() != "idle":
+            problems.append("refused step 2 was reconsidered")
+
+        # 3. actually-torn payload: truncate step 3's committed blob.
+        # The newer commit reopens the question and the shared walk falls
+        # back PAST the torn newest to the newest intact-and-finite step
+        # — step 2, whose earlier refusal was the transient injected
+        # fault. Newest-promotable wins (see serve/reload.py docstring):
+        # a torn commit costs the fleet nothing but the step it tore.
+        mgr.save(params_new, key, "threefry2x32", step=3, epoch=0, offset=0)
+        payload = glob.glob(os.path.join(ckpt_dir, "*3*.msgpack"))[0]
+        # off-loop: the traffic task shares this event loop, and blocking
+        # file IO here would stall the very requests the leg is measuring
+        await asyncio.get_running_loop().run_in_executor(
+            None, _truncate, payload, 16)
+        if await watcher.poll_once() != "reloaded":
+            problems.append("torn step 3 did not fall back to the intact "
+                            "step 2")
+        if fleet.serving_step != 2:
+            problems.append(f"torn-fallback serving_step "
+                            f"{fleet.serving_step} != 2")
+
+        # 4. intact but non-finite (a diverged run's checkpoint): with
+        # only the torn 3 and the NaN 4 beyond serving, NOTHING is
+        # promotable — refused by name, incumbent untouched
+        p_nan = jax.tree_util.tree_map(lambda a_: jnp.full_like(a_, jnp.nan),
+                                       params_new)
+        mgr.save(p_nan, key, "threefry2x32", step=4, epoch=0, offset=0)
+        if await watcher.poll_once() != "refused":
+            problems.append("NaN step 4 was not refused")
+        if fleet.serving_step != 2:
+            problems.append(f"refusals moved serving_step to "
+                            f"{fleet.serving_step} (expected 2)")
+
+        # 5. final good commit promotes past the wreckage
+        mgr.save(params_new, key, "threefry2x32", step=5, epoch=0, offset=0)
+        if await watcher.poll_once() != "reloaded":
+            problems.append("good step 5 did not reload")
+        if fleet.serving_step != 5:
+            problems.append(f"serving_step {fleet.serving_step} != 5")
+    finally:
+        stop.set()
+        await t
+        await watcher.stop()
+        snap = fleet.fleet_snapshot()
+        await fleet.shutdown()
+
+    if served["errors"]:
+        problems.append(f"{served['errors']} requests failed during the "
+                        f"reload cycle")
+    if served["n"] < 10:
+        problems.append(f"only {served['n']} requests flowed — the leg "
+                        f"never actually ran under traffic")
+    if watcher.reloads != 3:
+        problems.append(f"reloads={watcher.reloads} != 3")
+    if watcher.refused != 2:
+        problems.append(f"refused={watcher.refused} != 2")
+    return {
+        "served_during_reloads": served["n"],
+        "failed": served["errors"],
+        "availability": (round(served["n"]
+                               / (served["n"] + served["errors"]), 6)
+                         if served["n"] + served["errors"] else 0.0),
+        "reloads": watcher.reloads, "refused": watcher.refused,
+        "serving_step": fleet.serving_step,
+        "generation": snap["generation"],
+        "problems": problems,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replica-fleet crash/wedge/hot-reload chaos smoke")
+    ap.add_argument("--requests", type=int, default=200,
+                    help="open-loop requests per load leg")
+    ap.add_argument("--offered_rps", type=float, default=800.0)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh temp dir)")
+    ap.add_argument("--keep_workdir", action="store_true")
+    a = ap.parse_args(argv)
+
+    try:
+        import jax  # noqa: F401
+    except Exception as e:  # noqa: BLE001 — no runtime = skip, not fail
+        print(f"serve_chaos_smoke: SKIP — no usable jax runtime ({e})",
+              file=sys.stderr)
+        return 75
+
+    import jax
+    from pytorch_ddp_mnist_tpu import telemetry
+    from pytorch_ddp_mnist_tpu.models import init_mlp
+    from pytorch_ddp_mnist_tpu.serve.loadgen import request_rows
+    from pytorch_ddp_mnist_tpu.telemetry import flight
+
+    work = a.workdir or tempfile.mkdtemp(prefix="pdmt_serve_chaos_")
+    os.makedirs(work, exist_ok=True)
+    tel_dir = os.path.join(work, "telemetry")
+    ckpt_dir = os.path.join(work, "ckpts")
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    telemetry.enable(tel_dir)
+    flight.set_dump_dir(tel_dir)
+    reg = telemetry.get_registry()
+
+    params = init_mlp(jax.random.key(0))
+    params_new = init_mlp(jax.random.key(1))
+    t0 = time.monotonic()
+    # the parity target once: legs 1 and 2 drive the same seeded rows
+    direct = _direct_predictions(params,
+                                 request_rows(a.requests, "float32", seed=1))
+
+    legs = {}
+    # leg 1: replica 0's engine crashes mid-burst (after its 2nd batch)
+    legs["kill_mid_burst"] = _load_leg(
+        params, reg, {"crashes": 1},
+        fault="engine_crash:after=2:replica=0", shape="spike",
+        requests=a.requests, offered_rps=a.offered_rps,
+        expect_direct=direct)
+    # leg 2: replica 1 wedges (a dispatched batch hangs for 1s; the
+    # watchdog must fail it over within WEDGE_TIMEOUT_S)
+    legs["wedge_then_watchdog"] = _load_leg(
+        params, reg, {"wedges": 1},
+        fault="engine_wedge:delay_s=1.0:replica=1", shape="poisson",
+        requests=a.requests, offered_rps=a.offered_rps,
+        expect_direct=direct)
+    # leg 3: hot reload under traffic, with torn/NaN refusals by name
+    legs["torn_checkpoint_swap"] = asyncio.run(
+        _reload_leg(params, params_new, reg, ckpt_dir))
+
+    # stamp the final registry snapshot into the trace (what --require
+    # gates on), flush the flight ring, close the JSONL
+    telemetry.get_tracer().snapshot(reg)
+    flight.dump(reason="serve chaos smoke")
+    telemetry.disable()
+
+    check = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_telemetry.py"),
+         "--require", "serve.fleet.,serve.reload.", tel_dir],
+        capture_output=True, text=True)
+    telemetry_ok = check.returncode == 0
+    if not telemetry_ok:
+        print(f"serve_chaos_smoke: telemetry gate failed:\n{check.stdout}"
+              f"\n{check.stderr}", file=sys.stderr)
+
+    problems = [f"{leg}: {p}" for leg, v in legs.items()
+                for p in v.pop("problems")]
+    verdict = {
+        "serve_chaos_smoke": "ok" if not problems and telemetry_ok
+        else "fail",
+        "replicas": N_REPLICAS,
+        "wedge_timeout_s": WEDGE_TIMEOUT_S,
+        # the headline: the worst measured availability across legs —
+        # the number the fleet exists to hold at 1.0 under faults
+        "availability": min(v["availability"] for v in legs.values()),
+        "legs": legs,
+        "telemetry": "validated" if telemetry_ok else "FAILED",
+        "dur_s": round(time.monotonic() - t0, 2),
+    }
+    if problems:
+        verdict["problems"] = problems
+        for p in problems:
+            print(f"serve_chaos_smoke: FAIL — {p}", file=sys.stderr)
+    print(json.dumps(verdict))
+    if not a.keep_workdir and a.workdir is None and not problems \
+            and telemetry_ok:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if not problems and telemetry_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,11 @@ def test_serve_mode_contract():
     assert 0 < rec["batch_occupancy"] <= 1
     # bucket ladder 1..16 -> exactly 5 warmup compiles, none at serve time
     assert rec["compile_count"] == 5
+    # robustness stamps: default run is one replica on the poisson shape,
+    # fully available, with no failovers and no reloads to report
+    assert rec["shape"] == "poisson" and rec["replicas"] == 1
+    assert rec["availability"] == 1.0
+    assert rec["retried_requests"] == 0 and rec["reloads"] == 0
 
 
 def test_ddp_mode_contract_8_fake_devices():

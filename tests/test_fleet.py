@@ -1,0 +1,366 @@
+"""serve/fleet.py + serve/reload.py on CPU: the replica fleet's
+acceptance invariants — fleet-served predictions bitwise-equal to a
+direct engine, crash and wedge failover losing zero admitted requests,
+hot reload swapping behind a drain so no request spans a swap, torn/NaN
+checkpoints refused by name with the incumbent serving — plus the
+shared-restore-preference scan (`scan_restorable`), the `claim` fault
+primitive, the loadgen arrival shapes, and the fleet/reload record
+validators."""
+
+import asyncio
+import glob
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from pytorch_ddp_mnist_tpu.models import init_mlp
+from pytorch_ddp_mnist_tpu.serve import (FleetService, FleetUnavailable,
+                                         InferenceEngine, ReloadWatcher)
+from pytorch_ddp_mnist_tpu.serve.loadgen import arrival_times, request_rows
+from pytorch_ddp_mnist_tpu.telemetry.registry import MetricsRegistry
+from pytorch_ddp_mnist_tpu.train.ckpt_manager import CheckpointManager
+from pytorch_ddp_mnist_tpu.utils import faultpoints
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_mlp(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def params_b():
+    return init_mlp(jax.random.key(1))
+
+
+@pytest.fixture(scope="module")
+def rows():
+    return request_rows(48, "float32", seed=1)
+
+
+@pytest.fixture(scope="module")
+def direct(params, rows):
+    eng = InferenceEngine(params, max_batch=8)
+    preds = [int(eng.predict(np.stack([r]))[0]) for r in rows]
+    eng.close()
+    return preds
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faultpoints.install("")
+
+
+def _fleet(params, **kw):
+    kw.setdefault("n_replicas", 2)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_delay_ms", 1.0)
+    kw.setdefault("registry", MetricsRegistry())
+    return FleetService(lambda p: InferenceEngine(p, max_batch=8),
+                        params, **kw)
+
+
+def _serve_all(fleet, rows):
+    async def scenario():
+        got = await asyncio.gather(*[fleet.handle(r) for r in rows],
+                                   return_exceptions=True)
+        snap = fleet.fleet_snapshot()
+        await fleet.shutdown()
+        return got, snap
+    return asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# fleet: routing + parity
+# ---------------------------------------------------------------------------
+
+def test_fleet_bitwise_parity_with_direct_engine(params, rows, direct):
+    got, snap = _serve_all(_fleet(params), rows)
+    assert list(got) == direct
+    # both replicas actually served (least-loaded routing spreads work)
+    assert snap["healthy"] == 2 and not snap["degraded"]
+
+
+def test_fleet_validates_geometry(params):
+    with pytest.raises(ValueError, match="n_replicas"):
+        _fleet(params, n_replicas=0)
+    with pytest.raises(ValueError, match="retry_budget"):
+        _fleet(params, retry_budget=-1)
+    with pytest.raises(ValueError, match="wedge_timeout_s"):
+        _fleet(params, wedge_timeout_s=0)
+
+
+def test_client_error_propagates_unretried(params, rows):
+    fleet = _fleet(params)
+
+    async def scenario():
+        with pytest.raises((ValueError, TypeError)):
+            await fleet.handle(np.zeros(3))     # wrong row shape
+        ok = await fleet.handle(rows[0])
+        snap = fleet.fleet_snapshot()
+        await fleet.shutdown()
+        return ok, snap
+
+    ok, snap = asyncio.run(scenario())
+    assert isinstance(ok, int)
+    # a malformed payload is the CLIENT's fault: no quarantine, no retry
+    assert snap["retried_requests"] == 0
+    assert snap["crashes"] == 0 and snap["healthy"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet: crash + wedge failover
+# ---------------------------------------------------------------------------
+
+def test_crash_failover_loses_nothing(params, rows, direct):
+    faultpoints.install("engine_crash:after=1:replica=0")
+    got, snap = _serve_all(_fleet(params), rows)
+    assert list(got) == direct          # zero lost, zero wrong
+    assert snap["crashes"] >= 1
+    assert snap["retried_requests"] >= 1
+
+
+def test_wedge_watchdog_fails_over(params, rows, direct):
+    faultpoints.install("engine_wedge:delay_s=1.0:replica=1")
+    got, snap = _serve_all(
+        _fleet(params, wedge_timeout_s=0.1, retry_budget=3), rows)
+    assert list(got) == direct
+    assert snap["wedges"] >= 1
+    assert snap["retried_requests"] >= 1
+
+
+def test_retry_budget_bounds_failover(params, rows):
+    # every replica's engine crashes on every serve call (times=100 so
+    # the spec never exhausts before the budget does): the request must
+    # surface a replica failure after retry_budget+1 attempts, never
+    # spin forever
+    faultpoints.install("engine_crash:times=100")
+    fleet = _fleet(params, retry_budget=1, no_replica_wait_s=0.2)
+
+    async def scenario():
+        with pytest.raises(Exception) as ei:
+            await fleet.handle(rows[0])
+        snap = fleet.fleet_snapshot()
+        await fleet.shutdown()
+        return ei.value, snap
+
+    exc, snap = asyncio.run(scenario())
+    assert not isinstance(exc, (ValueError, TypeError))
+    assert snap["retry_exhausted"] >= 1 or isinstance(exc, FleetUnavailable)
+
+
+# ---------------------------------------------------------------------------
+# hot reload: swap invariant, refusal by name
+# ---------------------------------------------------------------------------
+
+def test_reload_swaps_all_replicas_no_request_spans_swap(
+        params, params_b, rows, tmp_path):
+    eng_b = InferenceEngine(params_b, max_batch=8)
+    direct_b = [int(eng_b.predict(np.stack([r]))[0]) for r in rows]
+    eng_b.close()
+
+    mgr = CheckpointManager(str(tmp_path))
+    key = np.zeros(2, np.uint32)
+    mgr.save(params_b, key, "threefry2x32", step=7, epoch=0, offset=0)
+    fleet = _fleet(params, serving_step=0)
+    watcher = ReloadWatcher(fleet, str(tmp_path))
+
+    async def scenario():
+        # traffic in flight while the swap happens
+        burst = [asyncio.ensure_future(fleet.handle(r)) for r in rows]
+        verdict = await watcher.poll_once()
+        old_engines = [rep.engine for rep in fleet.replicas]
+        first = await asyncio.gather(*burst)
+        after = await asyncio.gather(*[fleet.handle(r) for r in rows])
+        snap = fleet.fleet_snapshot()
+        await watcher.stop()
+        await fleet.shutdown()
+        return verdict, first, after, old_engines, snap
+
+    verdict, first, after, new_engines, snap = asyncio.run(scenario())
+    assert verdict == "reloaded"
+    assert fleet.serving_step == 7 and snap["generation"] == 1
+    # every post-swap answer comes from the NEW params, bitwise
+    assert list(after) == direct_b
+    # every in-flight request completed with a real answer — none was
+    # dropped or errored by the drain-and-swap
+    assert all(isinstance(got, int) for got in first)
+    # every replica rebuilt onto generation 1
+    assert all(rep.generation == 1 for rep in fleet.replicas)
+
+
+def test_reload_refuses_torn_and_nan_by_name(params, params_b, tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    key = np.zeros(2, np.uint32)
+    fleet = _fleet(params, serving_step=0)
+    watcher = ReloadWatcher(fleet, str(tmp_path))
+
+    # torn: newest payload truncated after commit
+    mgr.save(params_b, key, "threefry2x32", step=3, epoch=0, offset=0)
+    payload = glob.glob(os.path.join(str(tmp_path), "*3*.msgpack"))[0]
+    with open(payload, "r+b") as f:
+        f.truncate(8)
+
+    async def scenario():
+        torn = await watcher.poll_once()
+        idle = await watcher.poll_once()    # refused steps never re-poll
+        # NaN: intact by CRC, non-finite values — refused where a resume
+        # would fall back with a warning
+        p_nan = jax.tree_util.tree_map(
+            lambda a_: jnp.full_like(a_, jnp.nan), params_b)
+        mgr.save(p_nan, key, "threefry2x32", step=4, epoch=0, offset=0)
+        nan = await watcher.poll_once()
+        still_serving = await fleet.handle(
+            request_rows(1, "float32", seed=5)[0])
+        await watcher.stop()
+        await fleet.shutdown()
+        return torn, idle, nan, still_serving
+
+    torn, idle, nan, still_serving = asyncio.run(scenario())
+    assert (torn, idle, nan) == ("refused", "idle", "refused")
+    assert watcher.refused == 2 and watcher.reloads == 0
+    assert fleet.serving_step == 0          # incumbent untouched
+    assert isinstance(still_serving, int)   # and still serving
+
+
+def test_reload_torn_faultpoint_refuses_by_name(params, params_b, tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    key = np.zeros(2, np.uint32)
+    mgr.save(params_b, key, "threefry2x32", step=2, epoch=0, offset=0)
+    fleet = _fleet(params, serving_step=0)
+    watcher = ReloadWatcher(fleet, str(tmp_path))
+    faultpoints.install("reload_torn:times=1")
+
+    async def scenario():
+        refused = await watcher.poll_once()
+        await watcher.stop()
+        await fleet.shutdown()
+        return refused
+
+    assert asyncio.run(scenario()) == "refused"
+    assert fleet.serving_step == 0
+
+
+# ---------------------------------------------------------------------------
+# shared restore preference: scan_restorable
+# ---------------------------------------------------------------------------
+
+def test_scan_restorable_matches_restore_latest(params, params_b, tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    key = np.zeros(2, np.uint32)
+    mgr.save(params, key, "threefry2x32", step=1, epoch=0, offset=0)
+    p_nan = jax.tree_util.tree_map(
+        lambda a_: jnp.full_like(a_, jnp.nan), params_b)
+    mgr.save(p_nan, key, "threefry2x32", step=2, epoch=0, offset=0)
+
+    scan = mgr.scan_restorable(params)
+    # the walk prefers the newest INTACT AND FINITE step...
+    assert scan.best is not None and scan.best.step == 1
+    # ...while remembering the newer non-finite one (resume's fallback,
+    # reload's named refusal)
+    assert scan.newest_nonfinite is not None
+    assert scan.newest_nonfinite.step == 2
+    # and restore_latest (the --resume path) picks the same best
+    assert mgr.restore_latest(params).step == 1
+
+
+def test_scan_restorable_newer_than_bound(params, tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    key = np.zeros(2, np.uint32)
+    for step in (1, 2):
+        mgr.save(params, key, "threefry2x32", step=step, epoch=0, offset=0)
+    # nothing beyond step 2: the bounded walk never touches older steps
+    scan = mgr.scan_restorable(params, newer_than=2)
+    assert scan.best is None and scan.tried == []
+    assert mgr.scan_restorable(params, newer_than=1).best.step == 2
+
+
+# ---------------------------------------------------------------------------
+# faultpoints: the claim primitive
+# ---------------------------------------------------------------------------
+
+def test_claim_returns_spec_and_marks_fired():
+    faultpoints.install("engine_wedge:delay_s=0.5:replica=1:times=1")
+    # context mismatch: no claim, not consumed
+    assert faultpoints.claim("serve_wedge", replica=0) is None
+    spec = faultpoints.claim("serve_wedge", replica=1)
+    assert spec is not None and spec.delay_s == 0.5
+    # times=1: consumed by the claim above
+    assert faultpoints.claim("serve_wedge", replica=1) is None
+
+
+def test_claim_disarmed_is_free():
+    faultpoints.install("")
+    assert faultpoints.claim("serve_wedge", replica=0) is None
+
+
+# ---------------------------------------------------------------------------
+# loadgen arrival shapes
+# ---------------------------------------------------------------------------
+
+def test_poisson_shape_is_bitwise_legacy():
+    rng = np.random.default_rng(9)
+    legacy = np.cumsum(rng.exponential(1.0 / 250.0, size=300))
+    assert np.array_equal(
+        arrival_times(300, 250.0, shape="poisson", seed=9), legacy)
+
+
+@pytest.mark.parametrize("shape", ["poisson", "ramp", "spike"])
+def test_shapes_monotone_and_mass_balanced(shape):
+    t = arrival_times(2000, 400.0, shape=shape, seed=0)
+    assert t.shape == (2000,)
+    assert np.all(np.diff(t) >= 0) and t[0] >= 0
+    # same total load: the last arrival lands near the nominal T = n/r
+    assert t[-1] == pytest.approx(5.0, rel=0.25)
+
+
+def test_ramp_backloads_spike_bursts():
+    r = arrival_times(4000, 400.0, shape="ramp", seed=1)   # T = 10s
+    assert np.sum(r < 5.0) < 0.4 * len(r)       # analytic share: 30%
+    s = arrival_times(4000, 400.0, shape="spike", seed=1)
+    mid = np.sum((s >= 4.0) & (s < 6.0))
+    assert mid > 0.5 * len(s)                   # analytic share: 60%
+
+
+def test_unknown_shape_refused_by_name():
+    with pytest.raises(ValueError, match="sawtooth"):
+        arrival_times(5, 1.0, shape="sawtooth")
+
+
+# ---------------------------------------------------------------------------
+# fleet/reload record validators (the check_telemetry contract)
+# ---------------------------------------------------------------------------
+
+def test_fleet_record_errors_flag_contract_violations():
+    from pytorch_ddp_mnist_tpu.telemetry.analysis import fleet_record_errors
+
+    def point(name, line, **attrs):
+        return {"kind": "point", "name": name, "_line": line,
+                "attrs": attrs}
+
+    good = [
+        point("fleet_event", 1, event="quarantine", replica=0,
+              cause="wedge"),
+        point("fleet_event", 2, event="restart", replica=0, dur_s=0.1),
+        point("reload_event", 3, event="swapped", replica=1,
+              outstanding_at_swap=0),
+        point("reload_event", 4, event="refused", step=3, reason="torn"),
+    ]
+    assert fleet_record_errors(good) == []
+
+    bad = [
+        point("fleet_event", 1, event="exploded", replica=0),
+        point("fleet_event", 2, event="quarantine", replica=-1,
+              cause="gremlins"),
+        point("reload_event", 3, event="swapped", replica=1,
+              outstanding_at_swap=2),
+        point("reload_event", 4, event="refused", step=3, reason=""),
+    ]
+    msgs = dict(fleet_record_errors(bad))
+    assert "unknown event 'exploded'" in msgs[1]
+    assert len([ln for ln in msgs if ln == 2]) == 1
+    assert "outstanding_at_swap" in msgs[3]
+    assert "reason" in msgs[4]
